@@ -1,0 +1,24 @@
+"""katib_tpu — a TPU-native AutoML framework.
+
+Hyperparameter tuning, early stopping, and neural architecture search with the
+capability surface of kubeflow/katib, rebuilt idiomatically on JAX/XLA:
+Experiment/Suggestion/Trial state machines over a local state store, an
+in-process pluggable suggestion engine, a pjit/shard_map trial runtime that
+gang-schedules JAX training onto TPU device meshes, push-based metric
+observation logs, and orbax checkpointing for PBT lineage and resume.
+
+See SURVEY.md for the structural map of the reference this matches.
+"""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)

@@ -1,0 +1,75 @@
+"""Experiment defaulting — mirrors the mutating webhook.
+
+reference pkg/apis/controller/experiments/v1beta1/experiment_defaults.go:27-178
+and pkg/webhook/v1beta1/experiment/mutate_webhook.go.
+"""
+
+from __future__ import annotations
+
+from .spec import (
+    CollectorKind,
+    ExperimentSpec,
+    MetricStrategy,
+    MetricStrategyType,
+    MetricsCollectorSpec,
+    ObjectiveType,
+    ResumePolicy,
+    SourceSpec,
+)
+
+# reference experiment_defaults.go DefaultTrialParallelCount = 3
+DEFAULT_PARALLEL_TRIAL_COUNT = 3
+DEFAULT_RESUME_POLICY = ResumePolicy.NEVER
+# reference common_types.go DefaultFilePath = "/var/log/katib/metrics.log";
+# TPU-native: per-trial workdir-relative path.
+DEFAULT_METRICS_FILE = "metrics.log"
+
+
+def _default_strategy_for(objective_type: ObjectiveType) -> MetricStrategyType:
+    if objective_type == ObjectiveType.MINIMIZE:
+        return MetricStrategyType.MIN
+    if objective_type == ObjectiveType.MAXIMIZE:
+        return MetricStrategyType.MAX
+    return MetricStrategyType.LATEST
+
+
+def set_defaults(spec: ExperimentSpec) -> ExperimentSpec:
+    """Fill all defaultable fields in place (and return the spec).
+
+    Order follows Experiment.SetDefault (experiment_defaults.go:27-33):
+    parallelTrialCount, resumePolicy, objective metric strategies,
+    trial template conditions, metrics collector.
+    """
+    if spec.parallel_trial_count is None:
+        spec.parallel_trial_count = DEFAULT_PARALLEL_TRIAL_COUNT
+    if not spec.resume_policy:
+        spec.resume_policy = DEFAULT_RESUME_POLICY
+
+    # Metric strategies: objective metric gets min/max by objective type, any
+    # additional metric without an explicit strategy gets the same default
+    # (experiment_defaults.go:48-95).
+    obj = spec.objective
+    existing = {s.name for s in obj.metric_strategies}
+    if obj.objective_metric_name and obj.objective_metric_name not in existing:
+        obj.metric_strategies.append(
+            MetricStrategy(name=obj.objective_metric_name, value=_default_strategy_for(obj.type))
+        )
+    for metric in obj.additional_metric_names:
+        if metric not in existing and metric != obj.objective_metric_name:
+            obj.metric_strategies.append(
+                MetricStrategy(name=metric, value=_default_strategy_for(obj.type))
+            )
+
+    # Metrics collector: the reference defaults to a StdOut scraping sidecar
+    # (experiment_defaults.go:131-137). TPU-native default is PUSH for
+    # in-process entry points; subprocess command trials default to STDOUT
+    # scraping for parity with arbitrary training scripts.
+    if spec.metrics_collector_spec is None:
+        spec.metrics_collector_spec = MetricsCollectorSpec()
+    mc = spec.metrics_collector_spec
+    if mc.collector_kind in (CollectorKind.FILE, CollectorKind.TF_EVENT) and mc.source is None:
+        mc.source = SourceSpec(file_path=DEFAULT_METRICS_FILE)
+    if spec.trial_template.command is not None and mc.collector_kind == CollectorKind.PUSH:
+        mc.collector_kind = CollectorKind.STDOUT
+
+    return spec

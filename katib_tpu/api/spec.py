@@ -1,0 +1,754 @@
+"""Core experiment/trial/suggestion specification types.
+
+TPU-native re-design of Katib's CRD API surface. The reference defines these as
+Kubernetes CRD Go structs; here they are plain dataclasses held in a local state
+store (katib_tpu.db) instead of etcd, but the field semantics are preserved:
+
+- ExperimentSpec / ExperimentStatus:
+  reference pkg/apis/controller/experiments/v1beta1/experiment_types.go:26-324
+- TrialSpec / TrialStatus:
+  reference pkg/apis/controller/trials/v1beta1/trial_types.go:27-153
+- SuggestionSpec / SuggestionStatus:
+  reference pkg/apis/controller/suggestions/v1beta1/suggestion_types.go:29-150
+- Objective / metrics-collector / algorithm common types:
+  reference pkg/apis/controller/common/v1beta1/common_types.go:25-234
+
+Instead of an unstructured Kubernetes runSpec, a trial's run spec is either a
+shell command template (``${trialParameters.x}`` substitution, mirroring
+pkg/controller.v1beta1/experiment/manifest/generator.go:99-186) or a Python
+entry point resolved in-process (the TPU-native fast path used by
+``KatibClient.tune``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+
+# ---------------------------------------------------------------------------
+# Enums (reference: common_types.go, experiment_types.go)
+# ---------------------------------------------------------------------------
+
+class ObjectiveType(str, enum.Enum):
+    """reference common_types.go:27-35 (ObjectiveTypeMinimize/Maximize)."""
+
+    MINIMIZE = "minimize"
+    MAXIMIZE = "maximize"
+    UNKNOWN = ""
+
+
+class MetricStrategyType(str, enum.Enum):
+    """How to fold a metric's observation log into one value.
+
+    reference common_types.go:58-64 (ExtractByMin/Max/Latest).
+    """
+
+    MIN = "min"
+    MAX = "max"
+    LATEST = "latest"
+
+
+class ParameterType(str, enum.Enum):
+    """reference experiment_types.go:197-204."""
+
+    DOUBLE = "double"
+    INT = "int"
+    DISCRETE = "discrete"
+    CATEGORICAL = "categorical"
+    UNKNOWN = "unknown"
+
+
+class Distribution(str, enum.Enum):
+    """reference experiment_types.go:214-220."""
+
+    UNIFORM = "uniform"
+    LOG_UNIFORM = "logUniform"
+    NORMAL = "normal"
+    LOG_NORMAL = "logNormal"
+    UNKNOWN = "unknown"
+
+
+class ResumePolicy(str, enum.Enum):
+    """reference experiment_types.go:179-191.
+
+    NEVER: suggestion service state is dropped at completion; experiment cannot
+        be resumed.
+    LONG_RUNNING: suggestion state is kept in memory; experiment can be resumed
+        by raising budgets.
+    FROM_VOLUME: suggestion state is persisted (here: to the state-store
+        directory rather than a PVC) and restorable after restart.
+    """
+
+    NEVER = "Never"
+    LONG_RUNNING = "LongRunning"
+    FROM_VOLUME = "FromVolume"
+
+
+class CollectorKind(str, enum.Enum):
+    """reference common_types.go:205-227."""
+
+    STDOUT = "StdOut"
+    FILE = "File"
+    TF_EVENT = "TfEvent"
+    PROMETHEUS = "PrometheusMetric"
+    CUSTOM = "Custom"
+    NONE = "None"
+    PUSH = "Push"  # TPU-native first-class push reporting (katib_tpu.runtime.metrics)
+
+
+class ComparisonType(str, enum.Enum):
+    """reference common_types.go:118-129 (early stopping rule comparison)."""
+
+    EQUAL = "equal"
+    LESS = "less"
+    GREATER = "greater"
+
+
+# ---------------------------------------------------------------------------
+# Search space
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FeasibleSpace:
+    """reference experiment_types.go:222-232.
+
+    min/max/step are strings in the reference (CRD round-tripping); we keep
+    them as strings at the API boundary and parse in
+    katib_tpu.suggest.internal.search_space.
+    """
+
+    min: Optional[str] = None
+    max: Optional[str] = None
+    list: Optional[List[str]] = None
+    step: Optional[str] = None
+    distribution: Optional[Distribution] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.min is not None:
+            d["min"] = self.min
+        if self.max is not None:
+            d["max"] = self.max
+        if self.list is not None:
+            d["list"] = list(self.list)
+        if self.step is not None:
+            d["step"] = self.step
+        if self.distribution is not None:
+            d["distribution"] = self.distribution.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FeasibleSpace":
+        return cls(
+            min=d.get("min"),
+            max=d.get("max"),
+            list=d.get("list"),
+            step=d.get("step"),
+            distribution=Distribution(d["distribution"]) if d.get("distribution") else None,
+        )
+
+
+@dataclass
+class ParameterSpec:
+    """reference experiment_types.go:191-195."""
+
+    name: str
+    parameter_type: ParameterType
+    feasible_space: FeasibleSpace
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "parameterType": self.parameter_type.value,
+            "feasibleSpace": self.feasible_space.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ParameterSpec":
+        return cls(
+            name=d["name"],
+            parameter_type=ParameterType(d["parameterType"]),
+            feasible_space=FeasibleSpace.from_dict(d.get("feasibleSpace", {})),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Objective / metrics
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MetricStrategy:
+    """reference common_types.go:66-69."""
+
+    name: str
+    value: MetricStrategyType
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "value": self.value.value}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricStrategy":
+        return cls(name=d["name"], value=MetricStrategyType(d["value"]))
+
+
+@dataclass
+class ObjectiveSpec:
+    """reference common_types.go:37-56."""
+
+    type: ObjectiveType = ObjectiveType.UNKNOWN
+    goal: Optional[float] = None
+    objective_metric_name: str = ""
+    additional_metric_names: List[str] = field(default_factory=list)
+    metric_strategies: List[MetricStrategy] = field(default_factory=list)
+
+    def all_metric_names(self) -> List[str]:
+        return [self.objective_metric_name] + list(self.additional_metric_names)
+
+    def strategy_for(self, metric: str) -> MetricStrategyType:
+        for s in self.metric_strategies:
+            if s.name == metric:
+                return s.value
+        # default mirrors experiment_defaults.go setDefaultMetricStrategies:
+        # maximize -> max, minimize -> min
+        if self.type == ObjectiveType.MINIMIZE:
+            return MetricStrategyType.MIN
+        return MetricStrategyType.MAX
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "type": self.type.value,
+            "objectiveMetricName": self.objective_metric_name,
+        }
+        if self.goal is not None:
+            d["goal"] = self.goal
+        if self.additional_metric_names:
+            d["additionalMetricNames"] = list(self.additional_metric_names)
+        if self.metric_strategies:
+            d["metricStrategies"] = [s.to_dict() for s in self.metric_strategies]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectiveSpec":
+        return cls(
+            type=ObjectiveType(d.get("type", "")),
+            goal=d.get("goal"),
+            objective_metric_name=d.get("objectiveMetricName", ""),
+            additional_metric_names=list(d.get("additionalMetricNames", [])),
+            metric_strategies=[MetricStrategy.from_dict(s) for s in d.get("metricStrategies", [])],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Algorithm / early stopping
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AlgorithmSetting:
+    """reference common_types.go:95-101."""
+
+    name: str
+    value: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"name": self.name, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AlgorithmSetting":
+        return cls(name=d["name"], value=str(d["value"]))
+
+
+@dataclass
+class AlgorithmSpec:
+    """reference common_types.go:86-93."""
+
+    algorithm_name: str = ""
+    algorithm_settings: List[AlgorithmSetting] = field(default_factory=list)
+
+    def settings_dict(self) -> Dict[str, str]:
+        return {s.name: s.value for s in self.algorithm_settings}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "algorithmName": self.algorithm_name,
+            "algorithmSettings": [s.to_dict() for s in self.algorithm_settings],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AlgorithmSpec":
+        return cls(
+            algorithm_name=d.get("algorithmName", ""),
+            algorithm_settings=[AlgorithmSetting.from_dict(s) for s in d.get("algorithmSettings", [])],
+        )
+
+
+@dataclass
+class EarlyStoppingSpec:
+    """reference common_types.go:103-110."""
+
+    algorithm_name: str = ""
+    algorithm_settings: List[AlgorithmSetting] = field(default_factory=list)
+
+    def settings_dict(self) -> Dict[str, str]:
+        return {s.name: s.value for s in self.algorithm_settings}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "algorithmName": self.algorithm_name,
+            "algorithmSettings": [s.to_dict() for s in self.algorithm_settings],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EarlyStoppingSpec":
+        return cls(
+            algorithm_name=d.get("algorithmName", ""),
+            algorithm_settings=[AlgorithmSetting.from_dict(s) for s in d.get("algorithmSettings", [])],
+        )
+
+
+@dataclass
+class EarlyStoppingRule:
+    """reference common_types.go:112-129 and api.proto EarlyStoppingRule."""
+
+    name: str
+    value: str
+    comparison: ComparisonType
+    start_step: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "value": self.value,
+            "comparison": self.comparison.value,
+            "startStep": self.start_step,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EarlyStoppingRule":
+        return cls(
+            name=d["name"],
+            value=str(d["value"]),
+            comparison=ComparisonType(d["comparison"]),
+            start_step=int(d.get("startStep", 0)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Metrics collector
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FilterSpec:
+    """reference common_types.go:229-234 (metricsFormat regexes)."""
+
+    metrics_format: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metricsFormat": list(self.metrics_format)}
+
+
+@dataclass
+class SourceSpec:
+    """Subset of reference common_types.go:154-203 relevant without K8s:
+
+    file_system_path + filter. (Prometheus http source is represented but the
+    TPU-native path is PUSH.)
+    """
+
+    file_path: Optional[str] = None
+    file_format: str = "TEXT"  # TEXT | JSON, reference common_types.go FileSystemKind
+    filter: Optional[FilterSpec] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"fileFormat": self.file_format}
+        if self.file_path:
+            d["filePath"] = self.file_path
+        if self.filter:
+            d["filter"] = self.filter.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SourceSpec":
+        filt = d.get("filter")
+        return cls(
+            file_path=d.get("filePath"),
+            file_format=d.get("fileFormat", "TEXT"),
+            filter=FilterSpec(metrics_format=list(filt.get("metricsFormat", []))) if filt else None,
+        )
+
+
+@dataclass
+class MetricsCollectorSpec:
+    """reference common_types.go:131-152."""
+
+    collector_kind: CollectorKind = CollectorKind.PUSH
+    source: Optional[SourceSpec] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"collector": {"kind": self.collector_kind.value}}
+        if self.source:
+            d["source"] = self.source.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricsCollectorSpec":
+        return cls(
+            collector_kind=CollectorKind(d.get("collector", {}).get("kind", "Push")),
+            source=SourceSpec.from_dict(d["source"]) if d.get("source") else None,
+        )
+
+
+# ---------------------------------------------------------------------------
+# NAS config
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NasOperation:
+    """reference experiment_types.go:283-288 (Operation)."""
+
+    operation_type: str
+    parameters: List[ParameterSpec] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "operationType": self.operation_type,
+            "parameters": [p.to_dict() for p in self.parameters],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NasOperation":
+        return cls(
+            operation_type=d["operationType"],
+            parameters=[ParameterSpec.from_dict(p) for p in d.get("parameters", [])],
+        )
+
+
+@dataclass
+class GraphConfig:
+    """reference experiment_types.go:272-281."""
+
+    num_layers: Optional[int] = None
+    input_sizes: Optional[List[int]] = None
+    output_sizes: Optional[List[int]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.num_layers is not None:
+            d["numLayers"] = self.num_layers
+        if self.input_sizes is not None:
+            d["inputSizes"] = list(self.input_sizes)
+        if self.output_sizes is not None:
+            d["outputSizes"] = list(self.output_sizes)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GraphConfig":
+        return cls(
+            num_layers=d.get("numLayers"),
+            input_sizes=d.get("inputSizes"),
+            output_sizes=d.get("outputSizes"),
+        )
+
+
+@dataclass
+class NasConfig:
+    """reference experiment_types.go:264-270."""
+
+    graph_config: GraphConfig = field(default_factory=GraphConfig)
+    operations: List[NasOperation] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "graphConfig": self.graph_config.to_dict(),
+            "operations": [o.to_dict() for o in self.operations],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NasConfig":
+        return cls(
+            graph_config=GraphConfig.from_dict(d.get("graphConfig", {})),
+            operations=[NasOperation.from_dict(o) for o in d.get("operations", [])],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Trial template (TPU-native replacement for unstructured K8s runSpec)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrialResources:
+    """TPU slice request for one trial — replaces K8s resource requests.
+
+    Katib delegates device placement to the trial CRD; here the scheduler
+    gang-allocates TPU devices directly (SURVEY.md §7 layer 4).
+    """
+
+    num_devices: int = 1          # TPU chips (or virtual CPU devices in tests)
+    num_hosts: int = 1            # multi-host slice width (DCN processes)
+    topology: Optional[str] = None  # e.g. "2x2" — informational
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"numDevices": self.num_devices, "numHosts": self.num_hosts}
+        if self.topology:
+            d["topology"] = self.topology
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialResources":
+        return cls(
+            num_devices=int(d.get("numDevices", 1)),
+            num_hosts=int(d.get("numHosts", 1)),
+            topology=d.get("topology"),
+        )
+
+
+@dataclass
+class TrialParameterSpec:
+    """reference experiment_types.go:310-324 (TrialParameterSpec): maps a
+    template placeholder name to a search-space parameter reference."""
+
+    name: str
+    description: str = ""
+    reference: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "description": self.description, "reference": self.reference}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialParameterSpec":
+        return cls(
+            name=d["name"],
+            description=d.get("description", ""),
+            reference=d.get("reference", ""),
+        )
+
+
+@dataclass
+class TrialTemplate:
+    """TPU-native trial template — reference experiment_types.go:247-308.
+
+    Exactly one of:
+    - ``command``: argv template; ``${trialParameters.X}`` placeholders are
+      substituted like manifest/generator.go:99-186. Runs as a subprocess.
+    - ``entry_point``: "module:function" resolved in-process; called as
+      fn(assignments_dict, trial_context). The TPU-native fast path (no
+      process-per-trial overhead; the function runs under the trial's device
+      mesh).
+    - ``function``: a Python callable (not serializable; in-memory experiments
+      and KatibClient.tune only).
+    """
+
+    command: Optional[List[str]] = None
+    entry_point: Optional[str] = None
+    function: Optional[Callable[..., Any]] = None
+    trial_parameters: List[TrialParameterSpec] = field(default_factory=list)
+    resources: TrialResources = field(default_factory=TrialResources)
+    retain: bool = False  # reference experiment_types.go Retain: keep logs/workdir
+    primary_container_name: str = "training-container"  # parity field
+    success_condition: str = ""   # reference experiment_types.go:300-308 (GJSON in ref)
+    failure_condition: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    working_dir: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "trialParameters": [p.to_dict() for p in self.trial_parameters],
+            "resources": self.resources.to_dict(),
+            "retain": self.retain,
+        }
+        if self.command is not None:
+            d["command"] = list(self.command)
+        if self.entry_point is not None:
+            d["entryPoint"] = self.entry_point
+        if self.env:
+            d["env"] = dict(self.env)
+        if self.working_dir:
+            d["workingDir"] = self.working_dir
+        if self.success_condition:
+            d["successCondition"] = self.success_condition
+        if self.failure_condition:
+            d["failureCondition"] = self.failure_condition
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialTemplate":
+        return cls(
+            command=d.get("command"),
+            entry_point=d.get("entryPoint"),
+            trial_parameters=[TrialParameterSpec.from_dict(p) for p in d.get("trialParameters", [])],
+            resources=TrialResources.from_dict(d.get("resources", {})),
+            retain=bool(d.get("retain", False)),
+            env=dict(d.get("env", {})),
+            working_dir=d.get("workingDir"),
+            success_condition=d.get("successCondition", ""),
+            failure_condition=d.get("failureCondition", ""),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Experiment spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExperimentSpec:
+    """reference experiment_types.go:26-77 (ExperimentSpec)."""
+
+    name: str = ""
+    parameters: List[ParameterSpec] = field(default_factory=list)
+    objective: ObjectiveSpec = field(default_factory=ObjectiveSpec)
+    algorithm: AlgorithmSpec = field(default_factory=AlgorithmSpec)
+    early_stopping: Optional[EarlyStoppingSpec] = None
+    trial_template: TrialTemplate = field(default_factory=TrialTemplate)
+    parallel_trial_count: Optional[int] = None
+    max_trial_count: Optional[int] = None
+    max_failed_trial_count: Optional[int] = None
+    metrics_collector_spec: MetricsCollectorSpec = field(default_factory=MetricsCollectorSpec)
+    nas_config: Optional[NasConfig] = None
+    resume_policy: ResumePolicy = ResumePolicy.NEVER
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "parameters": [p.to_dict() for p in self.parameters],
+            "objective": self.objective.to_dict(),
+            "algorithm": self.algorithm.to_dict(),
+            "trialTemplate": self.trial_template.to_dict(),
+            "metricsCollectorSpec": self.metrics_collector_spec.to_dict(),
+            "resumePolicy": self.resume_policy.value,
+        }
+        if self.early_stopping:
+            d["earlyStopping"] = self.early_stopping.to_dict()
+        if self.parallel_trial_count is not None:
+            d["parallelTrialCount"] = self.parallel_trial_count
+        if self.max_trial_count is not None:
+            d["maxTrialCount"] = self.max_trial_count
+        if self.max_failed_trial_count is not None:
+            d["maxFailedTrialCount"] = self.max_failed_trial_count
+        if self.nas_config:
+            d["nasConfig"] = self.nas_config.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ExperimentSpec":
+        mc = d.get("metricsCollectorSpec")
+        return cls(
+            name=d.get("name", ""),
+            parameters=[ParameterSpec.from_dict(p) for p in d.get("parameters", [])],
+            objective=ObjectiveSpec.from_dict(d.get("objective", {})),
+            algorithm=AlgorithmSpec.from_dict(d.get("algorithm", {})),
+            early_stopping=EarlyStoppingSpec.from_dict(d["earlyStopping"]) if d.get("earlyStopping") else None,
+            trial_template=TrialTemplate.from_dict(d.get("trialTemplate", {})),
+            parallel_trial_count=d.get("parallelTrialCount"),
+            max_trial_count=d.get("maxTrialCount"),
+            max_failed_trial_count=d.get("maxFailedTrialCount"),
+            metrics_collector_spec=(
+                MetricsCollectorSpec.from_dict(mc) if mc else MetricsCollectorSpec()
+            ),
+            nas_config=NasConfig.from_dict(d["nasConfig"]) if d.get("nasConfig") else None,
+            resume_policy=ResumePolicy(d.get("resumePolicy", "Never")),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ExperimentSpec":
+        return cls.from_dict(json.loads(s))
+
+
+# ---------------------------------------------------------------------------
+# Assignments / observations
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ParameterAssignment:
+    """reference trials CRD / api.proto ParameterAssignment."""
+
+    name: str
+    value: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"name": self.name, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ParameterAssignment":
+        return cls(name=d["name"], value=str(d["value"]))
+
+
+@dataclass
+class Metric:
+    """reference common_types.go Observation Metric: folded min/max/latest."""
+
+    name: str
+    min: str = "unavailable"
+    max: str = "unavailable"
+    latest: str = "unavailable"
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"name": self.name, "min": self.min, "max": self.max, "latest": self.latest}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Metric":
+        return cls(
+            name=d["name"],
+            min=str(d.get("min", "unavailable")),
+            max=str(d.get("max", "unavailable")),
+            latest=str(d.get("latest", "unavailable")),
+        )
+
+
+@dataclass
+class Observation:
+    metrics: List[Metric] = field(default_factory=list)
+
+    def metric(self, name: str) -> Optional[Metric]:
+        for m in self.metrics:
+            if m.name == name:
+                return m
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metrics": [m.to_dict() for m in self.metrics]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Observation":
+        return cls(metrics=[Metric.from_dict(m) for m in d.get("metrics", [])])
+
+
+# Sentinel used throughout, reference consts/const.go UnavailableMetricValue.
+UNAVAILABLE_METRIC_VALUE = "unavailable"
+
+
+@dataclass
+class TrialAssignment:
+    """reference suggestion_types.go:126-141 (TrialAssignment)."""
+
+    name: str
+    parameter_assignments: List[ParameterAssignment] = field(default_factory=list)
+    early_stopping_rules: List[EarlyStoppingRule] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def assignments_dict(self) -> Dict[str, str]:
+        return {a.name: a.value for a in self.parameter_assignments}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "parameterAssignments": [a.to_dict() for a in self.parameter_assignments],
+            "earlyStoppingRules": [r.to_dict() for r in self.early_stopping_rules],
+            "labels": dict(self.labels),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialAssignment":
+        return cls(
+            name=d["name"],
+            parameter_assignments=[ParameterAssignment.from_dict(a) for a in d.get("parameterAssignments", [])],
+            early_stopping_rules=[EarlyStoppingRule.from_dict(r) for r in d.get("earlyStoppingRules", [])],
+            labels=dict(d.get("labels", {})),
+        )

@@ -1,0 +1,396 @@
+"""Trial / Experiment / Suggestion runtime records and condition machinery.
+
+reference:
+- trial conditions: pkg/apis/controller/trials/v1beta1/trial_types.go:106-153
+  (Created/Running/Succeeded/Killed/Failed/MetricsUnavailable/EarlyStopped)
+- experiment conditions: pkg/apis/controller/experiments/v1beta1/experiment_types.go:96-177
+  (Created/Running/Restarting/Succeeded/Failed) + reason strings in
+  pkg/controller.v1beta1/experiment/util/status_util.go
+- suggestion status: pkg/apis/controller/suggestions/v1beta1/suggestion_types.go:44-124
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .spec import (
+    EarlyStoppingRule,
+    ExperimentSpec,
+    Observation,
+    ParameterAssignment,
+    TrialAssignment,
+)
+
+
+class TrialCondition(str, enum.Enum):
+    CREATED = "Created"
+    PENDING = "Pending"      # queued for a device slot (TPU-native addition)
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    KILLED = "Killed"
+    FAILED = "Failed"
+    METRICS_UNAVAILABLE = "MetricsUnavailable"
+    EARLY_STOPPED = "EarlyStopped"
+
+
+# Terminal conditions, mirroring trial util.go IsCompleted-style helpers.
+TRIAL_TERMINAL = {
+    TrialCondition.SUCCEEDED,
+    TrialCondition.KILLED,
+    TrialCondition.FAILED,
+    TrialCondition.METRICS_UNAVAILABLE,
+    TrialCondition.EARLY_STOPPED,
+}
+
+
+class ExperimentCondition(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class ExperimentReason(str, enum.Enum):
+    """Terminal reasons, reference status_util.go:187-235."""
+
+    NONE = ""
+    GOAL_REACHED = "ExperimentGoalReached"
+    MAX_TRIALS_REACHED = "ExperimentMaxTrialsReached"
+    MAX_FAILED_TRIALS_REACHED = "ExperimentMaxFailedTrialsReached"
+    SUGGESTION_END_REACHED = "ExperimentSuggestionEndReached"
+    SUGGESTION_FAILED = "ExperimentSuggestionFailed"
+    EXPERIMENT_FAILED = "ExperimentFailed"
+
+
+@dataclass
+class Condition:
+    """One entry in a condition history list (type/status/reason/message/times)."""
+
+    type: str
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": self.last_transition_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Condition":
+        return cls(
+            type=d["type"],
+            status=bool(d.get("status", True)),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=float(d.get("lastTransitionTime", 0.0)),
+        )
+
+
+def _update_conditions(conditions: List[Condition], new: Condition) -> None:
+    """Append-or-replace semantics like the reference's setCondition helpers:
+    the newest condition of a type wins; older different-type conditions get
+    status=False."""
+    for c in conditions:
+        if c.type == new.type:
+            c.status = new.status
+            c.reason = new.reason
+            c.message = new.message
+            c.last_transition_time = new.last_transition_time
+            break
+    else:
+        conditions.append(new)
+    for c in conditions:
+        if c.type != new.type:
+            c.status = False
+
+
+@dataclass
+class Trial:
+    """A single evaluation — merges the reference's Trial CRD spec+status.
+
+    reference trial_types.go:27-104.
+    """
+
+    name: str
+    experiment_name: str
+    parameter_assignments: List[ParameterAssignment] = field(default_factory=list)
+    early_stopping_rules: List[EarlyStoppingRule] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+
+    # status
+    condition: TrialCondition = TrialCondition.CREATED
+    conditions: List[Condition] = field(default_factory=list)
+    observation: Optional[Observation] = None
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    message: str = ""
+
+    def assignments_dict(self) -> Dict[str, str]:
+        return {a.name: a.value for a in self.parameter_assignments}
+
+    @property
+    def is_terminal(self) -> bool:
+        return self.condition in TRIAL_TERMINAL
+
+    @property
+    def is_succeeded(self) -> bool:
+        return self.condition == TrialCondition.SUCCEEDED
+
+    @property
+    def is_early_stopped(self) -> bool:
+        return self.condition == TrialCondition.EARLY_STOPPED
+
+    def set_condition(self, cond: TrialCondition, reason: str = "", message: str = "") -> None:
+        self.condition = cond
+        _update_conditions(self.conditions, Condition(type=cond.value, reason=reason, message=message))
+        if cond == TrialCondition.RUNNING and self.start_time is None:
+            self.start_time = time.time()
+        if cond in TRIAL_TERMINAL and self.completion_time is None:
+            self.completion_time = time.time()
+        if message:
+            self.message = message
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "experimentName": self.experiment_name,
+            "uid": self.uid,
+            "parameterAssignments": [a.to_dict() for a in self.parameter_assignments],
+            "earlyStoppingRules": [r.to_dict() for r in self.early_stopping_rules],
+            "labels": dict(self.labels),
+            "condition": self.condition.value,
+            "conditions": [c.to_dict() for c in self.conditions],
+            "observation": self.observation.to_dict() if self.observation else None,
+            "startTime": self.start_time,
+            "completionTime": self.completion_time,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Trial":
+        t = cls(
+            name=d["name"],
+            experiment_name=d.get("experimentName", ""),
+            parameter_assignments=[ParameterAssignment.from_dict(a) for a in d.get("parameterAssignments", [])],
+            early_stopping_rules=[EarlyStoppingRule.from_dict(r) for r in d.get("earlyStoppingRules", [])],
+            labels=dict(d.get("labels", {})),
+            uid=d.get("uid", uuid.uuid4().hex[:12]),
+        )
+        t.condition = TrialCondition(d.get("condition", "Created"))
+        t.conditions = [Condition.from_dict(c) for c in d.get("conditions", [])]
+        t.observation = Observation.from_dict(d["observation"]) if d.get("observation") else None
+        t.start_time = d.get("startTime")
+        t.completion_time = d.get("completionTime")
+        t.message = d.get("message", "")
+        return t
+
+    @classmethod
+    def from_assignment(cls, assignment: TrialAssignment, experiment_name: str) -> "Trial":
+        return cls(
+            name=assignment.name,
+            experiment_name=experiment_name,
+            parameter_assignments=list(assignment.parameter_assignments),
+            early_stopping_rules=list(assignment.early_stopping_rules),
+            labels=dict(assignment.labels),
+        )
+
+
+@dataclass
+class OptimalTrial:
+    """reference experiment_types.go:231-245 (OptimalTrial)."""
+
+    best_trial_name: str = ""
+    parameter_assignments: List[ParameterAssignment] = field(default_factory=list)
+    observation: Observation = field(default_factory=Observation)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "bestTrialName": self.best_trial_name,
+            "parameterAssignments": [a.to_dict() for a in self.parameter_assignments],
+            "observation": self.observation.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OptimalTrial":
+        return cls(
+            best_trial_name=d.get("bestTrialName", ""),
+            parameter_assignments=[ParameterAssignment.from_dict(a) for a in d.get("parameterAssignments", [])],
+            observation=Observation.from_dict(d.get("observation", {"metrics": []})),
+        )
+
+
+@dataclass
+class ExperimentStatus:
+    """reference experiment_types.go:79-177 (ExperimentStatus) with the 7-bucket
+    trial summary from status_util.go:56-151."""
+
+    condition: ExperimentCondition = ExperimentCondition.CREATED
+    conditions: List[Condition] = field(default_factory=list)
+    reason: ExperimentReason = ExperimentReason.NONE
+    message: str = ""
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+    trials: int = 0
+    trials_succeeded: int = 0
+    trials_failed: int = 0
+    trials_killed: int = 0
+    trials_pending: int = 0
+    trials_running: int = 0
+    trials_early_stopped: int = 0
+    trials_metrics_unavailable: int = 0
+
+    trial_names: List[str] = field(default_factory=list)
+    succeeded_trial_names: List[str] = field(default_factory=list)
+    failed_trial_names: List[str] = field(default_factory=list)
+    killed_trial_names: List[str] = field(default_factory=list)
+    pending_trial_names: List[str] = field(default_factory=list)
+    running_trial_names: List[str] = field(default_factory=list)
+    early_stopped_trial_names: List[str] = field(default_factory=list)
+    metrics_unavailable_trial_names: List[str] = field(default_factory=list)
+
+    current_optimal_trial: OptimalTrial = field(default_factory=OptimalTrial)
+
+    @property
+    def is_completed(self) -> bool:
+        return self.condition in (ExperimentCondition.SUCCEEDED, ExperimentCondition.FAILED)
+
+    @property
+    def is_succeeded(self) -> bool:
+        return self.condition == ExperimentCondition.SUCCEEDED
+
+    def set_condition(
+        self,
+        cond: ExperimentCondition,
+        reason: ExperimentReason = ExperimentReason.NONE,
+        message: str = "",
+    ) -> None:
+        self.condition = cond
+        self.reason = reason
+        self.message = message
+        _update_conditions(
+            self.conditions, Condition(type=cond.value, reason=reason.value, message=message)
+        )
+        if cond == ExperimentCondition.RUNNING and self.start_time is None:
+            self.start_time = time.time()
+        if self.is_completed and self.completion_time is None:
+            self.completion_time = time.time()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "condition": self.condition.value,
+            "conditions": [c.to_dict() for c in self.conditions],
+            "reason": self.reason.value,
+            "message": self.message,
+            "startTime": self.start_time,
+            "completionTime": self.completion_time,
+            "trials": self.trials,
+            "trialsSucceeded": self.trials_succeeded,
+            "trialsFailed": self.trials_failed,
+            "trialsKilled": self.trials_killed,
+            "trialsPending": self.trials_pending,
+            "trialsRunning": self.trials_running,
+            "trialsEarlyStopped": self.trials_early_stopped,
+            "trialsMetricsUnavailable": self.trials_metrics_unavailable,
+            "currentOptimalTrial": self.current_optimal_trial.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ExperimentStatus":
+        s = cls()
+        s.condition = ExperimentCondition(d.get("condition", "Created"))
+        s.conditions = [Condition.from_dict(c) for c in d.get("conditions", [])]
+        s.reason = ExperimentReason(d.get("reason", ""))
+        s.message = d.get("message", "")
+        s.start_time = d.get("startTime")
+        s.completion_time = d.get("completionTime")
+        s.trials = d.get("trials", 0)
+        s.trials_succeeded = d.get("trialsSucceeded", 0)
+        s.trials_failed = d.get("trialsFailed", 0)
+        s.trials_killed = d.get("trialsKilled", 0)
+        s.trials_pending = d.get("trialsPending", 0)
+        s.trials_running = d.get("trialsRunning", 0)
+        s.trials_early_stopped = d.get("trialsEarlyStopped", 0)
+        s.trials_metrics_unavailable = d.get("trialsMetricsUnavailable", 0)
+        s.current_optimal_trial = OptimalTrial.from_dict(d.get("currentOptimalTrial", {}))
+        return s
+
+
+@dataclass
+class Experiment:
+    """Spec + status pair — the unit held by the state store."""
+
+    spec: ExperimentSpec
+    status: ExperimentStatus = field(default_factory=ExperimentStatus)
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"spec": self.spec.to_dict(), "status": self.status.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Experiment":
+        return cls(
+            spec=ExperimentSpec.from_dict(d["spec"]),
+            status=ExperimentStatus.from_dict(d.get("status", {})),
+        )
+
+
+@dataclass
+class SuggestionState:
+    """Replaces the Suggestion CRD: demand counter vs produced assignments.
+
+    reference suggestion_types.go:29-150 — ``spec.Requests`` is demand set by
+    the experiment controller; ``status.Suggestions`` is supply appended by the
+    suggestion engine; the delta is the ``current_request_number`` passed to
+    the algorithm (suggestionclient.go:88-91).
+    """
+
+    experiment_name: str
+    algorithm_name: str
+    requests: int = 0
+    suggestions: List[TrialAssignment] = field(default_factory=list)
+    algorithm_settings: Dict[str, str] = field(default_factory=dict)
+    failed: bool = False
+    message: str = ""
+
+    @property
+    def suggestion_count(self) -> int:
+        return len(self.suggestions)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "experimentName": self.experiment_name,
+            "algorithmName": self.algorithm_name,
+            "requests": self.requests,
+            "suggestions": [s.to_dict() for s in self.suggestions],
+            "algorithmSettings": dict(self.algorithm_settings),
+            "failed": self.failed,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SuggestionState":
+        return cls(
+            experiment_name=d["experimentName"],
+            algorithm_name=d.get("algorithmName", ""),
+            requests=int(d.get("requests", 0)),
+            suggestions=[TrialAssignment.from_dict(s) for s in d.get("suggestions", [])],
+            algorithm_settings=dict(d.get("algorithmSettings", {})),
+            failed=bool(d.get("failed", False)),
+            message=d.get("message", ""),
+        )

@@ -1,0 +1,266 @@
+"""Experiment validation — mirrors the validating webhook.
+
+reference pkg/webhook/v1beta1/experiment/validator/validator.go:81-590.
+Errors are accumulated (field.ErrorList style) and raised as one
+ValidationError listing every problem.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from .spec import (
+    CollectorKind,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveType,
+    ParameterType,
+    ResumePolicy,
+)
+from .status import Experiment, ExperimentReason
+
+NAME_RE = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")
+
+# Template placeholder syntax, reference consts/const.go:130-148.
+TRIAL_PARAM_RE = re.compile(r"\$\{trialParameters\.([^}]+)\}")
+META_PARAM_RE = re.compile(r"\$\{trialSpec\.([^}]+)\}")
+META_KEYS = {"Name", "Namespace", "Kind", "APIVersion"}
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+def validate_experiment(
+    spec: ExperimentSpec,
+    old: Optional[Experiment] = None,
+    known_algorithms: Optional[set] = None,
+    known_early_stopping: Optional[set] = None,
+) -> None:
+    """Raise ValidationError unless the spec is valid.
+
+    Mirrors DefaultValidator.ValidateExperiment (validator.go:81-180); ``old``
+    enables the restart-edit rules (only budgets editable; restart only from a
+    restartable completed state — status_util.go:240-246).
+    """
+    errs: List[str] = []
+
+    if not NAME_RE.match(spec.name or ""):
+        errs.append(
+            f"name {spec.name!r} must consist of lower case alphanumeric characters or '-', "
+            "start with an alphabetic character, and end with an alphanumeric character"
+        )
+
+    if spec.max_failed_trial_count is not None and spec.max_failed_trial_count < 0:
+        errs.append("maxFailedTrialCount should not be less than 0")
+    if spec.max_trial_count is not None and spec.max_trial_count <= 0:
+        errs.append("maxTrialCount must be greater than 0")
+    if spec.parallel_trial_count is not None and spec.parallel_trial_count <= 0:
+        errs.append("parallelTrialCount must be greater than 0")
+    if (
+        spec.max_failed_trial_count is not None
+        and spec.max_trial_count is not None
+        and spec.max_failed_trial_count > spec.max_trial_count
+    ):
+        errs.append("maxFailedTrialCount should be less than or equal to maxTrialCount")
+    if (
+        spec.parallel_trial_count is not None
+        and spec.max_trial_count is not None
+        and spec.parallel_trial_count > spec.max_trial_count
+    ):
+        errs.append("parallelTrialCount should be less than or equal to maxTrialCount")
+
+    if old is not None:
+        _validate_restart(spec, old, errs)
+
+    _validate_objective(spec, errs)
+    _validate_algorithm(spec, known_algorithms, errs)
+    _validate_early_stopping(spec, known_early_stopping, errs)
+
+    if spec.resume_policy not in (ResumePolicy.NEVER, ResumePolicy.LONG_RUNNING, ResumePolicy.FROM_VOLUME):
+        errs.append(f"invalid resumePolicy {spec.resume_policy!r}")
+
+    _validate_trial_template(spec, errs)
+
+    if not spec.parameters and spec.nas_config is None:
+        errs.append("spec.parameters or spec.nasConfig must be specified")
+    if spec.parameters and spec.nas_config is not None:
+        errs.append("only one of spec.parameters and spec.nasConfig can be specified")
+    if spec.parameters:
+        _validate_parameters(spec.parameters, errs)
+
+    _validate_metrics_collector(spec, errs)
+
+    if errs:
+        raise ValidationError(errs)
+
+
+def _validate_restart(spec: ExperimentSpec, old: Experiment, errs: List[str]) -> None:
+    """reference validator.go:117-145 + status_util.go:240-246
+    (IsCompletedExperimentRestartable: only MaxTrialsReached with LongRunning or
+    FromVolume)."""
+    old_spec = old.spec
+    changed = spec.to_json() != old_spec.to_json()
+    if not changed:
+        return
+    if old.status.is_completed:
+        restartable = (
+            old.status.is_succeeded
+            and old.status.reason == ExperimentReason.MAX_TRIALS_REACHED
+            and old_spec.resume_policy in (ResumePolicy.LONG_RUNNING, ResumePolicy.FROM_VOLUME)
+        )
+        if not restartable:
+            errs.append(
+                "experiment can be restarted only if it succeeded by reaching max trials "
+                "and resumePolicy is LongRunning or FromVolume"
+            )
+    if spec.max_trial_count is not None and spec.max_trial_count <= old.status.trials:
+        errs.append("maxTrialCount must be greater than status.trials count")
+    # Only budgets are editable (validator.go:139-144).
+    a, b = spec.to_dict(), old_spec.to_dict()
+    for k in ("maxTrialCount", "maxFailedTrialCount", "parallelTrialCount"):
+        a.pop(k, None)
+        b.pop(k, None)
+    if a != b:
+        errs.append("only parallelTrialCount, maxTrialCount and maxFailedTrialCount are editable")
+
+
+def _validate_objective(spec: ExperimentSpec, errs: List[str]) -> None:
+    obj = spec.objective
+    if obj.type not in (ObjectiveType.MINIMIZE, ObjectiveType.MAXIMIZE):
+        errs.append("objective.type must be minimize or maximize")
+    if not obj.objective_metric_name:
+        errs.append("objective.objectiveMetricName must be specified")
+    if obj.objective_metric_name in obj.additional_metric_names:
+        errs.append("objective.additionalMetricNames should not contain objectiveMetricName")
+
+
+def _validate_algorithm(spec: ExperimentSpec, known: Optional[set], errs: List[str]) -> None:
+    if not spec.algorithm.algorithm_name:
+        errs.append("algorithm.algorithmName must be specified")
+        return
+    if known is not None and spec.algorithm.algorithm_name not in known:
+        errs.append(f"unknown algorithm {spec.algorithm.algorithm_name!r} (registered: {sorted(known)})")
+
+
+def _validate_early_stopping(spec: ExperimentSpec, known: Optional[set], errs: List[str]) -> None:
+    es = spec.early_stopping
+    if es is None:
+        return
+    if not es.algorithm_name:
+        errs.append("earlyStopping.algorithmName must be specified")
+        return
+    if known is not None and es.algorithm_name not in known:
+        errs.append(f"unknown early-stopping algorithm {es.algorithm_name!r}")
+
+
+def _validate_parameters(parameters, errs: List[str]) -> None:
+    """reference validator.go:254-291."""
+    seen = set()
+    for i, p in enumerate(parameters):
+        if p.name in seen:
+            errs.append(f"parameters[{i}]: duplicate parameter name {p.name!r}")
+        seen.add(p.name)
+        fs = p.feasible_space
+        if fs == FeasibleSpace():
+            errs.append(f"parameters[{i}].feasibleSpace must be specified")
+            continue
+        if p.parameter_type in (ParameterType.DOUBLE, ParameterType.INT):
+            if fs.list:
+                errs.append(
+                    f"parameters[{i}]: feasibleSpace.list is not supported for parameterType {p.parameter_type.value}"
+                )
+            if not fs.max and not fs.min:
+                errs.append(
+                    f"parameters[{i}]: feasibleSpace.max or feasibleSpace.min must be specified "
+                    f"for parameterType {p.parameter_type.value}"
+                )
+        elif p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            if fs.max or fs.min or fs.step:
+                errs.append(
+                    f"parameters[{i}]: feasibleSpace .max, .min and .step are not supported "
+                    f"for parameterType {p.parameter_type.value}"
+                )
+            if not fs.list:
+                errs.append(f"parameters[{i}]: feasibleSpace.list must be specified")
+        else:
+            errs.append(f"parameters[{i}]: parameterType {p.parameter_type.value!r} is not supported")
+
+
+def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
+    """reference validator.go:293-426: the template must reference every trial
+    parameter, every trial parameter must resolve to a search-space parameter
+    (or meta key), and no placeholder may be dangling."""
+    t = spec.trial_template
+    sources = [t.command is not None, t.entry_point is not None, t.function is not None]
+    if sum(sources) == 0:
+        errs.append("trialTemplate must define one of command, entryPoint or function")
+        return
+    if sum(sources) > 1:
+        errs.append("trialTemplate must define exactly one of command, entryPoint or function")
+        return
+
+    # Trial parameter names must be unique; references must exist in the search
+    # space (or be NAS outputs / meta keys).
+    search_params = {p.name for p in spec.parameters}
+    if spec.nas_config is not None:
+        # NAS suggestions emit architecture + nn_config assignments
+        # (reference enas/service.py emits these names; darts emits
+        # algorithm-settings/search-space/num-layers).
+        search_params |= {
+            "architecture",
+            "nn_config",
+            "algorithm-settings",
+            "search-space",
+            "num-layers",
+        }
+    tp_names = set()
+    for tp in t.trial_parameters:
+        if tp.name in tp_names:
+            errs.append(f"trialParameters: duplicate name {tp.name!r}")
+        tp_names.add(tp.name)
+        if not tp.reference:
+            errs.append(f"trialParameters[{tp.name}]: reference must be specified")
+        elif tp.reference not in search_params and not _is_meta_key(tp.reference):
+            errs.append(
+                f"trialParameters[{tp.name}]: reference {tp.reference!r} not found in search space"
+            )
+
+    if t.command is not None:
+        text = "\n".join(t.command)
+        used = set(TRIAL_PARAM_RE.findall(text))
+        for name in used - tp_names:
+            errs.append(f"template placeholder ${{trialParameters.{name}}} has no trialParameters entry")
+        for name in tp_names - used:
+            errs.append(f"trialParameters[{name}] is not used in the template")
+        for meta in META_PARAM_RE.findall(text):
+            base = meta.split("[", 1)[0]
+            if base not in META_KEYS and not meta.startswith(("Annotations[", "Labels[")):
+                errs.append(f"unknown trialSpec meta placeholder ${{trialSpec.{meta}}}")
+
+
+def _is_meta_key(reference: str) -> bool:
+    """reference validator.go:564-581 (isMetaKey)."""
+    if reference in {f"${{trialSpec.{k}}}" for k in META_KEYS}:
+        return True
+    return bool(re.match(r"^\$\{trialSpec\.(Annotations|Labels)\[[^\]]+\]\}$", reference))
+
+
+def _validate_metrics_collector(spec: ExperimentSpec, errs: List[str]) -> None:
+    """reference validator.go:475-562 (subset without K8s container checks)."""
+    mc = spec.metrics_collector_spec
+    if mc.collector_kind in (CollectorKind.FILE, CollectorKind.TF_EVENT):
+        if mc.source is None or not mc.source.file_path:
+            errs.append(f"metricsCollector kind {mc.collector_kind.value} requires source.filePath")
+    if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.filter:
+        for f in mc.source.filter.metrics_format:
+            try:
+                ngroups = re.compile(f).groups
+            except re.error:
+                errs.append(f"metricsCollector filter {f!r} is not a valid regex")
+                continue
+            if ngroups != 2:
+                errs.append(f"metricsCollector filter {f!r} must have exactly 2 capture groups")

@@ -1,0 +1,314 @@
+"""Trial executors — run one trial to completion.
+
+Replaces the reference's trial-job execution plane (trial controller creating
+K8s jobs + webhook-injected metrics sidecar, SURVEY.md §3.3) with two direct
+execution paths:
+
+- InProcessExecutor: resolves the trial template's entry point / function and
+  calls it under the trial's device allocation. The TPU-native fast path — no
+  pod/process startup, metrics are pushed straight into the store, and the
+  early-stopping monitor raises inside the training loop.
+- SubprocessExecutor: renders the command template
+  (``${trialParameters.X}`` substitution — manifest/generator.go:99-186),
+  spawns the process with the metrics env binding, tails its stdout applying
+  early-stopping rules exactly like the reference sidecar (kill on trip), and
+  parses TEXT/JSON metric lines into the store on completion
+  (file-metricscollector semantics).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import signal
+import subprocess
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..api.spec import CollectorKind, ExperimentSpec, TrialTemplate
+from ..api.status import Experiment, Trial
+from ..db.store import MetricLog, ObservationStore
+from ..runtime.context import TrialContext
+from ..runtime.metrics import (
+    ENV_DB_PATH,
+    ENV_METRICS_FILE,
+    ENV_TRIAL_NAME,
+    EarlyStopped,
+    EarlyStoppingMonitor,
+    MetricsReporter,
+    parse_json_lines,
+    parse_text_lines,
+    set_current_reporter,
+)
+
+# placeholder grammar is shared with spec validation so the two can't drift
+from ..api.validation import META_PARAM_RE as META_RE, TRIAL_PARAM_RE
+
+
+class TrialOutcome(str, Enum):
+    COMPLETED = "completed"       # process/function finished cleanly
+    EARLY_STOPPED = "early_stopped"
+    FAILED = "failed"
+    KILLED = "killed"
+
+
+@dataclass
+class ExecutionResult:
+    outcome: TrialOutcome
+    message: str = ""
+
+
+def render_command(template: TrialTemplate, trial: Trial) -> List[str]:
+    """Placeholder substitution, mirroring applyParameters
+    (manifest/generator.go:99-186): ${trialParameters.X} resolves through the
+    trialParameters reference list to the assignment value; ${trialSpec.*}
+    meta placeholders resolve to trial metadata."""
+    assignments = trial.assignments_dict()
+    ref_by_name = {tp.name: tp.reference for tp in template.trial_parameters}
+
+    def sub_param(m: re.Match) -> str:
+        name = m.group(1)
+        ref = ref_by_name.get(name, name)
+        if ref in assignments:
+            return assignments[ref]
+        if name in assignments:
+            return assignments[name]
+        raise KeyError(f"unresolved trial parameter placeholder {name!r}")
+
+    def sub_meta(m: re.Match) -> str:
+        key = m.group(1)
+        if key == "Name":
+            return trial.name
+        if key == "Namespace":
+            return trial.experiment_name
+        if key.startswith("Labels["):
+            return trial.labels.get(key[len("Labels[") : -1], "")
+        if key.startswith("Annotations["):
+            return ""
+        return ""
+
+    out = []
+    for arg in template.command or []:
+        arg = TRIAL_PARAM_RE.sub(sub_param, arg)
+        arg = META_RE.sub(sub_meta, arg)
+        out.append(arg)
+    return out
+
+
+def resolve_entry_point(template: TrialTemplate) -> Callable[..., Any]:
+    if template.function is not None:
+        return template.function
+    assert template.entry_point is not None
+    mod_name, _, fn_name = template.entry_point.partition(":")
+    if not fn_name:
+        raise ValueError(f"entryPoint {template.entry_point!r} must be 'module:function'")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+class TrialExecution:
+    """Handle for one running trial; kill() requests termination."""
+
+    def __init__(self) -> None:
+        self._kill_requested = threading.Event()
+
+    def kill(self) -> None:
+        self._kill_requested.set()
+
+    @property
+    def kill_requested(self) -> bool:
+        return self._kill_requested.is_set()
+
+
+class InProcessExecutor:
+    def __init__(self, obs_store: ObservationStore):
+        self.obs_store = obs_store
+
+    def execute(
+        self, exp: Experiment, trial: Trial, ctx: TrialContext, handle: TrialExecution
+    ) -> ExecutionResult:
+        fn = resolve_entry_point(exp.spec.trial_template)
+        token = set_current_reporter(ctx.reporter)
+        try:
+            result = fn(ctx.assignments, ctx)
+            # convenience: a returned dict of floats is auto-reported
+            if isinstance(result, dict):
+                numeric = {
+                    k: v for k, v in result.items() if isinstance(v, (int, float))
+                }
+                if numeric:
+                    ctx.reporter.report(**numeric)
+            if ctx.reporter.stopped:
+                return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+            if handle.kill_requested:
+                return ExecutionResult(TrialOutcome.KILLED, "kill requested")
+            return ExecutionResult(TrialOutcome.COMPLETED)
+        except EarlyStopped:
+            return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+        except Exception:
+            return ExecutionResult(TrialOutcome.FAILED, traceback.format_exc(limit=10))
+        finally:
+            from ..runtime import metrics as _m
+
+            _m._current_reporter.reset(token)
+
+
+class SubprocessExecutor:
+    POLL_INTERVAL = 0.1
+
+    def __init__(self, obs_store: ObservationStore, db_path: Optional[str] = None):
+        self.obs_store = obs_store
+        self.db_path = db_path  # lets subprocesses push via env binding
+
+    def execute(
+        self, exp: Experiment, trial: Trial, ctx: TrialContext, handle: TrialExecution
+    ) -> ExecutionResult:
+        spec = exp.spec
+        cmd = render_command(spec.trial_template, trial)
+        workdir = ctx.workdir or os.getcwd()
+        os.makedirs(workdir, exist_ok=True)
+        stdout_path = os.path.join(workdir, "stdout.log")
+
+        env = dict(os.environ)
+        env.update(spec.trial_template.env)
+        env[ENV_TRIAL_NAME] = trial.name
+        if self.db_path:
+            env[ENV_DB_PATH] = self.db_path
+        metrics_file = None
+        mc = spec.metrics_collector_spec
+        if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.file_path:
+            metrics_file = mc.source.file_path
+            if not os.path.isabs(metrics_file):
+                metrics_file = os.path.join(workdir, metrics_file)
+            env[ENV_METRICS_FILE] = metrics_file
+
+        monitor = None
+        if trial.early_stopping_rules:
+            monitor = EarlyStoppingMonitor(
+                trial.early_stopping_rules,
+                spec.objective.objective_metric_name,
+                spec.objective.type,
+            )
+
+        with open(stdout_path, "wb") as out:
+            proc = subprocess.Popen(
+                cmd,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=spec.trial_template.working_dir or workdir,
+                start_new_session=True,
+            )
+            outcome = self._wait(proc, stdout_path, metrics_file, monitor, spec, handle)
+
+        # Collect metrics from the produced output (sidecar CollectObservationLog).
+        self._collect(trial, stdout_path, metrics_file, spec)
+
+        if outcome is not None:
+            return outcome
+        if proc.returncode == 0:
+            return ExecutionResult(TrialOutcome.COMPLETED)
+        return ExecutionResult(
+            TrialOutcome.FAILED, f"process exited with code {proc.returncode}"
+        )
+
+    def _wait(
+        self,
+        proc: subprocess.Popen,
+        stdout_path: str,
+        metrics_file: Optional[str],
+        monitor: Optional[EarlyStoppingMonitor],
+        spec: ExperimentSpec,
+        handle: TrialExecution,
+    ) -> Optional[ExecutionResult]:
+        """Poll for exit; tail output applying stop rules (the reference
+        sidecar's watchMetricsFile loop)."""
+        watch_path = metrics_file or stdout_path
+        offset = 0
+        buffered = ""
+        while True:
+            if handle.kill_requested:
+                self._terminate(proc)
+                return ExecutionResult(TrialOutcome.KILLED, "kill requested")
+            rc = proc.poll()
+            if monitor is not None and os.path.exists(watch_path):
+                with open(watch_path, "r", errors="replace") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                    offset = f.tell()
+                if chunk:
+                    buffered += chunk
+                    lines = buffered.split("\n")
+                    buffered = lines.pop()  # keep partial line
+                    for line in lines:
+                        for log in self._parse_line(line, spec):
+                            try:
+                                value = float(log.value)
+                            except ValueError:
+                                continue  # skip unparseable values like fold_observation
+                            if monitor.observe(log.metric_name, value):
+                                self._terminate(proc)
+                                return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+            if rc is not None:
+                return None
+            time.sleep(self.POLL_INTERVAL)
+
+    def _parse_line(self, line: str, spec: ExperimentSpec) -> List[MetricLog]:
+        names = spec.objective.all_metric_names()
+        mc = spec.metrics_collector_spec
+        filters = None
+        if mc.source and mc.source.filter:
+            filters = mc.source.filter.metrics_format
+        if mc.source and mc.source.file_format == "JSON":
+            return parse_json_lines([line], names)
+        return parse_text_lines([line], names, filters)
+
+    @staticmethod
+    def _terminate(proc: subprocess.Popen) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait(timeout=5)
+
+    def _collect(
+        self,
+        trial: Trial,
+        stdout_path: str,
+        metrics_file: Optional[str],
+        spec: ExperimentSpec,
+    ) -> None:
+        mc = spec.metrics_collector_spec
+        kind = mc.collector_kind
+        if kind in (CollectorKind.NONE, CollectorKind.PUSH):
+            return  # trial pushed directly (or reports nothing)
+        path = stdout_path
+        if kind == CollectorKind.FILE and metrics_file:
+            path = metrics_file
+        if not os.path.exists(path):
+            return
+        with open(path, "r", errors="replace") as f:
+            lines = f.read().splitlines()
+        names = spec.objective.all_metric_names()
+        filters = None
+        if mc.source and mc.source.filter:
+            filters = mc.source.filter.metrics_format
+        base = trial.start_time or time.time()
+        if mc.source and mc.source.file_format == "JSON":
+            logs = parse_json_lines(lines, names, base_time=base)
+        else:
+            logs = parse_text_lines(lines, names, filters, base_time=base)
+        if logs:
+            self.obs_store.report_observation_log(trial.name, logs)

@@ -1,0 +1,263 @@
+"""Experiment controller — the orchestration core.
+
+reference pkg/controller.v1beta1/experiment/experiment_controller.go. The
+reconcile loop is preserved (status aggregation -> budget math -> suggestion
+sync -> trial creation) but driven by trial-completion events from the
+scheduler instead of K8s watches:
+
+- budget: addCount = min(parallelTrialCount, maxTrialCount - completed)
+  - active (ReconcileTrials, experiment_controller.go:274-330);
+- parallel shrink deletes newest active trials first (deleteTrials :362-442);
+- incomplete early-stopped trials are excluded from new suggestion requests
+  (ReconcileSuggestions :449-461);
+- suggestion failure fails the experiment (:470-473);
+- resume/restart: budgets may be raised on a restartable completed experiment
+  (IsCompletedExperimentRestartable) and the loop continues.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import time
+from typing import Any, List, Optional, Sequence
+
+from ..api.defaults import set_defaults
+from ..api.spec import ExperimentSpec, ResumePolicy, UNAVAILABLE_METRIC_VALUE
+from ..api.status import (
+    Experiment,
+    ExperimentCondition,
+    ExperimentReason,
+    Trial,
+    TrialCondition,
+)
+from ..api.validation import validate_experiment
+from ..db.state import ExperimentStateStore
+from ..db.store import ObservationStore, open_store
+from ..earlystop.medianstop import registered_early_stoppers
+from ..suggest.base import registered_algorithms
+from .scheduler import TrialScheduler
+from .status import is_completed_experiment_restartable, update_experiment_status
+from .suggestion import SuggestionFailed, SuggestionService
+
+log = logging.getLogger("katib_tpu.experiment")
+
+
+class ExperimentController:
+    """Single-process orchestrator owning state, scheduler and suggestions.
+
+    Replaces cmd/katib-controller (manager + 3 controllers + webhooks).
+    """
+
+    def __init__(
+        self,
+        root_dir: Optional[str] = None,
+        devices: Optional[Sequence[Any]] = None,
+        persist: bool = True,
+    ):
+        self.root_dir = root_dir
+        state_root = os.path.join(root_dir, "state") if (root_dir and persist) else None
+        db_path = os.path.join(root_dir, "observations.db") if root_dir else None
+        self.state = ExperimentStateStore(state_root)
+        self.obs_store: ObservationStore = open_store(db_path)
+        self.db_path = db_path
+        self.suggestions = SuggestionService(self.state, self.obs_store)
+        workdir_root = os.path.join(root_dir, "trials") if root_dir else None
+        self.scheduler = TrialScheduler(
+            self.state,
+            self.obs_store,
+            devices=devices,
+            db_path=db_path,
+            workdir_root=workdir_root,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def create_experiment(self, spec: ExperimentSpec) -> Experiment:
+        """Defaulting + validation webhooks, then experiment creation
+        (SURVEY.md §3.1)."""
+        set_defaults(spec)
+        validate_experiment(
+            spec,
+            known_algorithms=registered_algorithms(),
+            known_early_stopping=registered_early_stoppers(),
+        )
+        exp = Experiment(spec=spec)
+        exp.status.set_condition(
+            ExperimentCondition.CREATED, ExperimentReason.NONE, "Experiment is created"
+        )
+        self.suggestions.forget(spec.name)  # stale state from a deleted namesake
+        self.state.create_experiment(exp)
+        # Algorithm/early-stopping settings dry-run (validator.go:203-238 +
+        # suggestion_controller.go:256-271). Done at admission like the
+        # reference's validating webhook.
+        self.suggestions.validate(exp)
+        return exp
+
+    def edit_experiment_budget(
+        self,
+        name: str,
+        max_trial_count: Optional[int] = None,
+        parallel_trial_count: Optional[int] = None,
+        max_failed_trial_count: Optional[int] = None,
+    ) -> Experiment:
+        """Budget edit / restart — the only legal spec mutation
+        (validator.go:139-144; SDK edit_experiment_budget)."""
+        exp = self.state.get_experiment(name)
+        if exp is None:
+            raise KeyError(f"experiment {name!r} not found")
+        new_spec = ExperimentSpec.from_json(exp.spec.to_json())
+        new_spec.trial_template.function = exp.spec.trial_template.function
+        if max_trial_count is not None:
+            new_spec.max_trial_count = max_trial_count
+        if parallel_trial_count is not None:
+            new_spec.parallel_trial_count = parallel_trial_count
+        if max_failed_trial_count is not None:
+            new_spec.max_failed_trial_count = max_failed_trial_count
+        validate_experiment(new_spec, old=exp, known_algorithms=registered_algorithms())
+        exp.spec = new_spec
+        if exp.status.is_completed and is_completed_experiment_restartable(exp):
+            # Restarting condition (experiment_controller.go:187-206)
+            exp.status.set_condition(
+                ExperimentCondition.RESTARTING, ExperimentReason.NONE, "Experiment is restarted"
+            )
+            exp.status.completion_time = None
+        self.state.update_experiment(exp)
+        return exp
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, name: str) -> Experiment:
+        """One reconcile pass (experiment_controller.go:156-247)."""
+        exp = self.state.get_experiment(name)
+        if exp is None:
+            raise KeyError(f"experiment {name!r} not found")
+        trials = self.state.list_trials(name)
+        update_experiment_status(exp, trials, self.suggestions.search_ended(name))
+        if not exp.status.is_completed:
+            try:
+                self._reconcile_trials(exp, trials)
+            except SuggestionFailed as e:
+                exp.status.set_condition(
+                    ExperimentCondition.FAILED,
+                    ExperimentReason.SUGGESTION_FAILED,
+                    str(e),
+                )
+        if exp.status.is_completed:
+            self._on_completed(exp)
+        self.state.update_experiment(exp)
+        return exp
+
+    def _reconcile_trials(self, exp: Experiment, trials: List[Trial]) -> None:
+        sts = exp.status
+        parallel = exp.spec.parallel_trial_count or 1
+        active = sts.trials_pending + sts.trials_running
+        completed = (
+            sts.trials_succeeded + sts.trials_failed + sts.trials_killed + sts.trials_early_stopped
+        )
+
+        if active > parallel:
+            self._delete_trials(exp, trials, active - parallel)
+            return
+        if active >= parallel:
+            return
+        if exp.spec.max_trial_count is None:
+            required_active = parallel
+        else:
+            required_active = min(exp.spec.max_trial_count - completed, parallel)
+        add_count = required_active - active
+        if add_count <= 0:
+            return
+
+        # Exclude incomplete early-stopped trials from the request total
+        # (experiment_controller.go:449-461).
+        incomplete_es = sum(
+            1
+            for t in trials
+            if t.condition == TrialCondition.EARLY_STOPPED and not self._observation_available(exp, t)
+        )
+        requests = len(trials) + add_count - incomplete_es
+
+        assignments = self.suggestions.sync_assignments(exp, trials, requests)
+        for assignment in assignments[:add_count]:
+            trial = Trial.from_assignment(assignment, exp.name)
+            trial.labels["katib-tpu/experiment"] = exp.name
+            self.state.create_trial(trial)
+            checkpoint_dir = self._checkpoint_dir_for(exp, trial)
+            self.scheduler.submit(exp, trial, checkpoint_dir=checkpoint_dir)
+
+    @staticmethod
+    def _observation_available(exp: Experiment, trial: Trial) -> bool:
+        if trial.observation is None:
+            return False
+        m = trial.observation.metric(exp.spec.objective.objective_metric_name)
+        return m is not None and m.latest != UNAVAILABLE_METRIC_VALUE
+
+    def _checkpoint_dir_for(self, exp: Experiment, trial: Trial) -> Optional[str]:
+        """PBT trials get their lineage directory (the suggestion-PVC mount,
+        inject_webhook.go:334+)."""
+        suggester = self.suggestions._suggesters.get(exp.name)
+        if suggester is not None and hasattr(suggester, "checkpoint_dir"):
+            try:
+                return suggester.checkpoint_dir(trial.name)
+            except Exception:
+                return None
+        return None
+
+    def _delete_trials(self, exp: Experiment, trials: List[Trial], count: int) -> None:
+        """Parallel-shrink: kill newest active trials (deleteTrials :362-442)."""
+        active = [
+            t
+            for t in trials
+            if t.condition in (TrialCondition.PENDING, TrialCondition.RUNNING, TrialCondition.CREATED)
+        ]
+        active.sort(key=lambda t: t.start_time or float("inf"), reverse=True)
+        suggestion = self.state.get_suggestion(exp.name)
+        doomed = active[:count]
+        for t in doomed:
+            self.scheduler.kill(t.name)
+        if suggestion is not None:
+            names = {t.name for t in doomed}
+            suggestion.suggestions = [a for a in suggestion.suggestions if a.name not in names]
+            suggestion.requests = len(suggestion.suggestions)
+            self.state.put_suggestion(suggestion)
+
+    def _on_completed(self, exp: Experiment) -> None:
+        self.suggestions.cleanup(exp)
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, name: str, timeout: Optional[float] = None, poll_interval: float = 0.5) -> Experiment:
+        """Drive the experiment to completion (replaces the controller-runtime
+        event loop; wakes on scheduler events instead of requeues)."""
+        deadline = None if timeout is None else time.time() + timeout
+        exp = self.reconcile(name)
+        while not exp.status.is_completed:
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"experiment {name!r} did not complete in {timeout}s")
+            try:
+                self.scheduler.events.get(timeout=poll_interval)
+            except queue.Empty:
+                pass
+            exp = self.reconcile(name)
+        # drain this experiment's still-running trials (goal-reached leaves
+        # stragglers); other experiments sharing the controller are untouched
+        for t in self.state.list_trials(name):
+            if not t.is_terminal:
+                self.scheduler.kill(t.name)
+        return exp
+
+    def delete_experiment(self, name: str) -> None:
+        """Delete an experiment and all its state (kubectl delete experiment)."""
+        for t in self.state.list_trials(name):
+            if not t.is_terminal:
+                self.scheduler.kill(t.name)
+            self.obs_store.delete_observation_log(t.name)
+        self.suggestions.forget(name)
+        self.state.delete_experiment(name)
+
+    def close(self) -> None:
+        self.scheduler.kill_all()
+        self.scheduler.join(timeout=10)
+        self.obs_store.close()

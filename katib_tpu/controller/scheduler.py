@@ -1,0 +1,258 @@
+"""Trial scheduler — gang device allocation + trial lifecycle supervision.
+
+TPU-native replacement for the reference's trial controller + kube-scheduler
+pair (pkg/controller.v1beta1/trial/trial_controller.go): instead of creating
+K8s jobs and mapping their conditions back via GJSON, the scheduler
+
+- gang-allocates devices: a trial asks for ``resources.num_devices`` TPU
+  chips and is dispatched only when that many are free (all-or-nothing, like
+  a gang-scheduled JAXJob; SURVEY.md §7 layer 4);
+- runs the trial via an executor on a worker thread;
+- on completion folds the observation log into the trial record
+  (UpdateTrialStatusObservation, trial_controller_util.go:124-217) and applies
+  the success/failure/metrics-unavailable classification
+  (trial_controller_util.go:42-122);
+- pushes a completion event that wakes the experiment controller — replacing
+  K8s watch events and the 1-second metrics requeue
+  (trial_controller.go:182-185) with direct event delivery.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..api.spec import CollectorKind, UNAVAILABLE_METRIC_VALUE
+from ..api.status import Experiment, Trial, TrialCondition
+from ..db.state import ExperimentStateStore
+from ..db.store import ObservationStore, fold_observation
+from ..runtime.context import TrialContext
+from ..runtime.metrics import EarlyStoppingMonitor, MetricsReporter
+from .executor import (
+    ExecutionResult,
+    InProcessExecutor,
+    SubprocessExecutor,
+    TrialExecution,
+    TrialOutcome,
+)
+
+log = logging.getLogger("katib_tpu.scheduler")
+
+
+@dataclass
+class TrialEvent:
+    experiment_name: str
+    trial_name: str
+    condition: TrialCondition
+
+
+class DeviceAllocator:
+    """All-or-nothing chip allocator over a fixed device list."""
+
+    def __init__(self, devices: Sequence[Any]):
+        self._lock = threading.Lock()
+        self._free: List[Any] = list(devices)
+        self.total = len(self._free)
+
+    def acquire(self, n: int) -> Optional[List[Any]]:
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken, self._free = self._free[:n], self._free[n:]
+            return taken
+
+    def release(self, devices: Sequence[Any]) -> None:
+        with self._lock:
+            self._free.extend(devices)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+class TrialScheduler:
+    def __init__(
+        self,
+        state: ExperimentStateStore,
+        obs_store: ObservationStore,
+        devices: Optional[Sequence[Any]] = None,
+        db_path: Optional[str] = None,
+        workdir_root: Optional[str] = None,
+    ):
+        if devices is None:
+            devices = list(range(8))  # abstract slots when JAX not involved
+        self.allocator = DeviceAllocator(devices)
+        self.state = state
+        self.obs_store = obs_store
+        self.events: "queue.Queue[TrialEvent]" = queue.Queue()
+        self.workdir_root = workdir_root
+        self._in_process = InProcessExecutor(obs_store)
+        self._subprocess = SubprocessExecutor(obs_store, db_path=db_path)
+        self._handles: Dict[str, TrialExecution] = {}
+        self._pending: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._waiting: List = []  # trials waiting for devices
+        self._threads: List[threading.Thread] = []
+        self._checkpoint_dirs: Dict[str, str] = {}
+        self._shutdown = threading.Event()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, exp: Experiment, trial: Trial, checkpoint_dir: Optional[str] = None) -> None:
+        trial.set_condition(TrialCondition.PENDING, "TrialPending", "waiting for devices")
+        self.state.update_trial(trial)
+        if checkpoint_dir:
+            self._checkpoint_dirs[trial.name] = checkpoint_dir
+        with self._lock:
+            self._waiting.append((exp, trial))
+        self._dispatch()
+
+    def kill(self, trial_name: str) -> None:
+        """Early-stop / parallel-shrink kill (reference deleteTrials)."""
+        with self._lock:
+            for i, (exp, t) in enumerate(self._waiting):
+                if t.name == trial_name:
+                    self._waiting.pop(i)
+                    self._checkpoint_dirs.pop(trial_name, None)
+                    t.set_condition(TrialCondition.KILLED, "TrialKilled", "killed while pending")
+                    self.state.update_trial(t)
+                    self.events.put(TrialEvent(exp.name, t.name, t.condition))
+                    return
+        h = self._handles.get(trial_name)
+        if h is not None:
+            h.kill()
+
+    def kill_all(self) -> None:
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        for exp, t in waiting:
+            t.set_condition(TrialCondition.KILLED, "TrialKilled", "scheduler shutdown")
+            self.state.update_trial(t)
+        for h in list(self._handles.values()):
+            h.kill()
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._waiting) + len(self._handles)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        for t in list(self._threads):
+            remaining = None if deadline is None else max(0.0, deadline - time.time())
+            t.join(timeout=remaining)
+
+    # -- dispatch loop -------------------------------------------------------
+
+    def _dispatch(self) -> None:
+        """Start every waiting trial whose gang allocation fits."""
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+            still_waiting = []
+            for exp, trial in self._waiting:
+                n = max(exp.spec.trial_template.resources.num_devices, 1)
+                n = min(n, self.allocator.total)  # clamp to the machine
+                devices = self.allocator.acquire(n)
+                if devices is None:
+                    still_waiting.append((exp, trial))
+                    continue
+                handle = TrialExecution()
+                self._handles[trial.name] = handle
+                th = threading.Thread(
+                    target=self._run_trial,
+                    args=(exp, trial, devices, handle),
+                    name=f"trial-{trial.name}",
+                    daemon=True,
+                )
+                self._threads.append(th)
+                th.start()
+            self._waiting = still_waiting
+
+    def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
+        try:
+            trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "Trial is running")
+            self.state.update_trial(trial)
+
+            ctx = self._build_context(exp, trial, devices)
+            spec = exp.spec
+            if spec.trial_template.command is not None:
+                result = self._subprocess.execute(exp, trial, ctx, handle)
+            else:
+                result = self._in_process.execute(exp, trial, ctx, handle)
+
+            self._finalize(exp, trial, result)
+        except Exception:
+            trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
+            self.state.update_trial(trial)
+        finally:
+            self.allocator.release(devices)
+            self._handles.pop(trial.name, None)
+            self._checkpoint_dirs.pop(trial.name, None)
+            self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
+            self._dispatch()
+
+    def _build_context(self, exp: Experiment, trial: Trial, devices) -> TrialContext:
+        spec = exp.spec
+        monitor = None
+        if trial.early_stopping_rules:
+            monitor = EarlyStoppingMonitor(
+                trial.early_stopping_rules,
+                spec.objective.objective_metric_name,
+                spec.objective.type,
+            )
+        reporter = MetricsReporter(
+            store=self.obs_store, trial_name=trial.name, monitor=monitor
+        )
+        workdir = None
+        if self.workdir_root:
+            import os
+
+            workdir = os.path.join(self.workdir_root, exp.name, trial.name)
+            os.makedirs(workdir, exist_ok=True)
+        return TrialContext(
+            trial_name=trial.name,
+            experiment_name=exp.name,
+            assignments=trial.assignments_dict(),
+            reporter=reporter,
+            workdir=workdir,
+            checkpoint_dir=self._checkpoint_dirs.get(trial.name),
+            devices=list(devices),
+            labels=dict(trial.labels),
+        )
+
+    def _finalize(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> None:
+        """Classification mirroring trial_controller_util.go:42-122 +
+        observation fold (:124-217)."""
+        spec = exp.spec
+        logs = self.obs_store.get_observation_log(trial.name)
+        observation = fold_observation(logs, spec.objective.all_metric_names())
+        trial.observation = observation
+
+        obj_metric = observation.metric(spec.objective.objective_metric_name)
+        metrics_available = (
+            obj_metric is not None and obj_metric.latest != UNAVAILABLE_METRIC_VALUE
+        )
+
+        if result.outcome == TrialOutcome.EARLY_STOPPED:
+            trial.set_condition(
+                TrialCondition.EARLY_STOPPED, "TrialEarlyStopped", "Trial is early stopped"
+            )
+        elif result.outcome == TrialOutcome.KILLED:
+            trial.set_condition(TrialCondition.KILLED, "TrialKilled", result.message)
+        elif result.outcome == TrialOutcome.FAILED:
+            trial.set_condition(TrialCondition.FAILED, "TrialFailed", result.message)
+        elif not metrics_available and spec.metrics_collector_spec.collector_kind != CollectorKind.NONE:
+            trial.set_condition(
+                TrialCondition.METRICS_UNAVAILABLE,
+                "MetricsUnavailable",
+                "Metrics are not available",
+            )
+        else:
+            trial.set_condition(TrialCondition.SUCCEEDED, "TrialSucceeded", "Trial has succeeded")
+        self.state.update_trial(trial)

@@ -1,0 +1,194 @@
+"""Experiment status aggregation — the 7-bucket trial summary, optimal trial
+selection, and terminal-condition logic.
+
+reference pkg/controller.v1beta1/experiment/util/status_util.go:45-246.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..api.spec import MetricStrategyType, ObjectiveType, UNAVAILABLE_METRIC_VALUE
+from ..api.status import (
+    Experiment,
+    ExperimentCondition,
+    ExperimentReason,
+    OptimalTrial,
+    Trial,
+    TrialCondition,
+)
+
+
+def get_objective_metric_value_str(exp: Experiment, trial: Trial) -> str:
+    """reference status_util.go:153-184: strategy-selected value with fallback
+    to latest when min/max unavailable."""
+    if trial.observation is None:
+        return UNAVAILABLE_METRIC_VALUE
+    obj = exp.spec.objective
+    m = trial.observation.metric(obj.objective_metric_name)
+    if m is None:
+        return UNAVAILABLE_METRIC_VALUE
+    strategy = obj.strategy_for(obj.objective_metric_name)
+    if strategy == MetricStrategyType.MIN:
+        return m.latest if m.min == UNAVAILABLE_METRIC_VALUE else m.min
+    if strategy == MetricStrategyType.MAX:
+        return m.latest if m.max == UNAVAILABLE_METRIC_VALUE else m.max
+    return m.latest
+
+
+def update_trials_summary(exp: Experiment, trials: Sequence[Trial]) -> bool:
+    """Mutates exp.status buckets + optimal trial; returns goal-reached.
+
+    reference status_util.go:56-151 (updateTrialsSummary).
+    """
+    sts = exp.status
+    obj = exp.spec.objective
+    buckets = {
+        "killed": [],
+        "failed": [],
+        "succeeded": [],
+        "early_stopped": [],
+        "running": [],
+        "metrics_unavailable": [],
+        "pending": [],
+    }
+    best_trial: Optional[Trial] = None
+    best_value: Optional[float] = None
+    goal_reached = False
+
+    for trial in trials:
+        if trial.condition == TrialCondition.KILLED:
+            buckets["killed"].append(trial.name)
+        elif trial.condition == TrialCondition.FAILED:
+            buckets["failed"].append(trial.name)
+        elif trial.condition == TrialCondition.SUCCEEDED:
+            buckets["succeeded"].append(trial.name)
+        elif trial.condition == TrialCondition.EARLY_STOPPED:
+            buckets["early_stopped"].append(trial.name)
+        elif trial.condition == TrialCondition.RUNNING:
+            buckets["running"].append(trial.name)
+        elif trial.condition == TrialCondition.METRICS_UNAVAILABLE:
+            buckets["metrics_unavailable"].append(trial.name)
+        else:
+            buckets["pending"].append(trial.name)
+
+        value_str = get_objective_metric_value_str(exp, trial)
+        if value_str == UNAVAILABLE_METRIC_VALUE:
+            continue
+        try:
+            value = float(value_str)
+        except ValueError:
+            # string-valued metric: latest reporting trial wins (status_util.go:101-105)
+            best_trial = trial
+            continue
+
+        if best_value is None:
+            best_value, best_trial = value, trial
+        if obj.type == ObjectiveType.MINIMIZE:
+            if value < best_value:
+                best_value, best_trial = value, trial
+            if obj.goal is not None and best_value <= obj.goal:
+                goal_reached = True
+        elif obj.type == ObjectiveType.MAXIMIZE:
+            if value > best_value:
+                best_value, best_trial = value, trial
+            if obj.goal is not None and best_value >= obj.goal:
+                goal_reached = True
+
+    sts.trials = len(trials)
+    sts.killed_trial_names = buckets["killed"]
+    sts.failed_trial_names = buckets["failed"]
+    sts.succeeded_trial_names = buckets["succeeded"]
+    sts.early_stopped_trial_names = buckets["early_stopped"]
+    sts.running_trial_names = buckets["running"]
+    sts.metrics_unavailable_trial_names = buckets["metrics_unavailable"]
+    sts.pending_trial_names = buckets["pending"]
+    sts.trial_names = [t.name for t in trials]
+    sts.trials_killed = len(buckets["killed"])
+    sts.trials_failed = len(buckets["failed"])
+    sts.trials_succeeded = len(buckets["succeeded"])
+    sts.trials_early_stopped = len(buckets["early_stopped"])
+    sts.trials_running = len(buckets["running"])
+    sts.trials_metrics_unavailable = len(buckets["metrics_unavailable"])
+    sts.trials_pending = len(buckets["pending"])
+
+    if best_trial is not None:
+        sts.current_optimal_trial = OptimalTrial(
+            best_trial_name=best_trial.name,
+            parameter_assignments=list(best_trial.parameter_assignments),
+            observation=best_trial.observation,
+        )
+    return goal_reached
+
+
+def update_experiment_status_condition(
+    exp: Experiment, goal_reached: bool, suggestion_end: bool
+) -> None:
+    """Terminal-condition checks in priority order.
+
+    reference status_util.go:187-235 (UpdateExperimentStatusCondition):
+    goal -> max-failed -> max-trials -> suggestion-end -> running.
+    """
+    sts = exp.status
+    completed = (
+        sts.trials_succeeded
+        + sts.trials_failed
+        + sts.trials_killed
+        + sts.trials_early_stopped
+        + sts.trials_metrics_unavailable
+    )
+    failed = sts.trials_failed + sts.trials_metrics_unavailable
+    active = sts.trials_pending + sts.trials_running
+    spec = exp.spec
+
+    if goal_reached:
+        sts.set_condition(
+            ExperimentCondition.SUCCEEDED,
+            ExperimentReason.GOAL_REACHED,
+            "Experiment has succeeded because Objective goal has reached",
+        )
+        return
+    if spec.max_failed_trial_count is not None and failed != 0 and failed >= spec.max_failed_trial_count:
+        sts.set_condition(
+            ExperimentCondition.FAILED,
+            ExperimentReason.MAX_FAILED_TRIALS_REACHED,
+            "Experiment has failed because max failed count has reached",
+        )
+        return
+    if spec.max_trial_count is not None and completed >= spec.max_trial_count:
+        sts.set_condition(
+            ExperimentCondition.SUCCEEDED,
+            ExperimentReason.MAX_TRIALS_REACHED,
+            "Experiment has succeeded because max trial count has reached",
+        )
+        return
+    if suggestion_end and active == 0:
+        sts.set_condition(
+            ExperimentCondition.SUCCEEDED,
+            ExperimentReason.SUGGESTION_END_REACHED,
+            "Experiment has succeeded because suggestion service has reached the end",
+        )
+        return
+    sts.set_condition(ExperimentCondition.RUNNING, ExperimentReason.NONE, "Experiment is running")
+
+
+def update_experiment_status(
+    exp: Experiment, trials: Sequence[Trial], suggestion_end: bool = False
+) -> bool:
+    """reference status_util.go:45-54 (UpdateExperimentStatus): summary, then
+    condition unless already completed. Returns goal_reached."""
+    goal_reached = update_trials_summary(exp, trials)
+    if not exp.status.is_completed:
+        update_experiment_status_condition(exp, goal_reached, suggestion_end)
+    return goal_reached
+
+
+def is_completed_experiment_restartable(exp: Experiment) -> bool:
+    """reference status_util.go:240-246."""
+    from ..api.spec import ResumePolicy
+
+    return (
+        exp.status.is_succeeded
+        and exp.status.reason == ExperimentReason.MAX_TRIALS_REACHED
+        and exp.spec.resume_policy in (ResumePolicy.LONG_RUNNING, ResumePolicy.FROM_VOLUME)
+    )

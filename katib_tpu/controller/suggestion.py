@@ -1,0 +1,191 @@
+"""Suggestion driver — get-or-create suggestion state, sync assignments.
+
+Replaces three reference components with one in-process driver:
+- experiment/suggestion/suggestion.go (GetOrCreateSuggestion / UpdateSuggestion)
+- suggestion controller + composer (no per-experiment pods to deploy — the
+  algorithm runs in-process; the Composer's deployment/service/PVC machinery
+  maps to Suggester instantiation + the FromVolume state directory)
+- suggestionclient/suggestionclient.go:83-198 (SyncAssignments: request delta
+  computation, algorithm-settings overlay + feedback merge, early-stopping
+  rule fetch, trial naming).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence
+
+from ..api.spec import (
+    AlgorithmSetting,
+    EarlyStoppingRule,
+    ExperimentSpec,
+    TrialAssignment,
+)
+from ..api.status import Experiment, SuggestionState, Trial, TrialCondition
+from ..db.state import ExperimentStateStore
+from ..db.store import ObservationStore
+from ..earlystop.medianstop import EarlyStopper, create_early_stopper
+from ..suggest.base import Suggester, SuggestionReply, SuggestionRequest, create
+from ..suggest.hyperband import TrialsNotCompleted
+
+
+class SuggestionFailed(Exception):
+    """Marks the suggestion failed -> experiment fails
+    (experiment_controller.go:470-473)."""
+
+
+class SuggestionService:
+    """One instance per orchestrator; holds per-experiment Suggester and
+    EarlyStopper instances (the reference's per-experiment suggestion pods)."""
+
+    def __init__(self, state: ExperimentStateStore, obs_store: ObservationStore):
+        self.state = state
+        self.obs_store = obs_store
+        self._suggesters: Dict[str, Suggester] = {}
+        self._early_stoppers: Dict[str, EarlyStopper] = {}
+        self._search_ended: Dict[str, bool] = {}
+
+    def suggester_for(self, exp: Experiment) -> Suggester:
+        name = exp.name
+        if name not in self._suggesters:
+            algo = exp.spec.algorithm.algorithm_name
+            kwargs = {}
+            # stateful algorithms get the experiment directory for their
+            # checkpoints (the FromVolume PVC equivalent, composer.go:296+)
+            exp_dir = self.state.experiment_dir(name)
+            if algo == "pbt":
+                import os
+
+                kwargs["checkpoint_root"] = (
+                    None if exp_dir is None else os.path.join(exp_dir, "pbt")
+                )
+            elif algo == "enas":
+                kwargs["state_dir"] = exp_dir
+            self._suggesters[name] = create(algo, **kwargs)
+        return self._suggesters[name]
+
+    def early_stopper_for(self, exp: Experiment) -> Optional[EarlyStopper]:
+        if exp.spec.early_stopping is None:
+            return None
+        name = exp.name
+        if name not in self._early_stoppers:
+            self._early_stoppers[name] = create_early_stopper(
+                exp.spec.early_stopping.algorithm_name
+            )
+        return self._early_stoppers[name]
+
+    def validate(self, exp: Experiment) -> None:
+        """ValidateAlgorithmSettings + ValidateEarlyStoppingSettings before
+        first sync (suggestion_controller.go:256-271)."""
+        try:
+            self.suggester_for(exp).validate_algorithm_settings(exp.spec)
+        except (ValueError, KeyError) as e:
+            raise SuggestionFailed(f"algorithm settings invalid: {e}") from e
+        stopper = self.early_stopper_for(exp)
+        if stopper is not None:
+            try:
+                stopper.validate_settings(exp.spec)
+            except (ValueError, KeyError) as e:
+                raise SuggestionFailed(f"early stopping settings invalid: {e}") from e
+
+    def search_ended(self, experiment_name: str) -> bool:
+        return self._search_ended.get(experiment_name, False)
+
+    def get_or_create(self, exp: Experiment, requests: int) -> SuggestionState:
+        """reference experiment/suggestion/suggestion.go:53-112."""
+        s = self.state.get_suggestion(exp.name)
+        if s is None:
+            s = SuggestionState(
+                experiment_name=exp.name,
+                algorithm_name=exp.spec.algorithm.algorithm_name,
+                requests=requests,
+            )
+            self.state.put_suggestion(s)
+        elif s.requests != requests:
+            s.requests = requests
+            self.state.put_suggestion(s)
+        return s
+
+    def sync_assignments(
+        self, exp: Experiment, trials: Sequence[Trial], requests: int
+    ) -> List[TrialAssignment]:
+        """Returns assignments that do not have trials yet.
+
+        Mirrors ReconcileSuggestions (experiment_controller.go:445-493) +
+        SyncAssignments (suggestionclient.go:83-198).
+        """
+        suggestion = self.get_or_create(exp, requests)
+        if suggestion.failed:
+            raise SuggestionFailed(suggestion.message or "Suggestion has failed")
+
+        current_request = suggestion.requests - suggestion.suggestion_count
+        if current_request > 0:
+            # Overlay settings feedback (hyperband state) onto a spec copy
+            # before calling the algorithm (suggestionclient.go:106-109).
+            filled = ExperimentSpec.from_json(exp.spec.to_json())
+            if exp.spec.trial_template.function is not None:
+                filled.trial_template.function = exp.spec.trial_template.function
+            self._overlay_settings(filled, suggestion.algorithm_settings)
+
+            request = SuggestionRequest(
+                experiment=filled,
+                trials=list(trials),
+                current_request_number=current_request,
+                total_request_number=suggestion.requests,
+            )
+            try:
+                reply = self.suggester_for(exp).get_suggestions(request)
+            except TrialsNotCompleted:
+                reply = SuggestionReply()  # wait: running trials must finish first
+            except SuggestionFailed:
+                raise
+            except Exception as e:
+                suggestion.failed = True
+                suggestion.message = f"{type(e).__name__}: {e}"
+                self.state.put_suggestion(suggestion)
+                raise SuggestionFailed(suggestion.message) from e
+
+            # early stopping rules are fetched after suggestions and attached
+            # to every new assignment (suggestionclient.go:131-170)
+            rules: List[EarlyStoppingRule] = []
+            stopper = self.early_stopper_for(exp)
+            if stopper is not None and reply.assignments:
+                rules = stopper.get_early_stopping_rules(filled, trials, self.obs_store)
+            for a in reply.assignments:
+                a.early_stopping_rules = list(rules)
+
+            suggestion.suggestions.extend(reply.assignments)
+            if reply.algorithm_settings:
+                suggestion.algorithm_settings.update(reply.algorithm_settings)
+            if reply.search_ended:
+                self._search_ended[exp.name] = True
+            self.state.put_suggestion(suggestion)
+
+        trial_names = {t.name for t in trials}
+        return [a for a in suggestion.suggestions if a.name not in trial_names]
+
+    @staticmethod
+    def _overlay_settings(spec: ExperimentSpec, settings: Dict[str, str]) -> None:
+        existing = {s.name: s for s in spec.algorithm.algorithm_settings}
+        for k, v in settings.items():
+            if k in existing:
+                existing[k].value = v
+            else:
+                spec.algorithm.algorithm_settings.append(AlgorithmSetting(name=k, value=v))
+
+    def cleanup(self, exp: Experiment) -> None:
+        """Resume-policy cleanup on completion
+        (suggestion_controller.go:132-143): Never/FromVolume drop the
+        in-memory algorithm instance (FromVolume keeps its on-disk state);
+        LongRunning keeps it alive for budget-raise restarts."""
+        from ..api.spec import ResumePolicy
+
+        if exp.spec.resume_policy in (ResumePolicy.NEVER, ResumePolicy.FROM_VOLUME):
+            self._suggesters.pop(exp.name, None)
+            self._early_stoppers.pop(exp.name, None)
+
+    def forget(self, experiment_name: str) -> None:
+        """Drop all per-experiment state (experiment deletion)."""
+        self._suggesters.pop(experiment_name, None)
+        self._early_stoppers.pop(experiment_name, None)
+        self._search_ended.pop(experiment_name, None)

@@ -1,0 +1,10 @@
+from .store import (  # noqa: F401
+    InMemoryObservationStore,
+    MetricLog,
+    ObservationStore,
+    SqliteObservationStore,
+    fold_observation,
+    objective_value,
+    open_store,
+)
+from .state import ExperimentStateStore  # noqa: F401

@@ -1,0 +1,160 @@
+"""Experiment state store — replaces Kubernetes CRDs/etcd as declarative state.
+
+The reference persists Experiment/Suggestion/Trial objects as CRs in etcd and
+controllers watch them. Here the orchestrator is a single process, so state is
+a thread-safe registry with optional JSON persistence per experiment under
+``<root>/<experiment>/state.json`` (FromVolume resume policy restores from it —
+reference composer.go:121-133 PVC semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api.spec import ExperimentSpec
+from ..api.status import Experiment, SuggestionState, Trial
+
+
+class ExperimentStateStore:
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root
+        self._lock = threading.RLock()
+        self._experiments: Dict[str, Experiment] = {}
+        self._trials: Dict[str, Dict[str, Trial]] = {}
+        self._suggestions: Dict[str, SuggestionState] = {}
+        if root:
+            os.makedirs(root, exist_ok=True)
+
+    # -- experiments --------------------------------------------------------
+
+    def create_experiment(self, exp: Experiment) -> Experiment:
+        with self._lock:
+            if exp.name in self._experiments:
+                raise ValueError(f"experiment {exp.name!r} already exists")
+            self._experiments[exp.name] = exp
+            self._trials.setdefault(exp.name, {})
+            self._persist(exp.name)
+            return exp
+
+    def get_experiment(self, name: str) -> Optional[Experiment]:
+        with self._lock:
+            return self._experiments.get(name)
+
+    def list_experiments(self) -> List[Experiment]:
+        with self._lock:
+            return list(self._experiments.values())
+
+    def update_experiment(self, exp: Experiment) -> None:
+        with self._lock:
+            self._experiments[exp.name] = exp
+            self._persist(exp.name)
+
+    def delete_experiment(self, name: str) -> None:
+        with self._lock:
+            self._experiments.pop(name, None)
+            self._trials.pop(name, None)
+            self._suggestions.pop(name, None)
+            if self.root:
+                p = self._path(name)
+                if os.path.exists(p):
+                    os.remove(p)
+
+    # -- trials -------------------------------------------------------------
+
+    def create_trial(self, trial: Trial) -> Trial:
+        with self._lock:
+            exp_trials = self._trials.setdefault(trial.experiment_name, {})
+            if trial.name in exp_trials:
+                raise ValueError(f"trial {trial.name!r} already exists")
+            exp_trials[trial.name] = trial
+            self._persist(trial.experiment_name)
+            return trial
+
+    def get_trial(self, experiment_name: str, trial_name: str) -> Optional[Trial]:
+        with self._lock:
+            return self._trials.get(experiment_name, {}).get(trial_name)
+
+    def list_trials(self, experiment_name: str) -> List[Trial]:
+        """Label-selector list in the reference (experiment_controller.go:263);
+        returned in creation order."""
+        with self._lock:
+            return list(self._trials.get(experiment_name, {}).values())
+
+    def update_trial(self, trial: Trial) -> None:
+        with self._lock:
+            self._trials.setdefault(trial.experiment_name, {})[trial.name] = trial
+            self._persist(trial.experiment_name)
+
+    def delete_trial(self, experiment_name: str, trial_name: str) -> None:
+        with self._lock:
+            self._trials.get(experiment_name, {}).pop(trial_name, None)
+            self._persist(experiment_name)
+
+    # -- suggestion state ----------------------------------------------------
+
+    def get_suggestion(self, experiment_name: str) -> Optional[SuggestionState]:
+        with self._lock:
+            return self._suggestions.get(experiment_name)
+
+    def put_suggestion(self, s: SuggestionState) -> None:
+        with self._lock:
+            self._suggestions[s.experiment_name] = s
+            self._persist(s.experiment_name)
+
+    def delete_suggestion(self, experiment_name: str) -> None:
+        with self._lock:
+            self._suggestions.pop(experiment_name, None)
+            self._persist(experiment_name)
+
+    # -- persistence ---------------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        assert self.root is not None
+        return os.path.join(self.root, name, "state.json")
+
+    def _persist(self, name: str) -> None:
+        if not self.root:
+            return
+        exp = self._experiments.get(name)
+        if exp is None:
+            return
+        payload = {
+            "experiment": exp.to_dict(),
+            "trials": [t.to_dict() for t in self._trials.get(name, {}).values()],
+            "suggestion": self._suggestions[name].to_dict() if name in self._suggestions else None,
+            "savedAt": time.time(),
+        }
+        p = self._path(name)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, p)
+
+    def load(self, name: str) -> Optional[Experiment]:
+        """FromVolume resume: restore experiment + trials + suggestion state."""
+        if not self.root:
+            return None
+        p = self._path(name)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            payload = json.load(f)
+        with self._lock:
+            exp = Experiment.from_dict(payload["experiment"])
+            self._experiments[name] = exp
+            self._trials[name] = {t["name"]: Trial.from_dict(t) for t in payload.get("trials", [])}
+            if payload.get("suggestion"):
+                self._suggestions[name] = SuggestionState.from_dict(payload["suggestion"])
+            return exp
+
+    def experiment_dir(self, name: str) -> Optional[str]:
+        if not self.root:
+            return None
+        d = os.path.join(self.root, name)
+        os.makedirs(d, exist_ok=True)
+        return d

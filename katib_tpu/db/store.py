@@ -1,0 +1,255 @@
+"""Observation-log store — the data plane.
+
+TPU-native replacement for katib-db-manager + MySQL/Postgres:
+- gRPC surface: reference pkg/apis/manager/v1beta1/api.proto:13-31
+  (ReportObservationLog / GetObservationLog / DeleteObservationLog)
+- table schema: reference pkg/db/v1beta1/mysql/mysql.go:67-166
+  (observation_logs(trial_name, time, metric_name, value))
+- interface: reference pkg/db/v1beta1/common/kdb.go
+
+Backed by SQLite in WAL mode: one writer per experiment host, many readers —
+matching the reference's single-db-manager topology without a network hop.
+A thread-safe in-memory implementation backs unit tests.
+
+Folding an observation log into per-metric {min,max,latest} honoring
+timestamps mirrors trial_controller_util.go:165-217 (getMetrics).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..api.spec import (
+    UNAVAILABLE_METRIC_VALUE,
+    Metric,
+    MetricStrategyType,
+    Observation,
+    ObjectiveSpec,
+)
+
+
+@dataclass
+class MetricLog:
+    """One observation-log row: (timestamp, metric_name, value).
+
+    Values are stored as strings like the reference (mysql.go VARCHAR value) so
+    non-numeric reports surface as 'unavailable' rather than crashing.
+    """
+
+    timestamp: float
+    metric_name: str
+    value: str
+
+
+class ObservationStore:
+    """Abstract store interface, reference kdb.go:1-30."""
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        raise NotImplementedError
+
+    def get_observation_log(
+        self,
+        trial_name: str,
+        metric_name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+    ) -> List[MetricLog]:
+        raise NotImplementedError
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryObservationStore(ObservationStore):
+    """Thread-safe dict-backed store for tests and in-process experiments."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._logs: Dict[str, List[MetricLog]] = {}
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        with self._lock:
+            self._logs.setdefault(trial_name, []).extend(logs)
+
+    def get_observation_log(
+        self,
+        trial_name: str,
+        metric_name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+    ) -> List[MetricLog]:
+        with self._lock:
+            rows = list(self._logs.get(trial_name, []))
+        return _filter_logs(rows, metric_name, start_time, end_time)
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        with self._lock:
+            self._logs.pop(trial_name, None)
+
+
+class SqliteObservationStore(ObservationStore):
+    """SQLite-WAL store; schema mirrors mysql.go observation_logs."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS observation_logs ("
+                " trial_name TEXT NOT NULL,"
+                " time REAL NOT NULL,"
+                " metric_name TEXT NOT NULL,"
+                " value TEXT NOT NULL)"
+            )
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs(trial_name, time)"
+            )
+            self._conn.commit()
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO observation_logs(trial_name, time, metric_name, value) VALUES (?,?,?,?)",
+                [(trial_name, l.timestamp, l.metric_name, l.value) for l in logs],
+            )
+            self._conn.commit()
+
+    def get_observation_log(
+        self,
+        trial_name: str,
+        metric_name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+    ) -> List[MetricLog]:
+        q = "SELECT time, metric_name, value FROM observation_logs WHERE trial_name = ?"
+        args: List = [trial_name]
+        if metric_name is not None:
+            q += " AND metric_name = ?"
+            args.append(metric_name)
+        if start_time is not None:
+            q += " AND time >= ?"
+            args.append(start_time)
+        if end_time is not None:
+            q += " AND time <= ?"
+            args.append(end_time)
+        q += " ORDER BY time ASC"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [MetricLog(timestamp=r[0], metric_name=r[1], value=r[2]) for r in rows]
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def _filter_logs(
+    rows: List[MetricLog],
+    metric_name: Optional[str],
+    start_time: Optional[float],
+    end_time: Optional[float],
+) -> List[MetricLog]:
+    out = rows
+    if metric_name is not None:
+        out = [r for r in out if r.metric_name == metric_name]
+    if start_time is not None:
+        out = [r for r in out if r.timestamp >= start_time]
+    if end_time is not None:
+        out = [r for r in out if r.timestamp <= end_time]
+    return sorted(out, key=lambda r: r.timestamp)
+
+
+def _parse_float(value: str) -> Optional[float]:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return None
+    if math.isnan(f):
+        return None
+    return f
+
+
+def fold_observation(logs: Sequence[MetricLog], metric_names: Sequence[str]) -> Observation:
+    """Fold raw logs into per-metric {min,max,latest}.
+
+    Mirrors getMetrics (trial_controller_util.go:165-217): 'latest' is the
+    value with the greatest timestamp (ties: last reported); min/max ignore
+    non-numeric values; a metric with no parseable value at all reports
+    'unavailable' everywhere.
+    """
+    metrics: List[Metric] = []
+    for name in metric_names:
+        rows = [r for r in logs if r.metric_name == name]
+        latest: str = UNAVAILABLE_METRIC_VALUE
+        best_ts = -math.inf
+        lo = math.inf
+        hi = -math.inf
+        has_numeric = False
+        for r in rows:
+            if r.timestamp >= best_ts:
+                best_ts = r.timestamp
+                latest = r.value
+            f = _parse_float(r.value)
+            if f is not None:
+                has_numeric = True
+                lo = min(lo, f)
+                hi = max(hi, f)
+        if not rows:
+            metrics.append(Metric(name=name))
+            continue
+        metrics.append(
+            Metric(
+                name=name,
+                min=repr(lo) if has_numeric else UNAVAILABLE_METRIC_VALUE,
+                max=repr(hi) if has_numeric else UNAVAILABLE_METRIC_VALUE,
+                latest=latest,
+            )
+        )
+    return Observation(metrics=metrics)
+
+
+def objective_value(
+    observation: Optional[Observation], objective: ObjectiveSpec
+) -> Optional[float]:
+    """Extract the objective metric per its strategy.
+
+    Mirrors getObjectiveMetricValue (status_util.go:153-184).
+    """
+    if observation is None:
+        return None
+    m = observation.metric(objective.objective_metric_name)
+    if m is None:
+        return None
+    strategy = objective.strategy_for(objective.objective_metric_name)
+    raw = {
+        MetricStrategyType.MIN: m.min,
+        MetricStrategyType.MAX: m.max,
+        MetricStrategyType.LATEST: m.latest,
+    }[strategy]
+    return _parse_float(raw)
+
+
+def open_store(path: Optional[str]) -> ObservationStore:
+    """Factory, reference pkg/db/v1beta1/db.go: path=None -> in-memory."""
+    if path is None:
+        return InMemoryObservationStore()
+    return SqliteObservationStore(path)

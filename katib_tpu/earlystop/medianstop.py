@@ -1,0 +1,114 @@
+"""Median-stop early stopping rule generator.
+
+reference pkg/earlystopping/v1beta1/medianstop/service.py:101-191. For each
+newly-succeeded trial, average its first ``start_step`` objective metric
+reports; once at least ``min_trials_required`` trials are recorded, emit the
+rule ``objective <comparison> <aggregate>`` where comparison is LESS for
+maximize / GREATER for minimize and the aggregate is the arithmetic mean of
+the per-trial averages (the reference computes a *mean* despite the
+"median" name — service.py:183-186 — reproduced for parity).
+
+Rule *enforcement* lives in katib_tpu.runtime.metrics.EarlyStoppingMonitor,
+mirroring the reference's sidecar (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..api.spec import ComparisonType, EarlyStoppingRule, ExperimentSpec, ObjectiveType
+from ..api.status import Trial, TrialCondition
+from ..db.store import ObservationStore
+
+
+class EarlyStopper:
+    """ABC-lite for early stopping services (api.proto EarlyStopping)."""
+
+    name: str = ""
+
+    def get_early_stopping_rules(
+        self, experiment: ExperimentSpec, trials: Sequence[Trial], store: ObservationStore
+    ) -> List[EarlyStoppingRule]:
+        raise NotImplementedError
+
+    def validate_settings(self, experiment: ExperimentSpec) -> None:
+        pass
+
+
+class MedianStop(EarlyStopper):
+    name = "medianstop"
+
+    DEFAULT_MIN_TRIALS_REQUIRED = 3
+    DEFAULT_START_STEP = 4
+
+    def __init__(self) -> None:
+        self._avg_history: Dict[str, float] = {}
+
+    def validate_settings(self, experiment: ExperimentSpec) -> None:
+        """reference service.py:70-98."""
+        es = experiment.early_stopping
+        if es is None:
+            return
+        for s in es.algorithm_settings:
+            if s.name == "min_trials_required":
+                if int(s.value) <= 0:
+                    raise ValueError("min_trials_required must be greater than zero")
+            elif s.name == "start_step":
+                if int(s.value) < 1:
+                    raise ValueError("start_step must be greater or equal than one")
+            else:
+                raise ValueError(f"unknown medianstop setting {s.name!r}")
+
+    def get_early_stopping_rules(
+        self, experiment: ExperimentSpec, trials: Sequence[Trial], store: ObservationStore
+    ) -> List[EarlyStoppingRule]:
+        es = experiment.early_stopping
+        settings = es.settings_dict() if es else {}
+        min_trials = int(settings.get("min_trials_required", self.DEFAULT_MIN_TRIALS_REQUIRED))
+        start_step = int(settings.get("start_step", self.DEFAULT_START_STEP))
+        objective_metric = experiment.objective.objective_metric_name
+        comparison = (
+            ComparisonType.LESS
+            if experiment.objective.type == ObjectiveType.MAXIMIZE
+            else ComparisonType.GREATER
+        )
+
+        for trial in trials:
+            if trial.name in self._avg_history or trial.condition != TrialCondition.SUCCEEDED:
+                continue
+            logs = store.get_observation_log(trial.name, metric_name=objective_metric)
+            first = logs[:start_step]
+            values = []
+            for log in first:
+                try:
+                    values.append(float(log.value))
+                except ValueError:
+                    continue
+            if not values:
+                continue
+            self._avg_history[trial.name] = sum(values) / len(values)
+
+        if len(self._avg_history) >= min_trials:
+            aggregate = sum(self._avg_history.values()) / len(self._avg_history)
+            return [
+                EarlyStoppingRule(
+                    name=objective_metric,
+                    value=str(aggregate),
+                    comparison=comparison,
+                    start_step=start_step,
+                )
+            ]
+        return []
+
+
+_EARLY_STOPPERS = {"medianstop": MedianStop}
+
+
+def registered_early_stoppers() -> set:
+    return set(_EARLY_STOPPERS)
+
+
+def create_early_stopper(name: str) -> EarlyStopper:
+    if name not in _EARLY_STOPPERS:
+        raise KeyError(f"unknown early-stopping algorithm {name!r}")
+    return _EARLY_STOPPERS[name]()

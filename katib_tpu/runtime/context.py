@@ -1,0 +1,67 @@
+"""Trial execution context — what a trial function receives from the runtime.
+
+The TPU-native analogue of everything the reference injects into a trial pod
+(env vars, mounted volumes, metrics sidecar wiring, suggestion PVC for PBT —
+pkg/webhook/v1beta1/pod/inject_webhook.go): assignments, a push metrics
+reporter with early-stopping enforcement, a workdir, the PBT checkpoint dir,
+and the gang-allocated device set from which the trial builds its mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .metrics import MetricsReporter
+
+
+@dataclass
+class TrialContext:
+    trial_name: str
+    experiment_name: str
+    assignments: Dict[str, str]
+    reporter: MetricsReporter
+    workdir: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    devices: Optional[List[Any]] = None  # jax devices gang-allocated to this trial
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def report(self, **metrics: float) -> None:
+        """Push metrics; raises katib_tpu.runtime.metrics.EarlyStopped when all
+        early-stopping rules have tripped."""
+        self.reporter.report(**metrics)
+
+    def mesh(self, axis_names=("data",), shape=None):
+        """Build a jax.sharding.Mesh over this trial's allocated devices.
+
+        Default: 1-D data mesh. Pass shape for multi-axis (e.g. shape=(2, 4),
+        axis_names=("data", "model")).
+        """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = self.devices
+        if not devices:
+            import jax
+
+            devices = jax.devices()
+        arr = np.array(devices)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        else:
+            arr = arr.reshape((-1,) * 1)
+            if len(axis_names) > 1:
+                raise ValueError("pass shape= for multi-axis meshes")
+        return Mesh(arr, axis_names)
+
+    def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self.assignments.get(name, default)
+
+    def param_float(self, name: str, default: Optional[float] = None) -> Optional[float]:
+        v = self.assignments.get(name)
+        return float(v) if v is not None else default
+
+    def param_int(self, name: str, default: Optional[int] = None) -> Optional[int]:
+        v = self.assignments.get(name)
+        return int(float(v)) if v is not None else default

@@ -1,0 +1,235 @@
+"""Metric reporting + collection — the TPU-native data plane.
+
+The reference collects metrics by injecting a log-scraping sidecar into the
+trial pod (pkg/webhook/v1beta1/pod/inject_webhook.go) which tails
+/var/log/katib/metrics.log and reports to katib-db-manager over gRPC. On TPU
+the idiomatic path is *push*: trial code calls ``report_metrics`` (the SDK
+already has this push mode — sdk/python/v1beta1/kubeflow/katib/api/
+report_metrics.py) and the rows land in the observation store directly.
+
+For parity with arbitrary subprocess trials, the TEXT/JSON line parsers of the
+file/stdout collector are reproduced (pkg/metricscollector/v1beta1/
+file-metricscollector/file-metricscollector.go:45-120, default filter regex
+from pkg/metricscollector/v1beta1/common/const.go:47).
+
+Early-stopping rule enforcement matches the sidecar watcher
+(cmd/metricscollector/v1beta1/file-metricscollector/main.go:147-386):
+- each rule is deleted once it trips; the trial stops when ALL rules tripped;
+- the objective metric is compared via its running optimum (max for maximize,
+  min for minimize) — the medianstop workaround;
+- a rule with start_step > 0 is evaluated exactly at the start_step-th report
+  of its metric.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..api.spec import ComparisonType, EarlyStoppingRule, ObjectiveType
+from ..db.store import MetricLog, ObservationStore, open_store
+
+# reference const.go:47
+DEFAULT_FILTER = r"([\w|-]+)\s*=\s*([+-]?\d*(\.\d+)?([Ee][+-]?\d+)?)"
+
+# env keys used to rebind a subprocess trial to the store (replaces the
+# sidecar + db-manager address plumbing of the reference webhook)
+ENV_TRIAL_NAME = "KATIB_TPU_TRIAL_NAME"
+ENV_DB_PATH = "KATIB_TPU_DB_PATH"
+ENV_METRICS_FILE = "KATIB_TPU_METRICS_FILE"
+
+
+class EarlyStopped(Exception):
+    """Raised inside trial code when all early-stopping rules tripped."""
+
+
+class EarlyStoppingMonitor:
+    """Stateful rule tracker, mirroring updateStopRules (main.go:336-386)."""
+
+    def __init__(
+        self,
+        rules: Sequence[EarlyStoppingRule],
+        objective_metric: str,
+        objective_type: ObjectiveType,
+    ):
+        self.rules = list(rules)
+        self.objective_metric = objective_metric
+        self.objective_type = objective_type
+        self.optimal_obj_value: Optional[float] = None
+        self._start_step_left: Dict[str, int] = {
+            r.name: r.start_step for r in rules if r.start_step != 0
+        }
+
+    @property
+    def should_stop(self) -> bool:
+        return not self.rules and self._had_rules
+
+    _had_rules = False
+
+    def observe(self, metric_name: str, value: float) -> bool:
+        """Feed one metric report; returns True when the trial must stop."""
+        if not self.rules:
+            return self.should_stop
+        self._had_rules = True
+        for rule in list(self.rules):
+            if rule.name != metric_name:
+                continue
+            self._apply_rule(rule, value)
+        return not self.rules
+
+    def _apply_rule(self, rule: EarlyStoppingRule, value: float) -> None:
+        # running-optimum substitution for the objective metric
+        if rule.name == self.objective_metric:
+            if self.optimal_obj_value is None:
+                self.optimal_obj_value = value
+            elif self.objective_type == ObjectiveType.MAXIMIZE:
+                self.optimal_obj_value = max(self.optimal_obj_value, value)
+            elif self.objective_type == ObjectiveType.MINIMIZE:
+                self.optimal_obj_value = min(self.optimal_obj_value, value)
+            value = self.optimal_obj_value
+
+        if rule.name in self._start_step_left:
+            self._start_step_left[rule.name] -= 1
+            if self._start_step_left[rule.name] != 0:
+                return
+
+        rule_value = float(rule.value)
+        tripped = (
+            (rule.comparison == ComparisonType.EQUAL and value == rule_value)
+            or (rule.comparison == ComparisonType.LESS and value < rule_value)
+            or (rule.comparison == ComparisonType.GREATER and value > rule_value)
+        )
+        if tripped:
+            self.rules.remove(rule)
+
+
+@dataclass
+class MetricsReporter:
+    """Push reporter bound to one trial; checks early-stopping on each report."""
+
+    store: ObservationStore
+    trial_name: str
+    monitor: Optional[EarlyStoppingMonitor] = None
+    raise_on_stop: bool = True
+    _stopped: bool = False
+
+    def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
+        ts = timestamp if timestamp is not None else time.time()
+        logs = [
+            MetricLog(timestamp=ts, metric_name=k, value=str(v)) for k, v in metrics.items()
+        ]
+        self.store.report_observation_log(self.trial_name, logs)
+        if self.monitor is not None:
+            for k, v in metrics.items():
+                try:
+                    fv = float(v)
+                except (TypeError, ValueError):
+                    continue
+                if self.monitor.observe(k, fv):
+                    self._stopped = True
+            if self._stopped and self.raise_on_stop:
+                raise EarlyStopped(f"trial {self.trial_name} early stopped")
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+# -- in-process trial context plumbing --------------------------------------
+
+_current_reporter: contextvars.ContextVar[Optional[MetricsReporter]] = contextvars.ContextVar(
+    "katib_tpu_reporter", default=None
+)
+
+
+def set_current_reporter(r: Optional[MetricsReporter]):
+    return _current_reporter.set(r)
+
+
+def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> None:
+    """SDK push entry point, reference sdk report_metrics.py:24+.
+
+    Works in three bindings:
+    1. in-process trial: a contextvar reporter was installed by the runtime;
+    2. subprocess trial with env binding: opens the store at $KATIB_TPU_DB_PATH;
+    3. bare subprocess: prints ``name=value`` lines for the stdout collector.
+    """
+    merged = dict(metrics or {})
+    merged.update(kw)
+    r = _current_reporter.get()
+    if r is not None:
+        r.report(**merged)
+        return
+    trial = os.environ.get(ENV_TRIAL_NAME)
+    db = os.environ.get(ENV_DB_PATH)
+    if trial and db:
+        store = open_store(db)
+        try:
+            MetricsReporter(store=store, trial_name=trial).report(**merged)
+        finally:
+            store.close()
+        return
+    for k, v in merged.items():
+        print(f"{k}={v}", flush=True)
+
+
+# -- pull parsers for subprocess output -------------------------------------
+
+def parse_text_lines(
+    lines: Sequence[str],
+    metric_names: Sequence[str],
+    filters: Optional[Sequence[str]] = None,
+    base_time: Optional[float] = None,
+) -> List[MetricLog]:
+    """TEXT collector: regex filters with 2 capture groups (name, value);
+    reference file-metricscollector.go:45-120."""
+    regs = [re.compile(f) for f in (filters or [DEFAULT_FILTER])]
+    wanted = set(metric_names)
+    t0 = base_time if base_time is not None else time.time()
+    out: List[MetricLog] = []
+    for i, line in enumerate(lines):
+        for reg in regs:
+            for m in reg.finditer(line):
+                name = m.group(1).strip()
+                value = (m.group(2) or "").strip()
+                if name not in wanted or value == "":
+                    continue
+                # monotonically increasing synthetic timestamps keep
+                # 'latest' folding faithful to report order
+                out.append(MetricLog(timestamp=t0 + i * 1e-6, metric_name=name, value=value))
+    return out
+
+
+def parse_json_lines(
+    lines: Sequence[str],
+    metric_names: Sequence[str],
+    base_time: Optional[float] = None,
+) -> List[MetricLog]:
+    """JSON collector: one JSON object per line; values may be str or number.
+    Lines that fail to parse are skipped (subprocess logs are noisy)."""
+    wanted = set(metric_names)
+    t0 = base_time if base_time is not None else time.time()
+    out: List[MetricLog] = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        ts = t0 + i * 1e-6
+        if "timestamp" in obj:
+            try:
+                ts = float(obj["timestamp"])
+            except (TypeError, ValueError):
+                pass
+        for k, v in obj.items():
+            if k in wanted:
+                out.append(MetricLog(timestamp=ts, metric_name=k, value=str(v)))
+    return out

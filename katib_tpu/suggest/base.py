@@ -1,0 +1,127 @@
+"""Suggestion algorithm interface + registry.
+
+The reference runs each algorithm as a per-experiment gRPC pod implementing the
+``Suggestion`` service (api.proto:36-39: GetSuggestions,
+ValidateAlgorithmSettings); the controller passes the experiment, the full
+trial history, and the number of new assignments wanted
+(suggestionclient.go:83-198). Here the same contract is a Python ABC driven
+in-process — keeping the gRPC-shaped boundary (all state derivable from the
+request, settings feedback returned in the reply) so algorithms can also be
+served out-of-process (katib_tpu.client.service wraps this ABC behind gRPC).
+"""
+
+from __future__ import annotations
+
+import abc
+import secrets
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Type
+
+from ..api.spec import ExperimentSpec, TrialAssignment
+from ..api.status import Trial
+from .internal.search_space import SearchSpace
+from .internal.trial import ObservedTrial, completed_trials
+
+
+@dataclass
+class SuggestionRequest:
+    """Mirror of api.proto GetSuggestionsRequest:297-303."""
+
+    experiment: ExperimentSpec
+    trials: List[Trial]
+    current_request_number: int
+    total_request_number: int = 0
+
+
+@dataclass
+class SuggestionReply:
+    """Mirror of GetSuggestionsReply: assignments + optional algorithm-settings
+    feedback (the hyperband state-round-trip channel, suggestion_types.go:98)
+    + optional end-of-search signal (grid/hyperband exhaustion -> experiment
+    reason SuggestionEndReached)."""
+
+    assignments: List[TrialAssignment] = field(default_factory=list)
+    algorithm_settings: Dict[str, str] = field(default_factory=dict)
+    search_ended: bool = False
+
+
+class Suggester(abc.ABC):
+    """One suggestion algorithm. Stateless-per-call by contract: everything
+    needed must come from the request (full history + settings). Implementations
+    may keep caches keyed by experiment name purely as an optimization."""
+
+    name: str = ""
+
+    @abc.abstractmethod
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        ...
+
+    def validate_algorithm_settings(self, experiment: ExperimentSpec) -> None:
+        """Raise ValueError on bad settings — api.proto ValidateAlgorithmSettings,
+        called once before the first suggestion sync
+        (suggestion_controller.go:256-271)."""
+
+    # -- shared helpers ------------------------------------------------------
+
+    @staticmethod
+    def search_space(experiment: ExperimentSpec) -> SearchSpace:
+        return SearchSpace.from_experiment(experiment)
+
+    @staticmethod
+    def history(request: SuggestionRequest) -> List[ObservedTrial]:
+        return completed_trials(request.trials, request.experiment.objective)
+
+    @staticmethod
+    def make_trial_name(experiment: ExperimentSpec) -> str:
+        """``<experiment>-<rand8>`` — reference suggestionclient.go trial
+        naming (utilrand.String(8))."""
+        suffix = "".join(secrets.choice("abcdefghijklmnopqrstuvwxyz0123456789") for _ in range(8))
+        return f"{experiment.name}-{suffix}"
+
+    @staticmethod
+    def settings(experiment: ExperimentSpec) -> Dict[str, str]:
+        return experiment.algorithm.settings_dict()
+
+    @staticmethod
+    def seed_from(experiment: ExperimentSpec, salt: int = 0) -> Optional[int]:
+        s = experiment.algorithm.settings_dict().get("random_state")
+        if s is None:
+            return None
+        return int(s) + salt
+
+
+_REGISTRY: Dict[str, Type[Suggester]] = {}
+
+
+def register(cls: Type[Suggester]) -> Type[Suggester]:
+    """Class decorator; replaces the katib-config per-algorithm image registry
+    (pkg/apis/config/v1beta1/types.go SuggestionConfig)."""
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} must set a name")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def registered_algorithms() -> set:
+    _ensure_builtins()
+    return set(_REGISTRY)
+
+
+def create(name: str, **kwargs) -> Suggester:
+    _ensure_builtins()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown algorithm {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+_BUILTINS_LOADED = False
+
+
+def _ensure_builtins() -> None:
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    # Import for registration side effects.
+    from . import random_search, grid, tpe, bayesopt, cmaes, sobol, hyperband, pbt  # noqa: F401
+    from .nas import darts, enas  # noqa: F401

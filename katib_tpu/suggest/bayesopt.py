@@ -1,0 +1,128 @@
+"""Gaussian-process Bayesian optimization — native implementation.
+
+Capability match for the reference's skopt service
+(pkg/suggestion/v1beta1/skopt/base_service.py:25-141: Optimizer with
+base_estimator="GP", n_initial_points, acq_func) without the scikit-optimize
+dependency. GP regression with a Matérn-5/2 kernel over the unit cube, fitted
+by Cholesky (O(n^3) in completed trials, n is tens-to-hundreds here), and an
+expected-improvement acquisition maximized over a quasi-random candidate batch
+— all dense numpy linear algebra.
+
+Settings (mirroring skopt service.py validation):
+  base_estimator (only "GP"), n_initial_points (default 10),
+  acq_func ("ei" | "pi" | "lcb", default "ei"), random_state.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+from scipy.linalg import cho_factor, cho_solve
+from scipy.stats import norm
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import TrialAssignment
+from .internal.search_space import MIN_GOAL
+
+
+def _matern52(a: np.ndarray, b: np.ndarray, length: float) -> np.ndarray:
+    """Matérn-5/2 kernel matrix between [n,D] and [m,D]."""
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    d = np.sqrt(np.maximum(d2, 1e-300)) / length
+    s5 = math.sqrt(5.0)
+    return (1.0 + s5 * d + 5.0 / 3.0 * d * d) * np.exp(-s5 * d)
+
+
+class _GP:
+    def __init__(self, xs: np.ndarray, ys: np.ndarray, length: float = 0.25, noise: float = 1e-6):
+        self.xs = xs
+        self.y_mean = ys.mean()
+        self.y_std = ys.std() + 1e-12
+        self.ys = (ys - self.y_mean) / self.y_std
+        self.length = length
+        K = _matern52(xs, xs, length) + noise * np.eye(len(xs))
+        self.chol = cho_factor(K, lower=True)
+        self.alpha = cho_solve(self.chol, self.ys)
+
+    def predict(self, cands: np.ndarray):
+        Ks = _matern52(cands, self.xs, self.length)  # [m, n]
+        mu = Ks @ self.alpha
+        v = cho_solve(self.chol, Ks.T)  # [n, m]
+        var = np.maximum(1.0 - (Ks * v.T).sum(axis=1), 1e-12)
+        return mu * self.y_std + self.y_mean, np.sqrt(var) * self.y_std
+
+
+@register
+class BayesianOptimization(Suggester):
+    name = "bayesianoptimization"
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        s = self.settings(experiment)
+        if s.get("base_estimator", "GP") != "GP":
+            raise ValueError("only base_estimator=GP is supported")
+        if "n_initial_points" in s and int(s["n_initial_points"]) < 1:
+            raise ValueError("n_initial_points must be >= 1")
+        if s.get("acq_func", "ei") not in ("ei", "pi", "lcb", "gp_hedge"):
+            raise ValueError("acq_func must be one of ei, pi, lcb, gp_hedge")
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        space = self.search_space(request.experiment)
+        s = self.settings(request.experiment)
+        n_initial = int(s.get("n_initial_points", 10))
+        acq = s.get("acq_func", "ei")
+        if acq == "gp_hedge":
+            acq = "ei"
+        seed = self.seed_from(request.experiment, salt=len(request.trials))
+        rng = np.random.default_rng(seed)
+        minimize = space.goal == MIN_GOAL
+
+        history = [t for t in self.history(request) if t.objective is not None]
+        xs = space.encode_many([t.assignments for t in history])
+        # Internally always minimize (negate for maximize), like skopt.
+        ys = np.array([t.objective for t in history], dtype=np.float64)
+        if not minimize:
+            ys = -ys
+
+        assignments: List[TrialAssignment] = []
+        for _ in range(request.current_request_number):
+            if len(ys) < n_initial:
+                u = space.sample_uniform(rng, 1)[0]
+            else:
+                u = self._acquire(xs, ys, space, rng, acq)
+                # constant liar for batch diversity
+                xs = np.vstack([xs, u[None, :]])
+                ys = np.append(ys, ys.max())
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(request.experiment),
+                    parameter_assignments=space.decode(u),
+                )
+            )
+        return SuggestionReply(assignments=assignments)
+
+    def _acquire(self, xs, ys, space, rng, acq: str) -> np.ndarray:
+        gp = _GP(xs, ys)
+        n_cand = max(512, 64 * len(space))
+        cands = space.sample_uniform(rng, n_cand)
+        # include jittered copies of the best points (local exploitation)
+        best_k = xs[np.argsort(ys)[: min(5, len(ys))]]
+        local = np.clip(
+            np.repeat(best_k, 20, axis=0) + rng.normal(0, 0.02, (len(best_k) * 20, xs.shape[1])),
+            0.0,
+            1.0 - 1e-9,
+        )
+        cands = np.vstack([cands, local])
+        mu, sigma = gp.predict(cands)
+        y_best = ys.min()
+        if acq == "lcb":
+            score = -(mu - 1.96 * sigma)  # minimize LCB -> maximize negative
+        else:
+            imp = y_best - mu  # improvement for minimization
+            z = imp / sigma
+            if acq == "pi":
+                score = norm.cdf(z)
+            else:  # ei
+                score = imp * norm.cdf(z) + sigma * norm.pdf(z)
+        return cands[int(np.argmax(score))]

@@ -1,0 +1,210 @@
+"""CMA-ES — native implementation (standard (mu/mu_w, lambda)-CMA-ES with
+cumulative step-size adaptation and rank-one + rank-mu covariance updates).
+
+Capability match for the reference's optuna/goptuna ``cmaes`` services
+(pkg/suggestion/v1beta1/optuna/base_service.py, goptuna/service.go:39-215).
+Those restore sampler state from the trial history each call; here the same
+stateless-per-call contract is met by *generation replay*: every assignment is
+labeled ``cmaes-generation``, and on each request the full CMA-ES state
+(mean, sigma, C, evolution paths) is reconstructed by folding completed
+generations in order. The update consumes observed x-vectors re-encoded from
+assignments, so no sampling reproducibility is required.
+
+Numeric (int/double) parameters only, >= 2 dimensions — mirroring the optuna
+service's cmaes validation (service.py).
+
+Settings: sigma (initial step, default 0.3), popsize (default 4+floor(3 ln D)),
+restart_strategy (accepted, only "none"), random_state.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import TrialAssignment
+from .internal.search_space import MIN_GOAL, SearchSpace
+
+GENERATION_LABEL = "cmaes-generation"
+
+
+@dataclass
+class _CmaState:
+    dim: int
+    popsize: int
+    sigma: float
+    mean: np.ndarray
+    C: np.ndarray
+    p_sigma: np.ndarray
+    p_c: np.ndarray
+    generation: int = 0
+
+    @classmethod
+    def fresh(cls, dim: int, popsize: int, sigma0: float) -> "_CmaState":
+        return cls(
+            dim=dim,
+            popsize=popsize,
+            sigma=sigma0,
+            mean=np.full(dim, 0.5),
+            C=np.eye(dim),
+            p_sigma=np.zeros(dim),
+            p_c=np.zeros(dim),
+        )
+
+    # strategy constants
+    @property
+    def mu(self) -> int:
+        return self.popsize // 2
+
+    def weights(self) -> np.ndarray:
+        w = np.log(self.mu + 0.5) - np.log(np.arange(1, self.mu + 1))
+        return w / w.sum()
+
+    def update(self, xs: np.ndarray, fitnesses: np.ndarray) -> None:
+        """One generation update; xs [n, D] in sampling space, minimizing."""
+        d = self.dim
+        order = np.argsort(fitnesses)
+        mu = min(self.mu, len(order))
+        if mu == 0:
+            self.generation += 1
+            return
+        w = self.weights()[:mu]
+        w = w / w.sum()
+        mu_eff = 1.0 / (w**2).sum()
+
+        c_sigma = (mu_eff + 2) / (d + mu_eff + 5)
+        d_sigma = 1 + 2 * max(0.0, math.sqrt((mu_eff - 1) / (d + 1)) - 1) + c_sigma
+        c_c = (4 + mu_eff / d) / (d + 4 + 2 * mu_eff / d)
+        c_1 = 2 / ((d + 1.3) ** 2 + mu_eff)
+        c_mu = min(1 - c_1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
+        chi_n = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d * d))
+
+        old_mean = self.mean
+        ys = (xs[order[:mu]] - old_mean) / self.sigma  # [mu, D]
+        y_w = (w[:, None] * ys).sum(axis=0)
+        self.mean = old_mean + self.sigma * y_w
+
+        # C^{-1/2} via symmetric eigendecomposition
+        eigval, eigvec = np.linalg.eigh(self.C)
+        eigval = np.maximum(eigval, 1e-20)
+        inv_sqrt = eigvec @ np.diag(eigval**-0.5) @ eigvec.T
+
+        self.p_sigma = (1 - c_sigma) * self.p_sigma + math.sqrt(
+            c_sigma * (2 - c_sigma) * mu_eff
+        ) * (inv_sqrt @ y_w)
+        ps_norm = np.linalg.norm(self.p_sigma)
+        h_sigma = ps_norm / math.sqrt(
+            1 - (1 - c_sigma) ** (2 * (self.generation + 1))
+        ) < (1.4 + 2 / (d + 1)) * chi_n
+        self.p_c = (1 - c_c) * self.p_c + (
+            math.sqrt(c_c * (2 - c_c) * mu_eff) * y_w if h_sigma else 0.0
+        )
+
+        rank_mu = (w[:, None, None] * (ys[:, :, None] @ ys[:, None, :])).sum(axis=0)
+        delta_h = (1 - h_sigma) * c_c * (2 - c_c)
+        self.C = (
+            (1 - c_1 - c_mu) * self.C
+            + c_1 * (np.outer(self.p_c, self.p_c) + delta_h * self.C)
+            + c_mu * rank_mu
+        )
+        self.sigma *= math.exp((c_sigma / d_sigma) * (ps_norm / chi_n - 1))
+        self.sigma = float(np.clip(self.sigma, 1e-8, 1e4))
+        self.generation += 1
+
+    def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        eigval, eigvec = np.linalg.eigh(self.C)
+        eigval = np.maximum(eigval, 1e-20)
+        B, Dm = eigvec, np.sqrt(eigval)
+        z = rng.standard_normal((n, self.dim))
+        xs = self.mean[None, :] + self.sigma * (z * Dm[None, :]) @ B.T
+        return np.clip(xs, 0.0, 1.0 - 1e-9)
+
+
+@register
+class CMAES(Suggester):
+    name = "cmaes"
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        space = self.search_space(experiment)
+        if any(not p.is_numeric for p in space.params):
+            raise ValueError("cmaes supports only int/double parameters")
+        if len(space) < 2:
+            raise ValueError("cmaes requires at least 2 parameters")
+        s = self.settings(experiment)
+        if "sigma" in s and float(s["sigma"]) <= 0:
+            raise ValueError("sigma must be > 0")
+        if "popsize" in s and int(s["popsize"]) < 2:
+            raise ValueError("popsize must be >= 2")
+        if s.get("restart_strategy", "none") not in ("none", "ipop", "bipop"):
+            raise ValueError("restart_strategy must be none, ipop or bipop")
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        space = self.search_space(request.experiment)
+        s = self.settings(request.experiment)
+        dim = len(space)
+        popsize = int(s.get("popsize", 4 + int(3 * math.log(max(dim, 1)))))
+        sigma0 = float(s.get("sigma", 0.3))
+        seed = self.seed_from(request.experiment, salt=len(request.trials))
+        rng = np.random.default_rng(seed)
+        minimize = space.goal == MIN_GOAL
+
+        state = _CmaState.fresh(dim, popsize, sigma0)
+
+        # Replay completed generations in order.
+        by_gen: Dict[int, List] = {}
+        created_by_gen: Dict[int, int] = {}
+        for t in request.trials:
+            g = t.labels.get(GENERATION_LABEL)
+            if g is None:
+                continue
+            created_by_gen[int(g)] = created_by_gen.get(int(g), 0) + 1
+        for t in self.history(request):
+            g = t.labels.get(GENERATION_LABEL)
+            if g is None or t.objective is None:
+                continue
+            by_gen.setdefault(int(g), []).append(t)
+
+        gen = 0
+        while True:
+            created = created_by_gen.get(gen, 0)
+            done = by_gen.get(gen, [])
+            # A generation folds into the state once popsize of its trials have
+            # completed (failed/killed trials never complete, so also fold when
+            # every created trial in a full generation is terminal).
+            terminal_in_gen = sum(
+                1
+                for t in request.trials
+                if t.labels.get(GENERATION_LABEL) == str(gen) and t.is_terminal
+            )
+            if created >= popsize and (len(done) >= popsize or terminal_in_gen >= created):
+                if done:
+                    xs = space.encode_many([t.assignments for t in done])
+                    ys = np.array([t.objective for t in done])
+                    if not minimize:
+                        ys = -ys
+                    state.update(xs, ys)
+                else:
+                    state.generation += 1
+                gen += 1
+            else:
+                break
+
+        # Fill the current generation; spill into the next label once full
+        # (distribution is unchanged until the generation folds).
+        assignments: List[TrialAssignment] = []
+        slot = created_by_gen.get(gen, 0)
+        for x in state.sample(rng, request.current_request_number):
+            label_gen = gen + slot // popsize
+            slot += 1
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(request.experiment),
+                    parameter_assignments=space.decode(x),
+                    labels={GENERATION_LABEL: str(label_gen)},
+                )
+            )
+        return SuggestionReply(assignments=assignments)

@@ -1,0 +1,47 @@
+"""Grid search.
+
+reference: optuna service with GridSampler over the combinations produced by
+internal/search_space.py:44-64. Deterministic enumeration order; when the grid
+is exhausted the reply signals search end, which the experiment controller
+turns into reason SuggestionEndReached (status_util.go).
+"""
+
+from __future__ import annotations
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import TrialAssignment
+
+
+@register
+class GridSearch(Suggester):
+    name = "grid"
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        # Fails fast when a double parameter lacks a step — mirrors optuna
+        # service validation for grid (service.py per-algorithm checks).
+        space = self.search_space(experiment)
+        space.grid_combinations()
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        space = self.search_space(request.experiment)
+        combos = space.grid_combinations()
+
+        tried = {
+            tuple(sorted(t.assignments_dict().items())) for t in request.trials
+        }
+        assignments = []
+        for combo in combos:
+            if len(assignments) >= request.current_request_number:
+                break
+            key = tuple(sorted((a.name, a.value) for a in combo))
+            if key in tried:
+                continue
+            tried.add(key)
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(request.experiment),
+                    parameter_assignments=combo,
+                )
+            )
+        ended = len(assignments) < request.current_request_number
+        return SuggestionReply(assignments=assignments, search_ended=ended)

@@ -1,0 +1,250 @@
+"""Hyperband — successive-halving bracket scheduler.
+
+reference pkg/suggestion/v1beta1/hyperband/service.py:36-354. The algorithm is
+deliberately *stateless in process*: the entire bracket state (eta, s_max, r_l,
+b_l, r, n, current_s, current_i, resource_name, evaluating_trials) round-trips
+through the algorithm settings — the reply carries updated settings which the
+experiment controller merges back into the experiment spec and passes in again
+on the next call (suggestionclient.go algorithm-settings feedback;
+SURVEY.md §7 hard part 4).
+
+Protocol reproduced exactly:
+- current_s == -1  -> outer loop finished: empty reply, search ended.
+- evaluating_trials == 0 -> master bracket: n random configs with the budget
+  parameter (resource_name) set to r.
+- else -> child bracket: all evaluating_trials most recent trials must be
+  SUCCEEDED (otherwise wait); take top ceil(n_i/eta) by objective; copy their
+  params with budget r*eta^current_i.
+- after the last rung of a bracket (current_i == current_s), advance to
+  bracket current_s-1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import ParameterAssignment, ParameterType, TrialAssignment
+from ..api.status import Trial, TrialCondition
+from .internal.search_space import SearchSpace, MIN_GOAL
+
+
+class TrialsNotCompleted(Exception):
+    """Child bracket requested while evaluating trials are still running; the
+    controller waits and retries (the reference raises and relies on gRPC
+    retry, service.py:150-153)."""
+
+
+@dataclass
+class HyperBandParam:
+    """reference hyperband/parameter.py HyperBandParam (settings codec)."""
+
+    eta: float = 3
+    s_max: int = -1
+    r_l: float = -1
+    b_l: float = -1
+    r: float = -1
+    n: int = -1
+    current_s: int = -2
+    current_i: int = -1
+    resource_name: str = ""
+    evaluating_trials: int = 0
+
+    @classmethod
+    def from_settings(cls, settings: Dict[str, str]) -> "HyperBandParam":
+        p = cls()
+        for k, v in settings.items():
+            if k == "eta":
+                p.eta = float(v)
+            elif k == "r_l":
+                p.r_l = float(v)
+            elif k == "b_l":
+                p.b_l = float(v)
+            elif k == "n":
+                p.n = int(float(v))
+            elif k == "r":
+                p.r = float(v)
+            elif k == "current_s":
+                p.current_s = int(float(v))
+            elif k == "current_i":
+                p.current_i = int(float(v))
+            elif k == "s_max":
+                p.s_max = int(float(v))
+            elif k == "evaluating_trials":
+                p.evaluating_trials = int(float(v))
+            elif k == "resource_name":
+                p.resource_name = v
+        if p.current_s == -1:
+            return p
+        # defaulting of unset derived fields (parameter.py convert)
+        if p.eta <= 0:
+            p.eta = 3
+        if p.s_max < 0:
+            p.s_max = int(math.log(p.r_l) / math.log(p.eta))
+        if p.b_l < 0:
+            p.b_l = (p.s_max + 1) * p.r_l
+        if p.current_s < 0:
+            p.current_s = p.s_max
+        if p.current_i < 0:
+            p.current_i = 0
+        if p.n < 0:
+            p.n = int(math.ceil((p.s_max + 1) * (p.eta**p.current_s) / (p.current_s + 1)))
+        if p.r < 0:
+            p.r = p.r_l * p.eta ** (-p.current_s)
+        return p
+
+    def to_settings(self) -> Dict[str, str]:
+        return {
+            "eta": str(self.eta),
+            "s_max": str(self.s_max),
+            "r_l": str(self.r_l),
+            "b_l": str(self.b_l),
+            "r": str(self.r),
+            "n": str(self.n),
+            "current_s": str(self.current_s),
+            "current_i": str(self.current_i),
+            "resource_name": self.resource_name,
+            "evaluating_trials": str(self.evaluating_trials),
+        }
+
+    def advance_rung(self) -> None:
+        """_update_hbParameters."""
+        self.current_i += 1
+        if self.current_i > self.current_s:
+            self.advance_bracket()
+
+    def advance_bracket(self) -> None:
+        """_new_hbParameters."""
+        self.current_s -= 1
+        self.current_i = 0
+        if self.current_s >= 0:
+            self.n = int(
+                math.ceil((self.s_max + 1) * (self.eta**self.current_s) / (self.current_s + 1))
+            )
+            self.r = self.r_l * self.eta ** (-self.current_s)
+
+
+@register
+class HyperBand(Suggester):
+    name = "hyperband"
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        """reference service.py:205-243."""
+        s = self.settings(experiment)
+        if "r_l" not in s or "resource_name" not in s:
+            raise ValueError("r_l and resource_name must be set")
+        try:
+            r_l = float(s["r_l"])
+        except ValueError:
+            raise ValueError("r_l must be a positive float number")
+        if r_l < 0:
+            raise ValueError("r_l must be a positive float number")
+        eta = int(float(s.get("eta", 3)))
+        if eta <= 0:
+            eta = 3
+        s_max = int(math.log(r_l) / math.log(eta))
+        max_parallel = int(math.ceil(eta**s_max))
+        if (experiment.parallel_trial_count or 0) < max_parallel:
+            raise ValueError(f"parallelTrialCount must be not less than {max_parallel}")
+        if s["resource_name"] not in [p.name for p in experiment.parameters]:
+            raise ValueError("value of resource_name setting must be in parameters")
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        param = HyperBandParam.from_settings(self.settings(request.experiment))
+        if param.current_s < 0:
+            return SuggestionReply(search_ended=True)
+        param.n = max(request.current_request_number, 1)
+
+        space = self.search_space(request.experiment)
+        seed = self.seed_from(request.experiment, salt=len(request.trials))
+        rng = np.random.default_rng(seed)
+
+        if param.evaluating_trials == 0:
+            specs = self._master_bracket(request, space, param, rng)
+        else:
+            specs = self._child_bracket(request, space, param)
+
+        # bookkeeping (service.py _make_bracket tail)
+        if param.current_i < param.current_s:
+            param.evaluating_trials = len(specs)
+        else:
+            param.evaluating_trials = 0
+        if param.evaluating_trials == 0:
+            param.advance_bracket()
+
+        assignments = [
+            TrialAssignment(
+                name=self.make_trial_name(request.experiment),
+                parameter_assignments=pa,
+            )
+            for pa in specs
+        ]
+        return SuggestionReply(assignments=assignments, algorithm_settings=param.to_settings())
+
+    def _master_bracket(
+        self, request: SuggestionRequest, space: SearchSpace, param: HyperBandParam, rng
+    ) -> List[List[ParameterAssignment]]:
+        specs = []
+        budget = str(self._format_budget(space, param.resource_name, param.r))
+        for u in space.sample_uniform(rng, param.n):
+            pa = space.decode(u)
+            pa = [
+                ParameterAssignment(a.name, budget) if a.name == param.resource_name else a
+                for a in pa
+            ]
+            specs.append(pa)
+        return specs
+
+    def _child_bracket(
+        self, request: SuggestionRequest, space: SearchSpace, param: HyperBandParam
+    ) -> List[List[ParameterAssignment]]:
+        n_i = math.ceil(param.n * param.eta ** (-param.current_i))
+        top_n = int(math.ceil(n_i / param.eta))
+        param.advance_rung()
+        r_i = param.r * param.eta**param.current_i
+
+        # last `evaluating_trials` trials by start time must all be SUCCEEDED
+        trials = sorted(request.trials, key=lambda t: t.start_time or 0.0)
+        latest = trials[-param.evaluating_trials :] if param.evaluating_trials else trials
+        for t in latest:
+            if t.condition != TrialCondition.SUCCEEDED:
+                raise TrialsNotCompleted(
+                    f"trial {t.name} not completed yet for hyperband child bracket"
+                )
+
+        obj = request.experiment.objective
+        from ..db.store import objective_value
+
+        def value(t: Trial) -> float:
+            v = objective_value(t.observation, obj)
+            return v if v is not None else float("-inf")
+
+        reverse = space.goal != MIN_GOAL
+        top = sorted(latest, key=value, reverse=reverse)[:top_n]
+
+        budget = str(self._format_budget(space, param.resource_name, r_i))
+        specs = []
+        for t in top:
+            specs.append(
+                [
+                    ParameterAssignment(name, budget if name == param.resource_name else v)
+                    for name, v in t.assignments_dict().items()
+                ]
+            )
+        return specs
+
+    @staticmethod
+    def _format_budget(space: SearchSpace, resource_name: str, r: float) -> str:
+        """INT resources are truncated like the reference (int(param.r)); a
+        DOUBLE resource keeps its fractional budget."""
+        try:
+            p = space.param(resource_name)
+        except KeyError:
+            return str(int(r))
+        if p.is_numeric and p.type == ParameterType.DOUBLE:
+            return repr(float(r))
+        return str(int(r))

@@ -1,0 +1,56 @@
+"""Trial-history view passed to suggestion algorithms.
+
+reference pkg/suggestion/v1beta1/internal/trial.py: converts proto trials into
+the algorithm-facing representation and filters to completed
+(SUCCEEDED/EARLYSTOPPED) trials (trial.py:40-49). Here the source is
+katib_tpu.api.status.Trial records rather than protos, but the contract stays
+"full history passed on every call" (api.proto GetSuggestionsRequest) so the
+suggestion engine is stateless-per-call and restarts are cheap
+(SURVEY.md §7 hard part 7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ...api.spec import ObjectiveSpec
+from ...api.status import Trial, TrialCondition
+from ...db.store import objective_value
+
+
+@dataclass
+class ObservedTrial:
+    """One completed trial as seen by an algorithm."""
+
+    name: str
+    assignments: Dict[str, str]
+    objective: Optional[float]
+    additional_metrics: Dict[str, Optional[float]] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    condition: TrialCondition = TrialCondition.SUCCEEDED
+
+
+def completed_trials(
+    trials: Sequence[Trial], objective: ObjectiveSpec, include_early_stopped: bool = True
+) -> List[ObservedTrial]:
+    """Filter to trials usable as training data, reference trial.py:40-49
+    (convert uses SUCCEEDED + EARLYSTOPPED)."""
+    wanted = {TrialCondition.SUCCEEDED}
+    if include_early_stopped:
+        wanted.add(TrialCondition.EARLY_STOPPED)
+    out: List[ObservedTrial] = []
+    for t in trials:
+        if t.condition not in wanted:
+            continue
+        obj = objective_value(t.observation, objective)
+        out.append(
+            ObservedTrial(
+                name=t.name,
+                assignments=t.assignments_dict(),
+                objective=obj,
+                labels=dict(t.labels),
+                condition=t.condition,
+            )
+        )
+    return out

@@ -1,0 +1,454 @@
+"""ENAS — Efficient Neural Architecture Search via a REINFORCE-trained LSTM
+controller, re-designed in JAX.
+
+reference pkg/suggestion/v1beta1/nas/enas/{service.py:32-431, Controller.py,
+Operation.py, AlgorithmSettings.py}. Behavior matched:
+
+- search space: each NAS operation's parameter grid is expanded into a flat
+  list of concrete operations (Operation.py SearchSpace);
+- controller: single-layer LSTM (hidden 64) samples one operation per layer
+  plus, for layer > 0, a per-previous-layer skip-connection bit via additive
+  attention over previous hidden states (Controller.py _build_sampler);
+  logits are temperature-scaled (5.0) and tanh-bounded (2.25);
+- training: REINFORCE with reward = mean child validation metric (negated for
+  minimize) + entropy bonus (1e-5), an EMA baseline (decay 0.999), a
+  skip-density KL penalty toward skip_target (0.4) weighted 0.8, Adam 5e-5
+  for controller_train_steps (50) steps per suggestion round
+  (service.py:238-344, Controller.py build_trainer);
+- output: per-trial assignments ``architecture`` (nested arc list) and
+  ``nn_config`` (layer/op dictionary), JSON with single quotes
+  (service.py:346-395);
+- controller state checkpoints to the experiment directory between suggestion
+  rounds (the reference saves a TF checkpoint to ctrl_cache/,
+  service.py:277-279).
+
+The JAX re-design replaces the TF1 session graph with a pure
+sample-and-score function: sampling uses jax.random categoricals, and because
+log-probs of the *sampled* indices are computed from the same logits,
+jax.grad flows through the policy exactly as the reference's
+sparse_softmax_cross_entropy construction does. The layer loop is a static
+Python unroll under jit (num_layers is compile-time constant — XLA-friendly
+control flow, no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..base import Suggester, SuggestionReply, SuggestionRequest, register
+from ...api.spec import ExperimentSpec, NasConfig, ParameterAssignment, ParameterType, TrialAssignment
+from ...api.status import TrialCondition
+
+# reference AlgorithmSettings.py
+ENAS_DEFAULT_SETTINGS: Dict[str, Any] = {
+    "controller_hidden_size": 64,
+    "controller_temperature": 5.0,
+    "controller_tanh_const": 2.25,
+    "controller_entropy_weight": 1e-5,
+    "controller_baseline_decay": 0.999,
+    "controller_learning_rate": 5e-5,
+    "controller_skip_target": 0.4,
+    "controller_skip_weight": 0.8,
+    "controller_train_steps": 50,
+    "controller_log_every_steps": 10,
+}
+
+_SETTING_TYPES = {
+    "controller_hidden_size": int,
+    "controller_temperature": float,
+    "controller_tanh_const": float,
+    "controller_entropy_weight": float,
+    "controller_baseline_decay": float,
+    "controller_learning_rate": float,
+    "controller_skip_target": float,
+    "controller_skip_weight": float,
+    "controller_train_steps": int,
+    "controller_log_every_steps": int,
+}
+_NONE_ALLOWED = {
+    "controller_temperature",
+    "controller_tanh_const",
+    "controller_entropy_weight",
+    "controller_skip_weight",
+}
+_SETTING_RANGES = {
+    "controller_hidden_size": (1, float("inf")),
+    "controller_temperature": (0, float("inf")),
+    "controller_tanh_const": (0, float("inf")),
+    "controller_entropy_weight": (0.0, float("inf")),
+    "controller_baseline_decay": (0.0, 1.0),
+    "controller_learning_rate": (0.0, 1.0),
+    "controller_skip_target": (0.0, 1.0),
+    "controller_skip_weight": (0.0, float("inf")),
+    "controller_train_steps": (1, float("inf")),
+    "controller_log_every_steps": (1, float("inf")),
+}
+
+
+def parse_enas_settings(spec: ExperimentSpec) -> Dict[str, Any]:
+    settings = dict(ENAS_DEFAULT_SETTINGS)
+    for s in spec.algorithm.algorithm_settings:
+        if s.value == "None":
+            settings[s.name] = None
+        elif s.name in _SETTING_TYPES:
+            settings[s.name] = _SETTING_TYPES[s.name](s.value)
+    return settings
+
+
+def expand_operations(nas_config: NasConfig) -> List[Dict[str, Any]]:
+    """Flatten the operation parameter grids, reference Operation.py SearchSpace:
+    returns [{'opt_id', 'opt_type', 'opt_params'}, ...]."""
+    ops: List[Dict[str, Any]] = []
+    opt_id = 0
+    for op in nas_config.operations:
+        avail: Dict[str, List[Any]] = {}
+        for p in op.parameters:
+            fs = p.feasible_space
+            if p.parameter_type == ParameterType.CATEGORICAL:
+                avail[p.name] = list(fs.list or [])
+            elif p.parameter_type == ParameterType.INT:
+                avail[p.name] = list(
+                    range(int(fs.min), int(fs.max) + 1, int(fs.step or 1))
+                )
+            elif p.parameter_type == ParameterType.DOUBLE:
+                step = float(fs.step or 1.0)
+                vals = list(np.arange(float(fs.min), float(fs.max) + step, step))
+                if vals and vals[-1] > float(fs.max):
+                    vals = vals[:-1]
+                avail[p.name] = vals
+        keys, values = list(avail.keys()), list(avail.values())
+        for combo in itertools.product(*values):
+            ops.append(
+                {
+                    "opt_id": opt_id,
+                    "opt_type": op.operation_type,
+                    "opt_params": {k: v for k, v in zip(keys, combo)},
+                }
+            )
+            opt_id += 1
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# JAX controller
+# ---------------------------------------------------------------------------
+
+def _init_params(rng: jax.Array, num_ops: int, hidden: int) -> Dict[str, jax.Array]:
+    """Uniform(-0.01, 0.01) init, reference Controller.py _build_params."""
+    keys = jax.random.split(rng, 7)
+    u = lambda k, shape: jax.random.uniform(k, shape, minval=-0.01, maxval=0.01)
+    return {
+        "w_lstm": u(keys[0], (2 * hidden, 4 * hidden)),
+        "g_emb": u(keys[1], (1, hidden)),
+        "w_emb": u(keys[2], (num_ops, hidden)),
+        "w_soft": u(keys[3], (hidden, num_ops)),
+        "attn_w1": u(keys[4], (hidden, hidden)),
+        "attn_w2": u(keys[5], (hidden, hidden)),
+        "attn_v": u(keys[6], (hidden, 1)),
+    }
+
+
+def _lstm_step(x, c, h, w_lstm):
+    ifog = jnp.concatenate([x, h], axis=1) @ w_lstm
+    i, f, o, g = jnp.split(ifog, 4, axis=1)
+    c_next = jax.nn.sigmoid(i) * jnp.tanh(g) + jax.nn.sigmoid(f) * c
+    h_next = jax.nn.sigmoid(o) * jnp.tanh(c_next)
+    return c_next, h_next
+
+
+def _sample_and_score(
+    params: Dict[str, jax.Array],
+    rng: jax.Array,
+    num_layers: int,
+    temperature: Optional[float],
+    tanh_const: Optional[float],
+    skip_target: float,
+):
+    """One controller rollout. Returns (arc_flat, log_prob, entropy,
+    skip_penalty, skip_count). Mirrors Controller.py _build_sampler; the layer
+    loop unrolls at trace time (static num_layers)."""
+    hidden = params["g_emb"].shape[1]
+    c = jnp.zeros((1, hidden))
+    h = jnp.zeros((1, hidden))
+    inputs = params["g_emb"]
+    skip_targets = jnp.array([1.0 - skip_target, skip_target])
+
+    arc: List[jax.Array] = []
+    log_probs: List[jax.Array] = []
+    entropies: List[jax.Array] = []
+    skip_penalties: List[jax.Array] = []
+    skip_counts: List[jax.Array] = []
+    all_h: List[jax.Array] = []
+    all_h_w: List[jax.Array] = []
+
+    def shape_logits(logits):
+        if temperature is not None:
+            logits = logits / temperature
+        if tanh_const is not None:
+            logits = tanh_const * jnp.tanh(logits)
+        return logits
+
+    for layer_id in range(num_layers):
+        rng, k_op, k_skip = jax.random.split(rng, 3)
+
+        c, h = _lstm_step(inputs, c, h, params["w_lstm"])
+        logits = shape_logits(h @ params["w_soft"])  # [1, num_ops]
+        op = jax.random.categorical(k_op, logits[0])
+        logp = jax.nn.log_softmax(logits[0])[op]
+        # Sign convention follows the reference: "log_prob" is the
+        # cross-entropy (-log pi), so loss = log_prob * advantage descends
+        # toward higher-probability good actions (Controller.py:122-128).
+        log_probs.append((-logp)[None])
+        ent = -logp * jnp.exp(logp)
+        entropies.append(jax.lax.stop_gradient(ent))
+        arc.append(op[None])
+
+        inputs = params["w_emb"][op][None, :]
+        c, h = _lstm_step(inputs, c, h, params["w_lstm"])
+
+        if layer_id > 0:
+            prev_h_w = jnp.concatenate(all_h_w, axis=0)  # [layer_id, H]
+            query = jnp.tanh(h @ params["attn_w2"] + prev_h_w)
+            query = query @ params["attn_v"]  # [layer_id, 1]
+            skip_logits = shape_logits(jnp.concatenate([-query, query], axis=1))
+            skips = jax.random.categorical(k_skip, skip_logits)  # [layer_id]
+            lp = jax.nn.log_softmax(skip_logits)
+            sel = jnp.take_along_axis(lp, skips[:, None], axis=1)[:, 0]
+            log_probs.append((-sel).sum()[None])
+            ent = (-sel * jnp.exp(sel)).sum()
+            entropies.append(jax.lax.stop_gradient(ent)[None])
+
+            skip_prob = jax.nn.sigmoid(skip_logits)
+            kl = (skip_prob * jnp.log(skip_prob / skip_targets)).sum()
+            skip_penalties.append(kl)
+
+            arc.append(skips)
+            skips_f = skips.astype(jnp.float32)[None, :]  # [1, layer_id]
+            skip_counts.append(skips_f.sum())
+            inputs = (skips_f @ jnp.concatenate(all_h, axis=0)) / (1.0 + skips_f.sum())
+        else:
+            inputs = params["g_emb"]
+
+        all_h.append(h)
+        all_h_w.append(h @ params["attn_w1"])
+
+    arc_flat = jnp.concatenate([a.reshape(-1) for a in arc])
+    log_prob = jnp.concatenate([l.reshape(-1) for l in log_probs]).sum()
+    entropy = jnp.concatenate([e.reshape(-1) for e in entropies]).sum()
+    skip_penalty = jnp.stack(skip_penalties).mean() if skip_penalties else jnp.array(0.0)
+    skip_count = jnp.stack(skip_counts).sum() if skip_counts else jnp.array(0.0)
+    return arc_flat, log_prob, entropy, skip_penalty, skip_count
+
+
+@register
+class ENAS(Suggester):
+    name = "enas"
+
+    def __init__(self, state_dir: Optional[str] = None):
+        self.state_dir = state_dir
+        self._state: Optional[Dict[str, Any]] = None
+
+    def validate_algorithm_settings(self, experiment: ExperimentSpec) -> None:
+        """reference enas/service.py:163-231."""
+        nas = experiment.nas_config
+        if nas is None:
+            raise ValueError("enas requires nasConfig")
+        gc = nas.graph_config
+        if not gc.num_layers or gc.num_layers < 1:
+            raise ValueError("graphConfig.numLayers must be >= 1")
+        if not gc.input_sizes or not gc.output_sizes:
+            raise ValueError("graphConfig.inputSizes and outputSizes must be set")
+        if not nas.operations:
+            raise ValueError("nasConfig.operations must not be empty")
+        if not expand_operations(nas):
+            raise ValueError("nasConfig.operations expand to an empty search space")
+        for s in experiment.algorithm.algorithm_settings:
+            if s.name not in _SETTING_TYPES:
+                raise ValueError(f"unknown ENAS setting {s.name!r}")
+            if s.value == "None":
+                if s.name not in _NONE_ALLOWED:
+                    raise ValueError(f"setting {s.name} must not be None")
+                continue
+            try:
+                v = _SETTING_TYPES[s.name](s.value)
+            except ValueError:
+                raise ValueError(f"setting {s.name}={s.value!r} has wrong type")
+            lo, hi = _SETTING_RANGES[s.name]
+            if not (lo <= v <= hi):
+                raise ValueError(f"setting {s.name}={v} out of range [{lo}, {hi}]")
+
+    # ------------------------------------------------------------------
+
+    def _ckpt_path(self) -> Optional[str]:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, "enas_controller.pkl")
+
+    def _load_or_init(self, request: SuggestionRequest) -> Dict[str, Any]:
+        if self._state is not None:
+            return self._state
+        path = self._ckpt_path()
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                raw = pickle.load(f)
+            raw["params"] = jax.tree.map(jnp.asarray, raw["params"])
+            raw["opt_state"] = jax.tree.map(jnp.asarray, raw["opt_state"])
+            self._state = raw
+            return raw
+
+    # fresh state
+        spec = request.experiment
+        settings = parse_enas_settings(spec)
+        ops = expand_operations(spec.nas_config)
+        num_layers = int(spec.nas_config.graph_config.num_layers)
+        seed = self.seed_from(spec) or 0
+        rng = jax.random.PRNGKey(seed)
+        rng, init_key = jax.random.split(rng)
+        params = _init_params(init_key, len(ops), int(settings["controller_hidden_size"]))
+        tx = optax.adam(float(settings["controller_learning_rate"]))
+        self._state = {
+            "params": params,
+            "opt_state": tx.init(params),
+            "baseline": 0.0,
+            "rng": rng,
+            "step": 0,
+            "first_run": True,
+            "settings": settings,
+            "ops": ops,
+            "num_layers": num_layers,
+        }
+        return self._state
+
+    def _save(self) -> None:
+        path = self._ckpt_path()
+        if not path or self._state is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        raw = dict(self._state)
+        raw["params"] = jax.tree.map(np.asarray, raw["params"])
+        raw["opt_state"] = jax.tree.map(np.asarray, raw["opt_state"])
+        raw["rng"] = np.asarray(raw["rng"])
+        with open(path, "wb") as f:
+            pickle.dump(raw, f)
+
+    def _evaluation_result(self, request: SuggestionRequest) -> Optional[float]:
+        """Average objective over succeeded trials (service.py:400-431)."""
+        vals = [t.objective for t in self.history(request) if t.objective is not None]
+        if not vals:
+            return None
+        return float(sum(vals) / len(vals))
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        state = self._load_or_init(request)
+        settings = state["settings"]
+        num_layers = state["num_layers"]
+        num_trials = max(request.current_request_number, 0)
+        spec = request.experiment
+
+        sample_fn = jax.jit(
+            lambda p, k: _sample_and_score(
+                p,
+                k,
+                num_layers,
+                settings["controller_temperature"],
+                settings["controller_tanh_const"],
+                float(settings["controller_skip_target"]),
+            )
+        )
+
+        if not state["first_run"]:
+            result = self._evaluation_result(request)
+            if result is None:
+                # All spawned trials failed (service.py:289-301): no update.
+                pass
+            else:
+                if spec.objective.type.value == "minimize":
+                    result = -result
+                self._train_controller(state, sample_fn, float(result), settings)
+
+        candidates = []
+        for _ in range(num_trials):
+            state["rng"], k = jax.random.split(state["rng"])
+            arc_flat, *_ = sample_fn(state["params"], k)
+            candidates.append(np.asarray(arc_flat).tolist())
+        state["first_run"] = False
+        self._save()
+
+        # organize arc + nn_config (service.py:346-395)
+        gc = spec.nas_config.graph_config
+        assignments = []
+        for arc in candidates:
+            organized: List[List[int]] = []
+            record = 0
+            for layer in range(num_layers):
+                organized.append([int(v) for v in arc[record : record + layer + 1]])
+                record += layer + 1
+            nn_config: Dict[str, Any] = {
+                "num_layers": num_layers,
+                "input_sizes": gc.input_sizes,
+                "output_sizes": gc.output_sizes,
+                "embedding": {},
+            }
+            for layer in range(num_layers):
+                opt = organized[layer][0]
+                nn_config["embedding"][opt] = state["ops"][opt]
+            arc_str = json.dumps(organized).replace('"', "'")
+            nn_config_str = json.dumps(nn_config).replace('"', "'")
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(spec),
+                    parameter_assignments=[
+                        ParameterAssignment("architecture", arc_str),
+                        ParameterAssignment("nn_config", nn_config_str),
+                    ],
+                )
+            )
+        return SuggestionReply(assignments=assignments)
+
+    def _train_controller(self, state, sample_fn, result: float, settings) -> None:
+        """REINFORCE update loop (Controller.py build_trainer +
+        service.py:310-344)."""
+        tx = optax.adam(float(settings["controller_learning_rate"]))
+        ent_w = settings["controller_entropy_weight"]
+        skip_w = settings["controller_skip_weight"]
+        decay = float(settings["controller_baseline_decay"])
+        num_layers = state["num_layers"]
+        temperature = settings["controller_temperature"]
+        tanh_const = settings["controller_tanh_const"]
+        skip_target = float(settings["controller_skip_target"])
+
+        def loss_fn(params, key, baseline):
+            _, log_prob, entropy, skip_penalty, _ = _sample_and_score(
+                params, key, num_layers, temperature, tanh_const, skip_target
+            )
+            reward = result + (float(ent_w) * entropy if ent_w is not None else 0.0)
+            new_baseline = baseline - (1.0 - decay) * (baseline - reward)
+            loss = log_prob * (reward - new_baseline)
+            if skip_w is not None:
+                loss = loss + float(skip_w) * skip_penalty
+            return loss, new_baseline
+
+        @jax.jit
+        def train_step(params, opt_state, key, baseline):
+            (loss, new_baseline), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, key, baseline
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_baseline, loss
+
+        params, opt_state, baseline = state["params"], state["opt_state"], state["baseline"]
+        for _ in range(int(settings["controller_train_steps"])):
+            state["rng"], k = jax.random.split(state["rng"])
+            params, opt_state, baseline, _ = train_step(params, opt_state, k, baseline)
+            state["step"] += 1
+        state["params"], state["opt_state"], state["baseline"] = params, opt_state, float(baseline)

@@ -1,0 +1,279 @@
+"""Population Based Training.
+
+reference pkg/suggestion/v1beta1/pbt/service.py:39-409. Faithful capability
+match of the job-queue design:
+
+- population seeded from the search space (step-quantized sample lists);
+- trials carry ``pbt.katib-tpu/generation`` and ``pbt.katib-tpu/parent``
+  labels; the suggester overrides trial names with its own uids so checkpoint
+  directories can be pre-created before the trial starts;
+- when a generation's sample pool exceeds the population size, it is segmented
+  at the truncation quantiles: bottom trials are replaced by *exploit* jobs
+  (copy a top performer's params AND its checkpoint directory), the rest
+  become *explore* jobs (each param perturbed x0.8/x1.2, or resampled with
+  ``resample_probability``);
+- killed/failed trials are re-queued with the same params/parent;
+- checkpoint lineage lives under ``checkpoint_root/<trial-uid>`` — the
+  TPU-native replacement for the suggestion PVC (``/opt/katib/data/<exp>``),
+  copied with shutil.copytree on exploit exactly like service.py:260-268. The
+  trial runtime exposes this directory as ``ctx.checkpoint_dir`` (orbax target).
+
+PBT is inherently stateful (the reference keeps an in-memory queue in the
+per-experiment service pod); here the suggester instance is per-experiment
+(the controller keeps one Suggester per experiment, mirroring the
+deployment-per-experiment topology) and state is additionally reconstructible
+from trial labels on restart.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import ParameterAssignment, TrialAssignment
+from ..api.status import Trial, TrialCondition
+from .internal.search_space import HyperParameter, SearchSpace, MIN_GOAL
+
+GENERATION_LABEL = "pbt.katib-tpu/generation"
+PARENT_LABEL = "pbt.katib-tpu/parent"
+
+_REQUIRED_SETTINGS = ("suggestion_trial_dir", "n_population", "truncation_threshold")
+
+
+class _Sampler:
+    """Per-parameter sample/perturb, reference HyperParameterSampler."""
+
+    def __init__(self, hp: HyperParameter, rng: np.random.Generator):
+        self.hp = hp
+        self.rng = rng
+        if hp.is_numeric:
+            step = hp.step if hp.step else (hp.max - hp.min) / 100.0 or 1.0
+            n = int(np.floor((hp.max - hp.min) / step + 1e-9)) + 1
+            self.values = [hp.min + i * step for i in range(max(n, 1))]
+        else:
+            self.values = list(hp.choices)
+
+    def _fmt(self, v) -> str:
+        if not self.hp.is_numeric:
+            return str(v)
+        if self.hp.type.value == "int":
+            return str(int(round(float(v))))
+        return repr(float(v))
+
+    def sample(self) -> str:
+        return self._fmt(self.values[self.rng.integers(0, len(self.values))])
+
+    def perturb(self, value: str) -> str:
+        if self.hp.is_numeric:
+            factor = float(self.rng.choice([0.8, 1.2]))
+            v = float(value) * factor
+            v = max(self.hp.min, min(self.hp.max, v))
+            return self._fmt(v)
+        try:
+            idx = self.values.index(value) + int(self.rng.choice([-1, 1]))
+        except ValueError:
+            idx = 0
+        return str(self.values[idx % len(self.values)])
+
+
+@dataclass
+class _PbtJob:
+    uid: str
+    params: Dict[str, str]
+    generation: int
+    parent: Optional[str] = None
+    metric_value: Optional[float] = None
+
+
+@register
+class PBT(Suggester):
+    name = "pbt"
+
+    def __init__(self, checkpoint_root: Optional[str] = None):
+        self.checkpoint_root = checkpoint_root
+        self._initialized = False
+        self.pending: List[_PbtJob] = []
+        self.running: Dict[str, _PbtJob] = {}
+        self.completed: Dict[str, _PbtJob] = {}
+        self.sample_pool: Dict[str, List[str]] = {"previous": [], "current": []}
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        """reference service.py:47-76 (suggestion_trial_dir is supplied by the
+        framework here, so only the numeric settings are required)."""
+        s = self.settings(experiment)
+        missing = [k for k in ("n_population", "truncation_threshold") if k not in s]
+        if missing:
+            raise ValueError(f"Required params missing: {', '.join(missing)}")
+        if int(s["n_population"]) < 5:
+            raise ValueError("Param(n_population) should be >= 5")
+        if not 0 <= float(s["truncation_threshold"]) <= 1:
+            raise ValueError("Param(truncation_threshold) should be between 0 and 1, inclusive")
+        if "resample_probability" in s and not 0 <= float(s["resample_probability"]) <= 1:
+            raise ValueError("Param(resample_probability) should be between 0 and 1")
+
+    # ------------------------------------------------------------------
+
+    def _init(self, request: SuggestionRequest) -> None:
+        if self._initialized:
+            return
+        s = self.settings(request.experiment)
+        self.population_size = int(s["n_population"])
+        self.truncation_threshold = float(s["truncation_threshold"])
+        self.resample_probability = (
+            float(s["resample_probability"]) if "resample_probability" in s else None
+        )
+        self.rng = np.random.default_rng(self.seed_from(request.experiment))
+        space = self.search_space(request.experiment)
+        self.metric_scale = -1.0 if space.goal == MIN_GOAL else 1.0
+        self.samplers = [_Sampler(p, self.rng) for p in space.params]
+        self.experiment_name = request.experiment.name
+        if self.checkpoint_root is None:
+            self.checkpoint_root = s.get(
+                "suggestion_trial_dir",
+                os.path.join("/tmp", "katib-tpu-pbt", self.experiment_name),
+            )
+        os.makedirs(self.checkpoint_root, exist_ok=True)
+        self._initialized = True
+        self._seed_from_base(self.population_size)
+
+    def _seed_from_base(self, count: int) -> None:
+        for _ in range(count):
+            self._append({s.hp.name: s.sample() for s in self.samplers}, generation=0)
+
+    def _append(self, params: Dict[str, str], generation: int, parent: Optional[str] = None) -> str:
+        job = _PbtJob(
+            uid=f"{self.experiment_name}-{uuid.uuid4().hex[:8]}",
+            params=dict(params),
+            generation=generation,
+            parent=parent,
+        )
+        self.pending.append(job)
+        trial_dir = os.path.join(self.checkpoint_root, job.uid)
+        if os.path.isdir(trial_dir):
+            shutil.rmtree(trial_dir)
+        if parent is None:
+            os.makedirs(trial_dir, exist_ok=True)
+        else:
+            # checkpoint lineage: exploit inherits the parent's checkpoints
+            # (service.py:260-268)
+            parent_dir = os.path.join(self.checkpoint_root, parent)
+            if os.path.isdir(parent_dir):
+                shutil.copytree(parent_dir, trial_dir)
+            else:
+                os.makedirs(trial_dir, exist_ok=True)
+        return job.uid
+
+    def _update(self, trial: Trial) -> None:
+        """Fold a trial result into the queue (service.py update)."""
+        if trial.condition in (
+            TrialCondition.CREATED,
+            TrialCondition.PENDING,
+            TrialCondition.RUNNING,
+        ):
+            return
+        if trial.name in self.completed or trial.name not in self.running:
+            return
+        job = self.running.pop(trial.name)
+        from ..db.store import objective_value
+
+        v = objective_value(trial.observation, self._objective)
+        job.metric_value = self.metric_scale * v if v is not None else None
+        self.completed[job.uid] = job
+
+        if trial.condition in (TrialCondition.KILLED, TrialCondition.FAILED):
+            # retry with same params/parent (service.py:303-323)
+            self._append(job.params, generation=job.generation, parent=job.parent)
+            return
+        if job.metric_value is not None:
+            self.sample_pool["current"].append(job.uid)
+
+    def _segment(self, pool: str, count: int):
+        """Truncation segmentation (service.py _segment_sample_pool)."""
+        jobs = [self.completed[uid] for uid in self.sample_pool[pool]]
+        values = np.array([j.metric_value for j in jobs])
+        lo, hi = np.quantile(values, (self.truncation_threshold, 1 - self.truncation_threshold))
+        exploit, explore, upper = [], [], []
+        for j in jobs:
+            if j.metric_value < lo:
+                exploit.append(j.uid)
+            else:
+                explore.append(j.uid)
+                if j.metric_value >= hi:
+                    upper.append(j.uid)
+        self.rng.shuffle(exploit)
+        self.rng.shuffle(explore)
+        exploit = exploit[: int(count * self.truncation_threshold)]
+        explore = explore[: count - len(exploit)]
+        return exploit, explore, upper
+
+    def _generate(self, min_count: int) -> None:
+        """service.py generate."""
+        if len(self.sample_pool["current"]) <= self.population_size:
+            if len(self.sample_pool["previous"]) == 0:
+                self._seed_from_base(min_count)
+                return
+            exploit, explore, upper = self._segment("previous", min_count)
+        else:
+            exploit, explore, upper = self._segment("current", self.population_size)
+            self.sample_pool["previous"] = self.sample_pool["current"]
+            self.sample_pool["current"] = []
+
+        if not upper:
+            upper = explore or exploit
+        replacements = self.rng.choice(upper, len(exploit)) if exploit else []
+        for uid, repl in zip(exploit, replacements):
+            job = self.completed[uid]
+            self._append(
+                self.completed[repl].params, generation=job.generation + 1, parent=job.uid
+            )
+        for uid in explore:
+            job = self.completed[uid]
+            params = {}
+            for s in self.samplers:
+                if self.resample_probability is None:
+                    params[s.hp.name] = s.perturb(job.params[s.hp.name])
+                elif self.rng.random() < self.resample_probability:
+                    params[s.hp.name] = s.sample()
+                else:
+                    params[s.hp.name] = job.params[s.hp.name]
+            self._append(params, generation=job.generation + 1, parent=job.uid)
+
+    # ------------------------------------------------------------------
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        self._objective = request.experiment.objective
+        self._init(request)
+        for t in request.trials:
+            self._update(t)
+        n = request.current_request_number
+        if len(self.pending) < n:
+            self._generate(n)
+        assignments: List[TrialAssignment] = []
+        for _ in range(n):
+            if not self.pending:
+                break
+            job = self.pending.pop(0)
+            self.running[job.uid] = job
+            labels = {GENERATION_LABEL: str(job.generation)}
+            if job.parent is not None:
+                labels[PARENT_LABEL] = job.parent
+            assignments.append(
+                TrialAssignment(
+                    name=job.uid,  # PBT overrides trial names with its uids
+                    parameter_assignments=[
+                        ParameterAssignment(k, v) for k, v in job.params.items()
+                    ],
+                    labels=labels,
+                )
+            )
+        return SuggestionReply(assignments=assignments)
+
+    def checkpoint_dir(self, trial_name: str) -> str:
+        assert self.checkpoint_root is not None
+        return os.path.join(self.checkpoint_root, trial_name)

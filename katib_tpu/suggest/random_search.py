@@ -1,0 +1,47 @@
+"""Random search.
+
+reference: hyperopt-random service (pkg/suggestion/v1beta1/hyperopt/
+base_service.py with algorithm_name="random") — uniform sampling over the
+feasible space, honoring uniform/logUniform distributions and int/step
+quantization via the shared unit-cube transforms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import TrialAssignment
+
+
+@register
+class RandomSearch(Suggester):
+    name = "random"
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        space = self.search_space(request.experiment)
+        seed = self.seed_from(request.experiment, salt=len(request.trials))
+        rng = np.random.default_rng(seed)
+
+        seen = {
+            tuple(sorted(t.assignments_dict().items())) for t in request.trials
+        }
+        assignments = []
+        attempts = 0
+        while len(assignments) < request.current_request_number:
+            u = space.sample_uniform(rng, 1)[0]
+            pa = space.decode(u)
+            key = tuple(sorted((a.name, a.value) for a in pa))
+            attempts += 1
+            # Avoid exact duplicates while the space has room; give up after a
+            # bounded number of retries (tiny discrete spaces).
+            if key in seen and attempts < 100 * request.current_request_number:
+                continue
+            seen.add(key)
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(request.experiment),
+                    parameter_assignments=pa,
+                )
+            )
+        return SuggestionReply(assignments=assignments)

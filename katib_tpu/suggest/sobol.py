@@ -1,0 +1,38 @@
+"""Sobol quasi-random search.
+
+Capability match for the reference's goptuna ``sobol`` service
+(pkg/suggestion/v1beta1/goptuna/service.go with sobol sampler). Uses scipy's
+scrambled Sobol sequence; the sequence index advances by the number of trials
+already created, so successive stateless calls continue the same
+low-discrepancy stream.
+"""
+
+from __future__ import annotations
+
+from scipy.stats import qmc
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import TrialAssignment
+
+
+@register
+class SobolSearch(Suggester):
+    name = "sobol"
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        space = self.search_space(request.experiment)
+        seed = self.seed_from(request.experiment) or 0
+        sampler = qmc.Sobol(d=len(space), scramble=True, seed=seed)
+        skip = len(request.trials)
+        if skip:
+            sampler.fast_forward(skip)
+        n = request.current_request_number
+        points = sampler.random(n)
+        assignments = [
+            TrialAssignment(
+                name=self.make_trial_name(request.experiment),
+                parameter_assignments=space.decode(u),
+            )
+            for u in points
+        ]
+        return SuggestionReply(assignments=assignments)

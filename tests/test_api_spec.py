@@ -1,0 +1,210 @@
+"""Spec round-trip, defaulting, and validation tests.
+
+Models reference test files defaults_test.go and validator_test.go
+(test strategy SURVEY.md §4 tier 1).
+"""
+
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    MetricStrategyType,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    ResumePolicy,
+    TrialParameterSpec,
+    TrialTemplate,
+    ValidationError,
+    set_defaults,
+    validate_experiment,
+)
+from katib_tpu.api.status import Experiment, ExperimentCondition, ExperimentReason
+
+
+def make_spec(**kw) -> ExperimentSpec:
+    spec = ExperimentSpec(
+        name=kw.pop("name", "test-exp"),
+        parameters=kw.pop(
+            "parameters",
+            [
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.1")),
+                ParameterSpec("units", ParameterType.INT, FeasibleSpace(min="8", max="64")),
+                ParameterSpec("opt", ParameterType.CATEGORICAL, FeasibleSpace(list=["sgd", "adam"])),
+            ],
+        ),
+        objective=kw.pop(
+            "objective",
+            ObjectiveSpec(type=ObjectiveType.MAXIMIZE, goal=0.99, objective_metric_name="accuracy"),
+        ),
+        algorithm=kw.pop("algorithm", AlgorithmSpec(algorithm_name="random")),
+        trial_template=kw.pop("trial_template", TrialTemplate(function=lambda a, ctx: None)),
+        max_trial_count=kw.pop("max_trial_count", 6),
+        **kw,
+    )
+    return spec
+
+
+class TestDefaults:
+    def test_parallel_and_resume_defaults(self):
+        spec = set_defaults(make_spec())
+        assert spec.parallel_trial_count == 3  # experiment_defaults.go DefaultTrialParallelCount
+        assert spec.resume_policy == ResumePolicy.NEVER
+
+    def test_metric_strategy_defaults_maximize(self):
+        spec = make_spec()
+        spec.objective.additional_metric_names = ["loss"]
+        set_defaults(spec)
+        assert spec.objective.strategy_for("accuracy") == MetricStrategyType.MAX
+        assert spec.objective.strategy_for("loss") == MetricStrategyType.MAX
+
+    def test_metric_strategy_defaults_minimize(self):
+        spec = make_spec(
+            objective=ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss")
+        )
+        set_defaults(spec)
+        assert spec.objective.strategy_for("loss") == MetricStrategyType.MIN
+
+    def test_explicit_strategy_not_overridden(self):
+        from katib_tpu.api import MetricStrategy
+
+        spec = make_spec()
+        spec.objective.metric_strategies = [
+            MetricStrategy(name="accuracy", value=MetricStrategyType.LATEST)
+        ]
+        set_defaults(spec)
+        assert spec.objective.strategy_for("accuracy") == MetricStrategyType.LATEST
+
+
+class TestValidation:
+    def test_valid_spec_passes(self):
+        validate_experiment(set_defaults(make_spec()))
+
+    def test_bad_name(self):
+        with pytest.raises(ValidationError, match="name"):
+            validate_experiment(set_defaults(make_spec(name="Bad_Name")))
+
+    def test_budget_rules(self):
+        with pytest.raises(ValidationError, match="maxTrialCount"):
+            validate_experiment(set_defaults(make_spec(max_trial_count=0)))
+        with pytest.raises(ValidationError, match="parallelTrialCount"):
+            spec = make_spec(max_trial_count=2)
+            spec.parallel_trial_count = 5
+            validate_experiment(spec)
+        with pytest.raises(ValidationError, match="maxFailedTrialCount"):
+            spec = set_defaults(make_spec(max_trial_count=3))
+            spec.max_failed_trial_count = 4
+            validate_experiment(spec)
+
+    def test_objective_required(self):
+        spec = set_defaults(make_spec(objective=ObjectiveSpec()))
+        with pytest.raises(ValidationError, match="objective"):
+            validate_experiment(spec)
+
+    def test_double_param_rejects_list(self):
+        spec = set_defaults(
+            make_spec(
+                parameters=[
+                    ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(list=["1", "2"]))
+                ]
+            )
+        )
+        with pytest.raises(ValidationError, match="list is not supported"):
+            validate_experiment(spec)
+
+    def test_categorical_param_rejects_minmax(self):
+        spec = set_defaults(
+            make_spec(
+                parameters=[
+                    ParameterSpec("opt", ParameterType.CATEGORICAL, FeasibleSpace(min="0", max="1"))
+                ]
+            )
+        )
+        with pytest.raises(ValidationError, match="not supported"):
+            validate_experiment(spec)
+
+    def test_unknown_algorithm(self):
+        spec = set_defaults(make_spec())
+        with pytest.raises(ValidationError, match="unknown algorithm"):
+            validate_experiment(spec, known_algorithms={"grid", "tpe"})
+
+    def test_template_placeholder_consistency(self):
+        # dangling placeholder: template uses a parameter with no trialParameters entry
+        tt = TrialTemplate(
+            command=["python", "train.py", "--lr=${trialParameters.learningRate}"],
+            trial_parameters=[],
+        )
+        spec = set_defaults(make_spec(trial_template=tt))
+        with pytest.raises(ValidationError, match="learningRate"):
+            validate_experiment(spec)
+
+        # consistent template passes
+        tt = TrialTemplate(
+            command=["python", "train.py", "--lr=${trialParameters.learningRate}"],
+            trial_parameters=[TrialParameterSpec(name="learningRate", reference="lr")],
+        )
+        validate_experiment(set_defaults(make_spec(trial_template=tt)))
+
+    def test_trial_parameter_reference_must_exist(self):
+        tt = TrialTemplate(
+            command=["python", "--x=${trialParameters.x}"],
+            trial_parameters=[TrialParameterSpec(name="x", reference="nonexistent")],
+        )
+        spec = set_defaults(make_spec(trial_template=tt))
+        with pytest.raises(ValidationError, match="not found in search space"):
+            validate_experiment(spec)
+
+    def test_restart_only_budgets_editable(self):
+        old_spec = set_defaults(make_spec(trial_template=TrialTemplate(command=["true"])))
+        old = Experiment(spec=old_spec)
+        old.status.set_condition(
+            ExperimentCondition.SUCCEEDED, ExperimentReason.MAX_TRIALS_REACHED
+        )
+        old.status.trials = 6
+
+        # Never resume policy -> not restartable
+        new_spec = ExperimentSpec.from_json(old_spec.to_json())
+        new_spec.max_trial_count = 10
+        with pytest.raises(ValidationError, match="restarted"):
+            validate_experiment(new_spec, old=old)
+
+        # LongRunning + budget raise -> OK
+        old.spec.resume_policy = ResumePolicy.LONG_RUNNING
+        new_spec = ExperimentSpec.from_json(old.spec.to_json())
+        new_spec.max_trial_count = 10
+        validate_experiment(new_spec, old=old)
+
+        # editing non-budget field -> rejected
+        new_spec2 = ExperimentSpec.from_json(old.spec.to_json())
+        new_spec2.max_trial_count = 10
+        new_spec2.algorithm.algorithm_name = "tpe"
+        with pytest.raises(ValidationError, match="editable"):
+            validate_experiment(new_spec2, old=old)
+
+
+class TestRoundTrip:
+    def test_spec_json_roundtrip(self):
+        spec = set_defaults(
+            make_spec(trial_template=TrialTemplate(command=["python", "t.py"]))
+        )
+        again = ExperimentSpec.from_json(spec.to_json())
+        assert again.to_json() == spec.to_json()
+
+    def test_trial_roundtrip(self):
+        from katib_tpu.api import ParameterAssignment, Trial, TrialCondition
+
+        t = Trial(
+            name="exp-abc123",
+            experiment_name="exp",
+            parameter_assignments=[ParameterAssignment("lr", "0.05")],
+        )
+        t.set_condition(TrialCondition.RUNNING)
+        t.set_condition(TrialCondition.SUCCEEDED)
+        d = t.to_dict()
+        again = Trial.from_dict(d)
+        assert again.is_succeeded
+        assert again.assignments_dict() == {"lr": "0.05"}
+        assert again.start_time is not None and again.completion_time is not None

@@ -1,0 +1,213 @@
+"""End-to-end experiment tests on in-process trials.
+
+Models the reference's e2e verifier assertions
+(test/e2e/v1beta1/scripts/gh-actions/run-e2e-experiment.py:17-120):
+- optimal-trial metrics exist;
+- MaxTrialsReached  => completed trial count == maxTrialCount;
+- goal-reached      => best metric beats goal;
+- suggestion state cleanup per resume policy.
+"""
+
+import math
+
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    EarlyStoppingSpec,
+    ExperimentReason,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.controller.experiment import ExperimentController
+
+
+def quadratic_objective(assignments, ctx):
+    """Maximize -((x-0.3)^2) - (y-0.7)^2: optimum at (0.3, 0.7)."""
+    x = float(assignments["x"])
+    y = float(assignments["y"])
+    value = -((x - 0.3) ** 2) - (y - 0.7) ** 2
+    ctx.report(objective=value)
+    return None
+
+
+def make_spec(name, algorithm="random", max_trials=6, parallel=3, goal=None, settings=None):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0")),
+            ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0")),
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, goal=goal, objective_metric_name="objective"
+        ),
+        algorithm=AlgorithmSpec(
+            algorithm_name=algorithm,
+            algorithm_settings=[AlgorithmSetting(k, str(v)) for k, v in (settings or {}).items()],
+        ),
+        trial_template=TrialTemplate(function=quadratic_objective),
+        max_trial_count=max_trials,
+        parallel_trial_count=parallel,
+    )
+
+
+@pytest.fixture
+def controller(tmp_path):
+    c = ExperimentController(root_dir=str(tmp_path), devices=list(range(4)))
+    yield c
+    c.close()
+
+
+class TestRandomSearchE2E:
+    def test_max_trials_reached(self, controller):
+        spec = make_spec("random-e2e", max_trials=6, parallel=3)
+        controller.create_experiment(spec)
+        exp = controller.run("random-e2e", timeout=60)
+
+        assert exp.status.is_succeeded
+        assert exp.status.reason == ExperimentReason.MAX_TRIALS_REACHED
+        # run-e2e-experiment.py: MaxTrialsReached => completed == maxTrialCount
+        assert exp.status.trials_succeeded == 6
+        opt = exp.status.current_optimal_trial
+        assert opt.best_trial_name
+        m = opt.observation.metric("objective")
+        assert m is not None and float(m.max) <= 0.0
+        assert {a.name for a in opt.parameter_assignments} == {"x", "y"}
+
+    def test_goal_reached(self, controller):
+        spec = make_spec("goal-e2e", max_trials=50, parallel=4, goal=-0.5)
+        controller.create_experiment(spec)
+        exp = controller.run("goal-e2e", timeout=120)
+        assert exp.status.is_succeeded
+        assert exp.status.reason == ExperimentReason.GOAL_REACHED
+        best = float(exp.status.current_optimal_trial.observation.metric("objective").max)
+        assert best >= -0.5
+
+    def test_parameter_values_in_range(self, controller):
+        spec = make_spec("range-e2e", max_trials=4, parallel=2)
+        controller.create_experiment(spec)
+        controller.run("range-e2e", timeout=60)
+        for trial in controller.state.list_trials("range-e2e"):
+            d = trial.assignments_dict()
+            assert 0.0 <= float(d["x"]) <= 1.0
+            assert 0.0 <= float(d["y"]) <= 1.0
+
+
+class TestGridSearchE2E:
+    def test_grid_exhaustion_ends_search(self, controller):
+        spec = ExperimentSpec(
+            name="grid-e2e",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0", step="0.5")),
+                ParameterSpec("opt", ParameterType.CATEGORICAL, FeasibleSpace(list=["a", "b"])),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"),
+            algorithm=AlgorithmSpec(algorithm_name="grid"),
+            trial_template=TrialTemplate(
+                function=lambda a, ctx: ctx.report(objective=float(a["x"]))
+            ),
+            max_trial_count=50,  # more than the 6 grid points
+            parallel_trial_count=3,
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("grid-e2e", timeout=60)
+        assert exp.status.is_succeeded
+        assert exp.status.reason == ExperimentReason.SUGGESTION_END_REACHED
+        assert exp.status.trials_succeeded == 6  # 3 x-values * 2 categories
+        # every grid point visited exactly once
+        seen = {
+            tuple(sorted(t.assignments_dict().items()))
+            for t in controller.state.list_trials("grid-e2e")
+        }
+        assert len(seen) == 6
+
+
+class TestFailureHandling:
+    def test_max_failed_trials(self, controller):
+        def failing(assignments, ctx):
+            raise RuntimeError("boom")
+
+        spec = make_spec("fail-e2e", max_trials=10, parallel=2)
+        spec.trial_template = TrialTemplate(function=failing)
+        spec.max_failed_trial_count = 3
+        controller.create_experiment(spec)
+        exp = controller.run("fail-e2e", timeout=60)
+        assert exp.status.condition.value == "Failed"
+        assert exp.status.reason == ExperimentReason.MAX_FAILED_TRIALS_REACHED
+        assert exp.status.trials_failed >= 3
+
+    def test_metrics_unavailable(self, controller):
+        def silent(assignments, ctx):
+            return None  # never reports
+
+        spec = make_spec("silent-e2e", max_trials=4, parallel=2)
+        spec.trial_template = TrialTemplate(function=silent)
+        spec.max_failed_trial_count = 2
+        controller.create_experiment(spec)
+        exp = controller.run("silent-e2e", timeout=60)
+        # metrics-unavailable counts toward failed budget (status_util.go:204)
+        assert exp.status.condition.value == "Failed"
+        assert exp.status.trials_metrics_unavailable >= 2
+
+
+class TestTPEE2E:
+    def test_tpe_improves(self, controller):
+        spec = make_spec(
+            "tpe-e2e", algorithm="tpe", max_trials=14, parallel=2,
+            settings={"n_startup_trials": 6, "random_state": 7},
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("tpe-e2e", timeout=120)
+        assert exp.status.is_succeeded
+        assert exp.status.trials_succeeded == 14
+        best = float(exp.status.current_optimal_trial.observation.metric("objective").max)
+        assert best > -0.6  # sanity: not worse than prior-free random guessing
+
+
+class TestBayesOptE2E:
+    def test_gp_bo(self, controller):
+        spec = make_spec(
+            "bo-e2e", algorithm="bayesianoptimization", max_trials=12, parallel=2,
+            settings={"n_initial_points": 6, "random_state": 5},
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("bo-e2e", timeout=180)
+        assert exp.status.is_succeeded
+        assert exp.status.trials_succeeded == 12
+
+
+class TestSubprocessTrialE2E:
+    def test_command_template_with_stdout_collector(self, controller):
+        from katib_tpu.api import TrialParameterSpec
+
+        spec = ExperimentSpec(
+            name="subproc-e2e",
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="1.0")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+            algorithm=AlgorithmSpec(algorithm_name="random"),
+            trial_template=TrialTemplate(
+                command=[
+                    "python",
+                    "-c",
+                    "import sys; lr=float('${trialParameters.learningRate}'); "
+                    "print(f'score={1.0 - (lr - 0.5)**2}')",
+                ],
+                trial_parameters=[TrialParameterSpec(name="learningRate", reference="lr")],
+            ),
+            max_trial_count=3,
+            parallel_trial_count=2,
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("subproc-e2e", timeout=120)
+        assert exp.status.is_succeeded
+        assert exp.status.trials_succeeded == 3
+        best = float(exp.status.current_optimal_trial.observation.metric("score").max)
+        assert 0.0 < best <= 1.0

@@ -1,0 +1,114 @@
+"""Observation store tests — models reference mysql_test.go/postgres_test.go
+and the getMetrics fold (trial_controller_util.go:165-217)."""
+
+import math
+
+import pytest
+
+from katib_tpu.api import (
+    MetricStrategy,
+    MetricStrategyType,
+    ObjectiveSpec,
+    ObjectiveType,
+    UNAVAILABLE_METRIC_VALUE,
+)
+from katib_tpu.db import (
+    InMemoryObservationStore,
+    MetricLog,
+    SqliteObservationStore,
+    fold_observation,
+    objective_value,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        s = InMemoryObservationStore()
+    else:
+        s = SqliteObservationStore(str(tmp_path / "obs.db"))
+    yield s
+    s.close()
+
+
+def logs(*rows):
+    return [MetricLog(timestamp=t, metric_name=n, value=v) for (t, n, v) in rows]
+
+
+class TestStore:
+    def test_report_get_roundtrip(self, store):
+        store.report_observation_log("t1", logs((1.0, "acc", "0.5"), (2.0, "acc", "0.7")))
+        got = store.get_observation_log("t1")
+        assert [(r.timestamp, r.metric_name, r.value) for r in got] == [
+            (1.0, "acc", "0.5"),
+            (2.0, "acc", "0.7"),
+        ]
+
+    def test_filters(self, store):
+        store.report_observation_log(
+            "t1", logs((1.0, "acc", "0.5"), (2.0, "loss", "0.4"), (3.0, "acc", "0.9"))
+        )
+        assert len(store.get_observation_log("t1", metric_name="acc")) == 2
+        assert len(store.get_observation_log("t1", start_time=2.5)) == 1
+        assert len(store.get_observation_log("t1", end_time=1.5)) == 1
+        assert store.get_observation_log("t2") == []
+
+    def test_delete(self, store):
+        store.report_observation_log("t1", logs((1.0, "acc", "0.5")))
+        store.delete_observation_log("t1")
+        assert store.get_observation_log("t1") == []
+
+    def test_isolation_between_trials(self, store):
+        store.report_observation_log("t1", logs((1.0, "acc", "0.1")))
+        store.report_observation_log("t2", logs((1.0, "acc", "0.2")))
+        assert store.get_observation_log("t1")[0].value == "0.1"
+        assert store.get_observation_log("t2")[0].value == "0.2"
+
+
+class TestFold:
+    def test_min_max_latest(self):
+        obs = fold_observation(
+            logs((1.0, "acc", "0.5"), (3.0, "acc", "0.7"), (2.0, "acc", "0.9")),
+            ["acc"],
+        )
+        m = obs.metric("acc")
+        assert float(m.min) == 0.5
+        assert float(m.max) == 0.9
+        assert float(m.latest) == 0.7  # greatest timestamp wins, not last row
+
+    def test_non_numeric_latest_preserved(self):
+        obs = fold_observation(logs((1.0, "acc", "0.5"), (2.0, "acc", "nan")), ["acc"])
+        m = obs.metric("acc")
+        assert float(m.min) == 0.5 and float(m.max) == 0.5
+        assert m.latest == "nan"
+
+    def test_all_unparseable_reports_unavailable(self):
+        obs = fold_observation(logs((1.0, "acc", "oops")), ["acc"])
+        m = obs.metric("acc")
+        assert m.min == UNAVAILABLE_METRIC_VALUE and m.max == UNAVAILABLE_METRIC_VALUE
+        assert m.latest == "oops"
+
+    def test_missing_metric(self):
+        obs = fold_observation(logs((1.0, "acc", "0.5")), ["acc", "loss"])
+        assert obs.metric("loss").latest == UNAVAILABLE_METRIC_VALUE
+
+
+class TestObjectiveValue:
+    def make_obj(self, strategy=None):
+        obj = ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="acc")
+        if strategy:
+            obj.metric_strategies = [MetricStrategy(name="acc", value=strategy)]
+        return obj
+
+    def test_strategy_extraction(self):
+        obs = fold_observation(
+            logs((1.0, "acc", "0.2"), (2.0, "acc", "0.9"), (3.0, "acc", "0.6")), ["acc"]
+        )
+        assert objective_value(obs, self.make_obj()) == 0.9  # maximize -> max
+        assert objective_value(obs, self.make_obj(MetricStrategyType.LATEST)) == 0.6
+        assert objective_value(obs, self.make_obj(MetricStrategyType.MIN)) == 0.2
+
+    def test_unavailable_returns_none(self):
+        obs = fold_observation(logs((1.0, "acc", "bad")), ["acc"])
+        assert objective_value(obs, self.make_obj()) is None
+        assert objective_value(None, self.make_obj()) is None
