@@ -22,3 +22,22 @@ from .api import (  # noqa: F401
     ParameterType,
     TrialTemplate,
 )
+from .runtime.metrics import report_metrics  # noqa: F401  (SDK push API)
+
+
+def __getattr__(name):
+    # Lazy imports keep `import katib_tpu` light (no JAX/flax import cost
+    # until a client or controller is actually used).
+    if name == "KatibClient":
+        from .client.katib_client import KatibClient
+
+        return KatibClient
+    if name == "search":
+        from .client import search
+
+        return search
+    if name == "ExperimentController":
+        from .controller.experiment import ExperimentController
+
+        return ExperimentController
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

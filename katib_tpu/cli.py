@@ -1,0 +1,190 @@
+"""katib-tpu CLI — submit/inspect experiments from the terminal.
+
+Terminal-first replacement for the reference's Web-UI backend REST surface
+(cmd/ui/v1beta1/main.go:42-75: fetch_experiments, create_experiment,
+fetch_hp_job_info, fetch_trial_logs). Subcommands:
+
+  run <spec.json>          create an experiment from a JSON spec and drive it
+  list                     list experiments in a state root
+  status <name>            experiment status + trial buckets + optimal trial
+  trials <name>            per-trial table (the fetch_hp_job_info view)
+  metrics <trial>          raw observation log for one trial
+  algorithms               registered suggestion / early-stopping algorithms
+
+Experiments with in-process entry points use trialTemplate.entryPoint
+("module:function"); arbitrary subprocess commands work via
+trialTemplate.command exactly like Katib YAML trial templates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _controller(root: Optional[str], devices: Optional[int] = None):
+    from .controller.experiment import ExperimentController
+
+    devs = None
+    if devices:
+        devs = list(range(devices))
+    return ExperimentController(root_dir=root, devices=devs)
+
+
+def cmd_run(args) -> int:
+    from .api.spec import ExperimentSpec
+
+    from .api.validation import ValidationError
+
+    with open(args.spec) as f:
+        spec = ExperimentSpec.from_dict(json.load(f))
+    ctrl = _controller(args.root, args.devices)
+    try:
+        ctrl.create_experiment(spec)
+    except (ValidationError, ValueError) as e:
+        print(f"invalid experiment spec: {e}", file=sys.stderr)
+        return 2
+    print(f"experiment {spec.name} created; running...")
+    exp = ctrl.run(spec.name, timeout=args.timeout)
+    _print_status(exp)
+    ctrl.close()
+    return 0 if exp.status.is_succeeded else 1
+
+
+def cmd_list(args) -> int:
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    rows = [
+        (e.name, e.status.condition.value, e.status.reason.value,
+         f"{e.status.trials_succeeded}/{e.status.trials}")
+        for e in ctrl.state.list_experiments()
+    ]
+    _table(["NAME", "STATUS", "REASON", "SUCCEEDED/TOTAL"], rows)
+    return 0
+
+
+def cmd_status(args) -> int:
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    exp = ctrl.state.get_experiment(args.name)
+    if exp is None:
+        print(f"experiment {args.name!r} not found", file=sys.stderr)
+        return 1
+    _print_status(exp)
+    return 0
+
+
+def cmd_trials(args) -> int:
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    trials = ctrl.state.list_trials(args.name)
+    rows = []
+    for t in trials:
+        metric = ""
+        if t.observation and t.observation.metrics:
+            m = t.observation.metrics[0]
+            metric = f"{m.name}={m.latest}"
+        rows.append((t.name, t.condition.value, json.dumps(t.assignments_dict()), metric))
+    _table(["TRIAL", "STATUS", "ASSIGNMENTS", "METRIC"], rows)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    import os
+
+    from .db.store import open_store
+
+    db = os.path.join(args.root, "observations.db") if args.root else None
+    store = open_store(db)
+    for log in store.get_observation_log(args.trial, metric_name=args.metric):
+        print(f"{log.timestamp:.3f}\t{log.metric_name}\t{log.value}")
+    store.close()
+    return 0
+
+
+def cmd_algorithms(args) -> int:
+    from .earlystop.medianstop import registered_early_stoppers
+    from .suggest.base import registered_algorithms
+
+    print("suggestion:", ", ".join(sorted(registered_algorithms())))
+    print("early-stopping:", ", ".join(sorted(registered_early_stoppers())))
+    return 0
+
+
+def _load_all(ctrl, root: Optional[str]) -> None:
+    """Hydrate persisted experiments from the state root."""
+    import os
+
+    state_root = os.path.join(root, "state") if root else None
+    if not state_root or not os.path.isdir(state_root):
+        return
+    for name in sorted(os.listdir(state_root)):
+        if os.path.exists(os.path.join(state_root, name, "state.json")):
+            ctrl.state.load(name)
+
+
+def _print_status(exp) -> None:
+    s = exp.status
+    print(f"name:      {exp.name}")
+    print(f"status:    {s.condition.value} ({s.reason.value or 'n/a'})")
+    print(
+        "trials:    "
+        f"{s.trials} total | {s.trials_succeeded} succeeded | {s.trials_running} running | "
+        f"{s.trials_failed} failed | {s.trials_early_stopped} early-stopped | "
+        f"{s.trials_killed} killed | {s.trials_metrics_unavailable} metrics-unavailable"
+    )
+    opt = s.current_optimal_trial
+    if opt.best_trial_name:
+        print(f"best:      {opt.best_trial_name}")
+        print(f"  params:  {json.dumps({a.name: a.value for a in opt.parameter_assignments})}")
+        for m in opt.observation.metrics:
+            print(f"  {m.name}: min={m.min} max={m.max} latest={m.latest}")
+
+
+def _table(headers, rows) -> None:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for row in rows:
+        print(fmt.format(*[str(c) for c in row]))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="katib-tpu", description=__doc__.split("\n")[0])
+    p.add_argument("--root", default=".katib-tpu", help="state root directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="create + drive an experiment from a JSON spec")
+    run_p.add_argument("spec")
+    run_p.add_argument("--timeout", type=float, default=None)
+    run_p.add_argument("--devices", type=int, default=None, help="abstract device slots (default: 8 slots; in-process JAX trials see the real devices regardless)")
+    run_p.set_defaults(fn=cmd_run)
+
+    sub.add_parser("list", help="list experiments").set_defaults(fn=cmd_list)
+
+    st = sub.add_parser("status", help="experiment status")
+    st.add_argument("name")
+    st.set_defaults(fn=cmd_status)
+
+    tr = sub.add_parser("trials", help="trial table for an experiment")
+    tr.add_argument("name")
+    tr.set_defaults(fn=cmd_trials)
+
+    me = sub.add_parser("metrics", help="raw observation log for a trial")
+    me.add_argument("trial")
+    me.add_argument("--metric", default=None)
+    me.set_defaults(fn=cmd_metrics)
+
+    sub.add_parser("algorithms", help="list registered algorithms").set_defaults(fn=cmd_algorithms)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

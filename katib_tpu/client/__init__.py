@@ -1,0 +1,2 @@
+from .katib_client import KatibClient  # noqa: F401
+from . import search  # noqa: F401

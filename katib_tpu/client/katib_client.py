@@ -1,0 +1,251 @@
+"""KatibClient — the user-facing SDK surface.
+
+reference sdk/python/v1beta1/kubeflow/katib/api/katib_client.py (1298 LoC):
+create_experiment, tune() (objective function -> experiment), waiting and
+condition helpers, optimal-HP getters, trial metrics from the DB manager,
+budget edits. Here the client drives an in-process ExperimentController
+instead of the K8s API, but method names and semantics track the SDK so a
+Katib user can port scripts mechanically.
+
+tune() differences from the reference (katib_client.py:163-434): the
+reference serializes the objective function's source into a container
+command; the TPU-native fast path passes the callable straight into the trial
+template (in-process execution under the trial's device allocation). Pass
+``pack=True`` to instead serialize the function source and run it as a
+subprocess trial with stdout metric collection — the reference's exact
+topology — which also exercises the placeholder-template path.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import textwrap
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from ..api.spec import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    EarlyStoppingSpec,
+    ExperimentSpec,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    TrialParameterSpec,
+    TrialResources,
+    TrialTemplate,
+)
+from ..api.status import Experiment, Trial
+from ..controller.experiment import ExperimentController
+from ..db.store import MetricLog
+
+
+class KatibClient:
+    def __init__(
+        self,
+        root_dir: Optional[str] = None,
+        devices: Optional[Sequence[Any]] = None,
+        controller: Optional[ExperimentController] = None,
+    ):
+        self.controller = controller or ExperimentController(root_dir=root_dir, devices=devices)
+
+    # -- experiment lifecycle (katib_client.py create_experiment etc.) ------
+
+    def create_experiment(self, spec: ExperimentSpec) -> Experiment:
+        return self.controller.create_experiment(spec)
+
+    def get_experiment(self, name: str) -> Optional[Experiment]:
+        return self.controller.state.get_experiment(name)
+
+    def list_experiments(self) -> List[Experiment]:
+        return self.controller.state.list_experiments()
+
+    def delete_experiment(self, name: str) -> None:
+        self.controller.delete_experiment(name)
+
+    def edit_experiment_budget(self, name: str, **kw) -> Experiment:
+        return self.controller.edit_experiment_budget(name, **kw)
+
+    def run(self, name: str, timeout: Optional[float] = None) -> Experiment:
+        """Drive to completion (the reference's controllers run server-side;
+        in-process the client pumps the loop)."""
+        return self.controller.run(name, timeout=timeout)
+
+    def wait_for_experiment_condition(
+        self,
+        name: str,
+        expected_condition: str = "Succeeded",
+        timeout: float = 600,
+        polling_interval: float = 1.0,
+    ) -> Experiment:
+        """katib_client.py wait_for_experiment_condition."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            exp = self.get_experiment(name)
+            if exp is not None and exp.status.condition.value == expected_condition:
+                return exp
+            if exp is not None and exp.status.is_completed:
+                raise RuntimeError(
+                    f"experiment {name!r} reached {exp.status.condition.value}, "
+                    f"expected {expected_condition}"
+                )
+            time.sleep(polling_interval)
+        raise TimeoutError(f"experiment {name!r} not {expected_condition} within {timeout}s")
+
+    def is_experiment_succeeded(self, name: str) -> bool:
+        exp = self.get_experiment(name)
+        return bool(exp and exp.status.is_succeeded)
+
+    # -- results -------------------------------------------------------------
+
+    def list_trials(self, name: str) -> List[Trial]:
+        return self.controller.state.list_trials(name)
+
+    def get_success_trial_details(self, name: str) -> List[Dict[str, Any]]:
+        """katib_client.py get_success_trial_details."""
+        out = []
+        for t in self.list_trials(name):
+            if t.is_succeeded:
+                out.append(
+                    {
+                        "name": t.name,
+                        "parameter_assignments": t.assignments_dict(),
+                        "metrics": [m.to_dict() for m in (t.observation.metrics if t.observation else [])],
+                    }
+                )
+        return out
+
+    def get_optimal_hyperparameters(self, name: str) -> Dict[str, Any]:
+        """katib_client.py get_optimal_hyperparameters."""
+        exp = self.get_experiment(name)
+        if exp is None:
+            raise KeyError(name)
+        opt = exp.status.current_optimal_trial
+        return {
+            "best_trial_name": opt.best_trial_name,
+            "parameter_assignments": {a.name: a.value for a in opt.parameter_assignments},
+            "observation": opt.observation.to_dict(),
+        }
+
+    def get_trial_metrics(self, trial_name: str, metric_name: Optional[str] = None) -> List[MetricLog]:
+        """katib_client.py get_trial_metrics (reads the DB manager)."""
+        return self.controller.obs_store.get_observation_log(trial_name, metric_name=metric_name)
+
+    # -- tune ---------------------------------------------------------------
+
+    def tune(
+        self,
+        name: str,
+        objective: Callable[..., Any],
+        parameters: Dict[str, ParameterSpec],
+        objective_metric_name: str,
+        additional_metric_names: Optional[List[str]] = None,
+        objective_type: str = "maximize",
+        objective_goal: Optional[float] = None,
+        algorithm_name: str = "random",
+        algorithm_settings: Optional[Dict[str, Any]] = None,
+        early_stopping_algorithm_name: Optional[str] = None,
+        early_stopping_settings: Optional[Dict[str, Any]] = None,
+        max_trial_count: Optional[int] = None,
+        parallel_trial_count: Optional[int] = None,
+        max_failed_trial_count: Optional[int] = None,
+        num_devices_per_trial: int = 1,
+        retain_trials: bool = False,
+        pack: bool = False,
+        env: Optional[Dict[str, str]] = None,
+    ) -> Experiment:
+        """Turn a Python objective function into an Experiment
+        (katib_client.py tune, :163-434).
+
+        ``objective`` receives a dict of hyperparameters (plus optionally the
+        trial context as a second argument) and reports metrics via
+        katib_tpu.report_metrics or by returning a metric dict.
+        ``parameters`` maps names to katib_tpu.client.search builders.
+        """
+        named_params = []
+        for pname, pspec in parameters.items():
+            ps = ParameterSpec(
+                name=pname, parameter_type=pspec.parameter_type, feasible_space=pspec.feasible_space
+            )
+            named_params.append(ps)
+
+        if pack:
+            template = self._packed_template(objective, named_params, env or {})
+            template.resources = TrialResources(num_devices=num_devices_per_trial)
+            template.retain = retain_trials
+        else:
+            fn = objective
+            n_args = len(inspect.signature(fn).parameters)
+            if n_args == 1:
+                wrapped = lambda assignments, ctx: fn(assignments)
+            else:
+                wrapped = fn
+            template = TrialTemplate(
+                function=wrapped,
+                resources=TrialResources(num_devices=num_devices_per_trial),
+                retain=retain_trials,
+            )
+
+        spec = ExperimentSpec(
+            name=name,
+            parameters=named_params,
+            objective=ObjectiveSpec(
+                type=ObjectiveType(objective_type),
+                goal=objective_goal,
+                objective_metric_name=objective_metric_name,
+                additional_metric_names=list(additional_metric_names or []),
+            ),
+            algorithm=AlgorithmSpec(
+                algorithm_name=algorithm_name,
+                algorithm_settings=[
+                    AlgorithmSetting(k, str(v)) for k, v in (algorithm_settings or {}).items()
+                ],
+            ),
+            early_stopping=(
+                EarlyStoppingSpec(
+                    algorithm_name=early_stopping_algorithm_name,
+                    algorithm_settings=[
+                        AlgorithmSetting(k, str(v))
+                        for k, v in (early_stopping_settings or {}).items()
+                    ],
+                )
+                if early_stopping_algorithm_name
+                else None
+            ),
+            trial_template=template,
+            max_trial_count=max_trial_count,
+            parallel_trial_count=parallel_trial_count,
+            max_failed_trial_count=max_failed_trial_count,
+        )
+        return self.create_experiment(spec)
+
+    def _packed_template(
+        self, objective: Callable, parameters: List[ParameterSpec], env: Dict[str, str]
+    ) -> TrialTemplate:
+        """Serialize the objective source into a subprocess command — the
+        reference topology (katib_client.py:325-345 builds a container command
+        from inspect.getsource). Parameter values travel as argv
+        ``name=value`` pairs, never as source text, so arbitrary value strings
+        cannot break (or inject into) the generated script."""
+        import sys
+
+        src = textwrap.dedent(inspect.getsource(objective))
+        fn_name = objective.__name__
+        script = (
+            "import sys\n"
+            + src
+            + "\n"
+            + "params = dict(a.split('=', 1) for a in sys.argv[1:])\n"
+            + f"result = {fn_name}(params)\n"
+            + "if isinstance(result, dict):\n"
+            + "    [print(f'{k}={v}') for k, v in result.items()]\n"
+        )
+        return TrialTemplate(
+            command=[sys.executable, "-c", script]
+            + [f"{p.name}=${{trialParameters.{p.name}}}" for p in parameters],
+            trial_parameters=[
+                TrialParameterSpec(name=p.name, reference=p.name) for p in parameters
+            ],
+            env=dict(env),
+        )

@@ -1,0 +1,48 @@
+"""Search-space builder helpers for KatibClient.tune.
+
+reference sdk/python/v1beta1/kubeflow/katib/api/search.py:19-64
+(katib.search.double/int/categorical returning V1beta1ParameterSpec).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..api.spec import Distribution, FeasibleSpace, ParameterSpec, ParameterType
+
+
+def double(
+    min: float, max: float, step: Optional[float] = None, distribution: Optional[str] = None
+) -> ParameterSpec:
+    return ParameterSpec(
+        name="",
+        parameter_type=ParameterType.DOUBLE,
+        feasible_space=FeasibleSpace(
+            min=str(min),
+            max=str(max),
+            step=str(step) if step is not None else None,
+            distribution=Distribution(distribution) if distribution else None,
+        ),
+    )
+
+
+def int_(min: int, max: int, step: Optional[int] = None) -> ParameterSpec:
+    return ParameterSpec(
+        name="",
+        parameter_type=ParameterType.INT,
+        feasible_space=FeasibleSpace(
+            min=str(min), max=str(max), step=str(step) if step is not None else None
+        ),
+    )
+
+
+# the SDK exports this as `int`; keep both spellings
+globals()["int"] = int_
+
+
+def categorical(values: Sequence[Union[str, float, int]]) -> ParameterSpec:
+    return ParameterSpec(
+        name="",
+        parameter_type=ParameterType.CATEGORICAL,
+        feasible_space=FeasibleSpace(list=[str(v) for v in values]),
+    )

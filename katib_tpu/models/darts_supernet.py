@@ -1,0 +1,198 @@
+"""DARTS supernet (search network) in flax.
+
+reference examples/v1beta1/trial-images/darts-cnn-cifar10/model.py
+(Cell, NetworkCNN) + search_space.py (genotype parsing). Structure matched:
+
+- stem: 3x3 conv to stem_multiplier*init_channels;
+- num_layers cells; reduction cells (stride 2, doubled channels) at layers
+  [L/3, 2L/3] (L==2: second layer; L==1: none);
+- each cell: 2 preprocessed inputs (FactorizedReduce after a reduction cell),
+  num_nodes intermediate nodes, node i has 2+i mixed-op edges; cell output is
+  the concat of intermediate node states;
+- two alpha sets (normal/reduce), one [i+2, n_ops] matrix per node,
+  initialized 1e-3*randn; softmaxed per-edge before the forward pass;
+- genotype: per node keep top-2 edges by max non-'none' op weight
+  (search_space.py parse).
+
+TPU-first: pure function of (weights, alphas, x) — alphas live in a separate
+param collection ("alphas") so bilevel optimization can take grads per group;
+NHWC; all cells unrolled at trace time (static num_layers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.darts_ops import FactorizedReduce, MixedOp, StdConv, batch_norm
+
+
+class Cell(nn.Module):
+    """model.py Cell."""
+
+    primitives: Sequence[str]
+    num_nodes: int
+    channels: int
+    reduction_prev: bool
+    reduction_cur: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, w_dag):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(channels=self.channels, name="pre0_reduce")(s0)
+        else:
+            s0 = StdConv(channels=self.channels, kernel_size=1, name="pre0")(s0)
+        s1 = StdConv(channels=self.channels, kernel_size=1, name="pre1")(s1)
+
+        states = [s0, s1]
+        for i in range(self.num_nodes):
+            acc = None
+            for j in range(2 + i):
+                stride = 2 if self.reduction_cur and j < 2 else 1
+                out = MixedOp(
+                    primitives=self.primitives,
+                    channels=self.channels,
+                    stride=stride,
+                    name=f"node{i}_edge{j}",
+                )(states[j], w_dag[i][j])
+                acc = out if acc is None else acc + out
+            states.append(acc)
+        return jnp.concatenate(states[2:], axis=-1)
+
+
+class DartsSupernet(nn.Module):
+    """model.py NetworkCNN."""
+
+    primitives: Sequence[str]      # includes 'none' (appended by SearchSpace)
+    init_channels: int = 16
+    input_channels: int = 3
+    num_classes: int = 10
+    num_layers: int = 8
+    num_nodes: int = 4
+    stem_multiplier: int = 3
+
+    def reduction_layers(self) -> List[int]:
+        if self.num_layers == 1:
+            return []
+        if self.num_layers == 2:
+            return [1]
+        return [self.num_layers // 3, 2 * self.num_layers // 3]
+
+    @nn.compact
+    def __call__(self, x):
+        n_ops = len(self.primitives)
+        # alphas in their own collection for bilevel grad separation
+        alpha_normal = [
+            self.param(
+                f"alpha_normal_{i}",
+                lambda key, shape: 1e-3 * jax.random.normal(key, shape),
+                (i + 2, n_ops),
+            )
+            for i in range(self.num_nodes)
+        ]
+        alpha_reduce = (
+            [
+                self.param(
+                    f"alpha_reduce_{i}",
+                    lambda key, shape: 1e-3 * jax.random.normal(key, shape),
+                    (i + 2, n_ops),
+                )
+                for i in range(self.num_nodes)
+            ]
+            if self.num_layers > 1
+            else []
+        )
+
+        w_normal = [jax.nn.softmax(a, axis=-1) for a in alpha_normal]
+        w_reduce = [jax.nn.softmax(a, axis=-1) for a in alpha_reduce]
+
+        c_cur = self.stem_multiplier * self.init_channels
+        s = nn.Conv(c_cur, (3, 3), padding="SAME", use_bias=False, name="stem")(x)
+        s = batch_norm(s)
+        s0 = s1 = s
+
+        reductions = self.reduction_layers()
+        c = self.init_channels
+        reduction_prev = False
+        for layer in range(self.num_layers):
+            reduction_cur = layer in reductions
+            if reduction_cur:
+                c *= 2
+            cell = Cell(
+                primitives=self.primitives,
+                num_nodes=self.num_nodes,
+                channels=c,
+                reduction_prev=reduction_prev,
+                reduction_cur=reduction_cur,
+                name=f"cell{layer}",
+            )
+            w_dag = w_reduce if reduction_cur else w_normal
+            s0, s1 = s1, cell(s0, s1, w_dag)
+            reduction_prev = reduction_cur
+
+        out = s1.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, name="classifier")(out)
+
+
+def split_params(params: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Split a flax param tree into (weights, alphas) masks for two-group
+    optimization (model.py getWeights/getAlphas)."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    weights = {k: v for k, v in flat.items() if not k[-1].startswith("alpha_")}
+    alphas = {k: v for k, v in flat.items() if k[-1].startswith("alpha_")}
+    return (
+        flax.traverse_util.unflatten_dict(weights),
+        flax.traverse_util.unflatten_dict(alphas),
+    )
+
+
+def merge_params(weights: Dict[str, Any], alphas: Dict[str, Any]) -> Dict[str, Any]:
+    import flax
+
+    flat = dict(flax.traverse_util.flatten_dict(weights))
+    flat.update(flax.traverse_util.flatten_dict(alphas))
+    return flax.traverse_util.unflatten_dict(flat)
+
+
+def parse_genotype(
+    alphas: Sequence[jnp.ndarray], primitives: Sequence[str], k: int = 2
+) -> List[List[Tuple[str, int]]]:
+    """search_space.py parse: discretize one alpha set into a gene.
+
+    For each node: per-edge best non-'none' op, then keep the top-k edges by
+    that op's weight. 'none' must be the last primitive.
+    """
+    assert primitives[-1] == "none"
+    gene: List[List[Tuple[str, int]]] = []
+    for edges in alphas:
+        w = jax.nn.softmax(jnp.asarray(edges), axis=-1)[:, :-1]  # drop 'none'
+        best_op = jnp.argmax(w, axis=-1)               # [n_edges]
+        best_w = jnp.max(w, axis=-1)                   # [n_edges]
+        top_edges = jnp.argsort(-best_w)[:k]
+        gene.append(
+            [(primitives[int(best_op[e])], int(e)) for e in sorted(map(int, top_edges))]
+        )
+    return gene
+
+
+def genotype(params: Dict[str, Any], primitives: Sequence[str], num_nodes: int) -> Dict[str, Any]:
+    """model.py genotype(): normal + reduce genes with concat range."""
+    _, alphas = split_params(params)
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(alphas)
+    normal = [flat[k] for k in sorted(flat) if k[-1].startswith("alpha_normal_")]
+    reduce_ = [flat[k] for k in sorted(flat) if k[-1].startswith("alpha_reduce_")]
+    gene = {
+        "normal": parse_genotype(normal, primitives),
+        "normal_concat": list(range(2, 2 + num_nodes)),
+    }
+    if reduce_:
+        gene["reduce"] = parse_genotype(reduce_, primitives)
+        gene["reduce_concat"] = list(range(2, 2 + num_nodes))
+    return gene

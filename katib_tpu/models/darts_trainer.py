@@ -1,0 +1,328 @@
+"""DARTS bilevel search trainer — the TPU re-design of the reference's
+darts-cnn-cifar10 trial image.
+
+reference examples/v1beta1/trial-images/darts-cnn-cifar10/run_trial.py:29-259
+(alternating alpha/weight optimization, SGD+cosine for weights, Adam for
+alphas, grad clip, prints Best-Genotype) and architect.py:19-135 (second-order
+unrolled alpha gradient).
+
+JAX re-design:
+- the whole search step — virtual SGD step w', validation grads at w',
+  finite-difference Hessian correction, alpha Adam update, then the real
+  weight update — is ONE jitted pure function; XLA fuses the three
+  forward/backward passes and keeps everything resident in HBM;
+- second-order terms are plain jax.grad compositions (no parameter copying:
+  the virtual model is just a tree_map expression);
+- data parallelism: the step is jitted with NamedSharding over a 1-D device
+  mesh ('data'); batch-sharded inputs make XLA insert psum for the gradient
+  all-reduce over ICI (multi-chip DARTS, SURVEY.md §7 hard part 1);
+- bfloat16 matmuls via jax.default_matmul_precision can be toggled by the
+  caller; parameters stay f32.
+
+Entry point ``run_darts_trial(assignments, ctx)`` consumes the suggestion's
+``algorithm-settings`` / ``search-space`` / ``num-layers`` JSON assignments
+exactly like run_trial.py parses its flags.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..utils.datasets import batches, load_cifar10
+from .darts_supernet import DartsSupernet, genotype, merge_params, split_params
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def _loss_fn(model: DartsSupernet, weights, alphas, batch) -> jnp.ndarray:
+    x, y = batch
+    logits = model.apply({"params": merge_params(weights, alphas)}, x)
+    return cross_entropy(logits, y)
+
+
+def _tree_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.vdot(l, l) for l in leaves))
+
+
+def architect_alpha_grad(
+    model: DartsSupernet,
+    weights,
+    alphas,
+    momentum_buf,
+    train_batch,
+    valid_batch,
+    xi: float,
+    w_momentum: float,
+    w_weight_decay: float,
+):
+    """Unrolled second-order alpha gradient (architect.py:30-135).
+
+    dalpha L_val(w', a) - xi * d^2/dadw L_train(w, a) . dw' L_val(w', a)
+    with the Hessian-vector product approximated by central differences.
+    """
+    # virtual step: w' = w - xi * (momentum*buf + dw L_train + wd*w)
+    g_w = jax.grad(lambda w: _loss_fn(model, w, alphas, train_batch))(weights)
+    v_weights = jax.tree.map(
+        lambda w, g, m: w - xi * (w_momentum * m + g + w_weight_decay * w),
+        weights,
+        g_w,
+        momentum_buf,
+    )
+
+    # validation grads at (w', alpha)
+    val_loss = lambda w, a: _loss_fn(model, w, a, valid_batch)
+    dalpha = jax.grad(val_loss, argnums=1)(v_weights, alphas)
+    dw = jax.grad(val_loss, argnums=0)(v_weights, alphas)
+
+    # finite-difference Hessian (compute_hessian): eps = 0.01 / ||dw||
+    eps = 0.01 / (_tree_norm(dw) + 1e-12)
+    w_pos = jax.tree.map(lambda w, d: w + eps * d, weights, dw)
+    w_neg = jax.tree.map(lambda w, d: w - eps * d, weights, dw)
+    train_alpha_grad = lambda w: jax.grad(
+        lambda a: _loss_fn(model, w, a, train_batch)
+    )(alphas)
+    a_pos = train_alpha_grad(w_pos)
+    a_neg = train_alpha_grad(w_neg)
+    hessian = jax.tree.map(lambda p, n: (p - n) / (2.0 * eps), a_pos, a_neg)
+
+    return jax.tree.map(lambda da, h: da - xi * h, dalpha, hessian)
+
+
+class DartsSearch:
+    """Alternating bilevel optimization driver (run_trial.py train loop)."""
+
+    def __init__(
+        self,
+        primitives: Sequence[str],
+        num_layers: int = 8,
+        settings: Optional[Dict[str, Any]] = None,
+        input_channels: int = 3,
+        num_classes: int = 10,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: int = 0,
+    ):
+        s = dict(settings or {})
+        self.num_epochs = int(s.get("num_epochs", 50) or 50)
+        self.w_lr = float(s.get("w_lr", 0.025))
+        self.w_lr_min = float(s.get("w_lr_min", 0.001))
+        self.w_momentum = float(s.get("w_momentum", 0.9))
+        self.w_weight_decay = float(s.get("w_weight_decay", 3e-4))
+        self.w_grad_clip = float(s.get("w_grad_clip", 5.0))
+        self.alpha_lr = float(s.get("alpha_lr", 3e-4))
+        self.alpha_weight_decay = float(s.get("alpha_weight_decay", 1e-3))
+        self.batch_size = int(s.get("batch_size", 128) or 128)
+        self.init_channels = int(s.get("init_channels", 16))
+        self.num_nodes = int(s.get("num_nodes", 4))
+        self.stem_multiplier = int(s.get("stem_multiplier", 3))
+        self.print_step = int(s.get("print_step", 50))
+
+        prims = list(primitives)
+        if "none" not in prims:
+            prims.append("none")  # search_space.py appends 'none'
+        self.primitives = prims
+        self.model = DartsSupernet(
+            primitives=tuple(prims),
+            init_channels=self.init_channels,
+            input_channels=input_channels,
+            num_classes=num_classes,
+            num_layers=num_layers,
+            num_nodes=self.num_nodes,
+            stem_multiplier=self.stem_multiplier,
+        )
+        self.mesh = mesh
+        self.seed = seed
+        self._built = False
+
+    # ------------------------------------------------------------------
+
+    def build(self, sample_shape: Tuple[int, ...], total_steps: int) -> None:
+        key = jax.random.PRNGKey(self.seed)
+        params = self.model.init(key, jnp.zeros((2,) + tuple(sample_shape)))["params"]
+        self.weights, self.alphas = split_params(params)
+
+        # weights: SGD momentum + cosine decay + clip (run_trial.py w_optim)
+        schedule = optax.cosine_decay_schedule(
+            self.w_lr, max(total_steps, 1), alpha=self.w_lr_min / self.w_lr
+        )
+        self.w_tx = optax.chain(
+            optax.add_decayed_weights(self.w_weight_decay),
+            optax.clip_by_global_norm(self.w_grad_clip),
+            optax.sgd(schedule, momentum=self.w_momentum),
+        )
+        self.w_opt_state = self.w_tx.init(self.weights)
+        self._schedule = schedule
+
+        # alphas: Adam(0.5, 0.999) + weight decay (run_trial.py alpha_optim)
+        self.a_tx = optax.chain(
+            optax.add_decayed_weights(self.alpha_weight_decay),
+            optax.adam(self.alpha_lr, b1=0.5, b2=0.999),
+        )
+        self.a_opt_state = self.a_tx.init(self.alphas)
+        self.step_idx = 0
+
+        self._search_step = self._compile_step()
+        self._eval_step = self._compile_eval()
+        self._built = True
+
+    def _shard_batch(self, batch):
+        if self.mesh is None:
+            return batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P("data"))
+        return tuple(jax.device_put(b, sharding) for b in batch)
+
+    def _compile_step(self):
+        model = self.model
+        w_momentum, w_weight_decay = self.w_momentum, self.w_weight_decay
+        schedule, w_tx, a_tx = self._schedule, self.w_tx, self.a_tx
+
+        def momentum_of(opt_state):
+            # trace of optax.sgd momentum buffer inside the chain
+            return opt_state[2][0].trace
+
+        def step(weights, alphas, w_opt_state, a_opt_state, step_idx, train_batch, valid_batch):
+            xi = schedule(step_idx)
+            # 1) alpha update from the unrolled objective
+            dalpha = architect_alpha_grad(
+                model,
+                weights,
+                alphas,
+                momentum_of(w_opt_state),
+                train_batch,
+                valid_batch,
+                xi,
+                w_momentum,
+                w_weight_decay,
+            )
+            a_updates, a_opt_state = a_tx.update(dalpha, a_opt_state, alphas)
+            alphas = optax.apply_updates(alphas, a_updates)
+
+            # 2) weight update on the training batch
+            loss, g_w = jax.value_and_grad(
+                lambda w: _loss_fn(model, w, alphas, train_batch)
+            )(weights)
+            w_updates, w_opt_state = w_tx.update(g_w, w_opt_state, weights)
+            weights = optax.apply_updates(weights, w_updates)
+            return weights, alphas, w_opt_state, a_opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _compile_eval(self):
+        model = self.model
+
+        def evaluate(weights, alphas, batch):
+            x, y = batch
+            logits = model.apply({"params": merge_params(weights, alphas)}, x)
+            return (jnp.argmax(logits, -1) == y).mean()
+
+        return jax.jit(evaluate)
+
+    # ------------------------------------------------------------------
+
+    def train_epoch(self, train_data, valid_data, rng: np.random.Generator):
+        """One epoch of alternating updates (run_trial.py train())."""
+        assert self._built
+        x_t, y_t = train_data
+        x_v, y_v = valid_data
+        losses = []
+        if len(x_t) < self.batch_size:  # split smaller than one batch
+            train_iter = [(x_t, y_t)]
+            valid_iter = iter([(x_v, y_v)])
+        else:
+            train_iter = batches(x_t, y_t, self.batch_size, rng)
+            valid_iter = batches(x_v, y_v, self.batch_size, rng)
+        for train_batch in train_iter:
+            try:
+                valid_batch = next(valid_iter)
+            except StopIteration:
+                if len(x_v) < self.batch_size:
+                    valid_iter = iter([(x_v, y_v)])
+                else:
+                    valid_iter = batches(x_v, y_v, self.batch_size, rng)
+                valid_batch = next(valid_iter)
+            (self.weights, self.alphas, self.w_opt_state, self.a_opt_state, loss) = (
+                self._search_step(
+                    self.weights,
+                    self.alphas,
+                    self.w_opt_state,
+                    self.a_opt_state,
+                    self.step_idx,
+                    self._shard_batch(train_batch),
+                    self._shard_batch(valid_batch),
+                )
+            )
+            self.step_idx += 1
+            losses.append(loss)
+        return float(jnp.stack(losses).mean())
+
+    def validate(self, valid_data, rng: np.random.Generator, max_batches: int = 50) -> float:
+        x_v, y_v = valid_data
+        accs = []
+        for i, batch in enumerate(batches(x_v, y_v, self.batch_size, rng)):
+            if i >= max_batches:
+                break
+            accs.append(self._eval_step(self.weights, self.alphas, self._shard_batch(batch)))
+        return float(jnp.stack(accs).mean()) if accs else 0.0
+
+    def genotype(self) -> Dict[str, Any]:
+        params = merge_params(self.weights, self.alphas)
+        return genotype(params, self.primitives, self.num_nodes)
+
+
+def run_darts_trial(assignments: Dict[str, str], ctx=None) -> None:
+    """Trial entry point — parses the DARTS suggestion assignments
+    (run_trial.py main argument parsing) and runs the search, reporting
+    Best-Genotype + validation accuracy per epoch."""
+    settings = json.loads(assignments["algorithm-settings"].replace("'", '"'))
+    search_space = json.loads(assignments["search-space"].replace("'", '"'))
+    num_layers = int(assignments["num-layers"])
+
+    # dataset size / epochs can be trimmed via settings for CI-scale runs
+    n_train = int(settings.get("num_train_examples", 0) or 0) or None
+    mesh = None
+    if ctx is not None and ctx.devices and len(ctx.devices) > 1:
+        # the scheduler may hand out abstract int slots (no JAX involved);
+        # only real jax devices can form a Mesh
+        if all(isinstance(d, jax.Device) for d in ctx.devices):
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(ctx.devices), ("data",))
+
+    x, y = load_cifar10("train", n=n_train)
+    half = len(x) // 2
+    train_data, valid_data = (x[:half], y[:half]), (x[half:], y[half:])
+
+    search = DartsSearch(
+        primitives=search_space,
+        num_layers=num_layers,
+        settings=settings,
+        mesh=mesh,
+    )
+    steps_per_epoch = max(half // search.batch_size, 1)
+    search.build(x.shape[1:], steps_per_epoch * search.num_epochs)
+
+    rng = np.random.default_rng(0)
+    best_acc = 0.0
+    for epoch in range(search.num_epochs):
+        loss = search.train_epoch(train_data, valid_data, rng)
+        acc = search.validate(valid_data, rng)
+        best_acc = max(best_acc, acc)
+        if ctx is not None:
+            ctx.report(**{"Validation-accuracy": acc, "Train-loss": loss})
+        else:
+            print(f"Validation-accuracy={acc}")
+            print(f"Train-loss={loss}")
+    gene = search.genotype()
+    print(f"Best-Genotype={gene}")

@@ -1,0 +1,185 @@
+"""ENAS child network builder + trainer — TPU re-design of the reference's
+enas-cnn-cifar10 trial image.
+
+reference examples/v1beta1/trial-images/enas-cnn-cifar10/{ModelConstructor.py,
+op_library.py, RunTrial.py}: decodes the controller-emitted ``architecture``
+(per-layer [op, skip bits...]) and ``nn_config`` (embedding of concrete
+operations) into a CNN:
+
+- layer l concatenates the previous layer with all skip-connected earlier
+  layers (spatially zero-padded to the largest H/W) and applies its op;
+- ops: convolution, separable_convolution, depthwise_convolution, reduction
+  (max/avg pool; identity when the spatial dim is already 1);
+- head: global average pool -> dropout(0.4) -> dense softmax.
+
+Re-design notes: flax module built dynamically from the arch (static under
+jit — each architecture compiles once); train-mode stateless batch norm like
+the DARTS ops; NHWC.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.darts_ops import batch_norm
+from ..utils.datasets import batches, load_cifar10
+
+
+def _pad_to(x: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """op_library.py concat: zero-pad spatial dims up to (h, w)."""
+    dh, dw = h - x.shape[1], w - x.shape[2]
+    if dh == 0 and dw == 0:
+        return x
+    top, left = dh // 2, dw // 2
+    return jnp.pad(x, ((0, 0), (top, dh - top), (left, dw - left), (0, 0)))
+
+
+def _concat_inputs(inputs: List[jnp.ndarray]) -> jnp.ndarray:
+    if len(inputs) == 1:
+        return inputs[0]
+    h = max(i.shape[1] for i in inputs)
+    w = max(i.shape[2] for i in inputs)
+    return jnp.concatenate([_pad_to(i, h, w) for i in inputs], axis=-1)
+
+
+class EnasChildNet(nn.Module):
+    """ModelConstructor.build_model as a flax module."""
+
+    arch: Any            # list of [op, skip...] per layer (parsed)
+    embedding: Dict[str, Dict[str, Any]]
+    num_classes: int = 10
+    dropout_rate: float = 0.4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        layers = [x]
+        num_layers = len(self.arch)
+        for l in range(1, num_layers + 1):
+            opt = self.arch[l - 1][0]
+            skip = self.arch[l - 1][1 : l + 1]
+            cfg = self.embedding[str(opt)]
+            params = cfg.get("opt_params", {})
+            inputs = [layers[l - 1]]
+            for i in range(l - 1):
+                if l > 1 and i < len(skip) and skip[i] == 1:
+                    inputs.append(layers[i])
+            h = _concat_inputs(inputs)
+            h = self._apply_op(h, cfg["opt_type"], params, name=f"layer{l}")
+            layers.append(h)
+
+        out = layers[-1].mean(axis=(1, 2))
+        out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return nn.Dense(self.num_classes, name="classifier")(out)
+
+    def _apply_op(self, x, opt_type: str, p: Dict[str, Any], name: str):
+        num_filter = int(p.get("num_filter", 64))
+        filter_size = int(p.get("filter_size", 3))
+        stride = int(p.get("stride", 1) or 1)
+        if opt_type == "convolution":
+            x = nn.relu(x)
+            x = nn.Conv(
+                num_filter, (filter_size, filter_size), strides=(stride, stride),
+                padding="SAME", name=f"{name}_conv",
+            )(x)
+            return batch_norm(x)
+        if opt_type == "separable_convolution":
+            depth_mult = int(p.get("depth_multiplier", 1))
+            x = nn.relu(x)
+            x = nn.Conv(
+                x.shape[-1] * depth_mult, (filter_size, filter_size),
+                strides=(stride, stride), padding="SAME",
+                feature_group_count=x.shape[-1], name=f"{name}_dw",
+            )(x)
+            x = nn.Conv(num_filter, (1, 1), name=f"{name}_pw")(x)
+            return batch_norm(x)
+        if opt_type == "depthwise_convolution":
+            depth_mult = int(p.get("depth_multiplier", 1))
+            x = nn.relu(x)
+            x = nn.Conv(
+                x.shape[-1] * depth_mult, (filter_size, filter_size),
+                strides=(stride, stride), padding="SAME",
+                feature_group_count=x.shape[-1], name=f"{name}_dw",
+            )(x)
+            return batch_norm(x)
+        if opt_type == "reduction":
+            if x.shape[1] == 1 or x.shape[2] == 1:
+                return x  # identity fallback (op_library.py reduction)
+            pool = int(p.get("pool_size", 2))
+            stride_p = p.get("stride") or pool
+            stride_p = int(stride_p)
+            rtype = p.get("reduction_type", "max_pooling")
+            if rtype == "avg_pooling":
+                return nn.avg_pool(x, (pool, pool), strides=(stride_p, stride_p))
+            return nn.max_pool(x, (pool, pool), strides=(stride_p, stride_p))
+        raise ValueError(f"unknown ENAS op type {opt_type!r}")
+
+
+def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
+    """Trial entry point — RunTrial.py equivalent: decode architecture, train,
+    report per-epoch Validation-accuracy (latest strategy)."""
+    arch = json.loads(assignments["architecture"].replace("'", '"'))
+    nn_config = json.loads(assignments["nn_config"].replace("'", '"'))
+    num_epochs = int(assignments.get("num_epochs", "3"))
+    batch_size = int(assignments.get("batch_size", "128"))
+    lr = float(assignments.get("learning_rate", "0.002"))
+    n_train = int(assignments.get("num_train_examples", "0")) or None
+
+    num_classes = int(nn_config["output_sizes"][-1])
+    model = EnasChildNet(
+        arch=tuple(tuple(l) for l in arch),
+        embedding=nn_config["embedding"],
+        num_classes=num_classes,
+    )
+
+    x, y = load_cifar10("train", n=n_train)
+    split = int(len(x) * 0.9)
+    x_t, y_t, x_v, y_v = x[:split], y[:split], x[split:], y[split:]
+
+    key = jax.random.PRNGKey(0)
+    params = model.init({"params": key, "dropout": key}, jnp.zeros((2,) + x.shape[1:]))[
+        "params"
+    ]
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, key, bx, by):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, bx, train=True, rngs={"dropout": key})
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def eval_step(params, bx, by):
+        logits = model.apply({"params": params}, bx, train=False)
+        return (jnp.argmax(logits, -1) == by).mean()
+
+    rng = np.random.default_rng(0)
+    loss = jnp.array(float("nan"))
+    for epoch in range(num_epochs):
+        train_iter = (
+            [(x_t, y_t)] if len(x_t) < batch_size else batches(x_t, y_t, batch_size, rng)
+        )
+        for bx, by in train_iter:
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = train_step(params, opt_state, sub, bx, by)
+        accs = [eval_step(params, bx, by) for bx, by in batches(x_v, y_v, batch_size, rng)]
+        if not accs and len(x_v):  # val split smaller than one batch
+            accs = [eval_step(params, x_v, y_v)]
+        acc = float(jnp.stack(accs).mean()) if accs else 0.0
+        if ctx is not None:
+            ctx.report(**{"Validation-accuracy": acc, "Train-loss": float(loss)})
+        else:
+            print(f"Epoch {epoch+1}:")
+            print(f"Validation-accuracy={acc}")
+            print(f"Train-loss={float(loss)}")

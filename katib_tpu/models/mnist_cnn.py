@@ -1,0 +1,82 @@
+"""MNIST HPO trial workload — flax re-design of the reference's
+pytorch-mnist trial image (examples/v1beta1/trial-images/pytorch-mnist/
+mnist.py: conv-conv-fc net, SGD with lr/momentum hyperparameters, prints
+per-epoch loss/accuracy for the collector)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..utils.datasets import batches, load_mnist
+
+
+class MnistCNN(nn.Module):
+    """mnist.py Net: two convs + two dense layers."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(20, (5, 5))(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(50, (5, 5))(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(500)(x))
+        return nn.Dense(10)(x)
+
+
+def run_mnist_trial(assignments: Dict[str, str], ctx=None) -> None:
+    """Entry point: hyperparameters lr / momentum (+ optional batch_size,
+    num_epochs, num_train_examples); reports loss and accuracy."""
+    lr = float(assignments.get("lr", "0.01"))
+    momentum = float(assignments.get("momentum", "0.5"))
+    batch_size = int(assignments.get("batch_size", "64"))
+    num_epochs = int(assignments.get("num_epochs", "1"))
+    n_train = int(assignments.get("num_train_examples", "0")) or None
+
+    x, y = load_mnist("train", n=n_train)
+    x_test, y_test = load_mnist("test", n=(n_train // 5 if n_train else None))
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2,) + x.shape[1:]))["params"]
+    tx = optax.sgd(lr, momentum=momentum)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, bx, by):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def eval_step(params, bx, by):
+        logits = model.apply({"params": params}, bx, train=False)
+        return (jnp.argmax(logits, -1) == by).mean()
+
+    rng = np.random.default_rng(0)
+    for epoch in range(num_epochs):
+        losses = []
+        for bx, by in batches(x, y, batch_size, rng):
+            params, opt_state, loss = train_step(params, opt_state, bx, by)
+            losses.append(loss)
+        accs = [eval_step(params, bx, by) for bx, by in batches(x_test, y_test, batch_size, rng)]
+        if not accs and len(x_test):  # test split smaller than one batch
+            accs = [eval_step(params, x_test, y_test)]
+        metrics = {
+            "loss": float(jnp.stack(losses).mean()) if losses else float("nan"),
+            "accuracy": float(jnp.stack(accs).mean()) if accs else 0.0,
+        }
+        if ctx is not None:
+            ctx.report(**metrics)
+        else:
+            print(f"loss={metrics['loss']}")
+            print(f"accuracy={metrics['accuracy']}")

@@ -1,0 +1,54 @@
+"""PBT reference workload — re-design of the reference's simple-pbt trial
+image (examples/v1beta1/trial-images/simple-pbt/pbt_test.py:13-127): a
+triangle-wave optimal-learning-rate benchmark whose score can only be
+maximized by adapting lr over generations, with checkpoint save/restore
+through the PBT lineage directory (the suggestion-PVC equivalent,
+ctx.checkpoint_dir)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+_STEPS_PER_ROUND = 20
+
+
+def _optimal_lr(step: int, period: int = 100) -> float:
+    """Triangle wave in [0, 0.02] (pbt_test.py objective shape)."""
+    phase = (step % period) / period
+    tri = 2 * phase if phase < 0.5 else 2 * (1 - phase)
+    return 0.02 * tri
+
+
+def run_pbt_trial(assignments: Dict[str, str], ctx=None) -> None:
+    """Score improves when lr tracks the moving optimum; state (step, score)
+    persists across generations via the checkpoint dir."""
+    lr = float(assignments["lr"])
+
+    step, score = 0, 0.0
+    ckpt_path = None
+    if ctx is not None and ctx.checkpoint_dir:
+        os.makedirs(ctx.checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(ctx.checkpoint_dir, "training.json")
+        if os.path.exists(ckpt_path):
+            with open(ckpt_path) as f:
+                state = json.load(f)
+            step, score = int(state["step"]), float(state["score"])
+
+    for _ in range(_STEPS_PER_ROUND):
+        target = _optimal_lr(step)
+        # reward closeness to the optimal lr at this step
+        score += max(0.0, 1.0 - abs(lr - target) / 0.02) * 0.01
+        step += 1
+
+    if ckpt_path is not None:
+        with open(ckpt_path, "w") as f:
+            json.dump({"step": step, "score": score}, f)
+
+    if ctx is not None:
+        ctx.report(**{"Validation-accuracy": score})
+    else:
+        print(f"Validation-accuracy={score}")

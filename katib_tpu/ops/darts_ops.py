@@ -1,0 +1,190 @@
+"""DARTS operation set in flax — TPU re-design of the reference op library.
+
+reference examples/v1beta1/trial-images/darts-cnn-cifar10/operations.py
+(OPS dict: none, avg/max_pooling_3x3, skip_connection, separable_convolution
+3x3/5x5, dilated_convolution 3x3/5x5).
+
+TPU-first notes:
+- NHWC layout everywhere (XLA's preferred conv layout on TPU).
+- Normalization is stateless per-batch (train-mode BatchNorm with
+  affine=False, no running stats): avoids mutable collections so the whole
+  supernet stays a pure function — required for clean bilevel jax.grad and
+  pjit sharding of the architect step.
+- The mixed op evaluates every candidate and takes the alpha-weighted sum
+  (one fused weighted add in XLA) rather than data-dependent branching,
+  which would break tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Per-batch normalization over N,H,W (affine=False train-mode BN)."""
+    mean = x.mean(axis=(0, 1, 2), keepdims=True)
+    var = x.var(axis=(0, 1, 2), keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps)
+
+
+class Zero(nn.Module):
+    """operations.py Zero: multiply by 0, strided slice when reducing."""
+
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        if self.stride == 1:
+            return x * 0.0
+        return x[:, :: self.stride, :: self.stride, :] * 0.0
+
+
+class PoolBN(nn.Module):
+    """operations.py PoolBN: avg/max pool 3x3 + BN."""
+
+    pool_type: str  # "avg" | "max"
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        if self.pool_type == "avg":
+            out = nn.avg_pool(x, (3, 3), strides=(self.stride, self.stride), padding="SAME")
+        else:
+            out = nn.max_pool(x, (3, 3), strides=(self.stride, self.stride), padding="SAME")
+        return batch_norm(out)
+
+
+class Identity(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
+class FactorizedReduce(nn.Module):
+    """operations.py FactorizedReduce: stride-2 via two offset 1x1 convs
+    concatenated, then BN."""
+
+    channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        h = self.channels // 2
+        a = nn.Conv(h, (1, 1), strides=(2, 2), use_bias=False, name="conv1")(x)
+        b = nn.Conv(self.channels - h, (1, 1), strides=(2, 2), use_bias=False, name="conv2")(
+            x[:, 1:, 1:, :]
+        )
+        return batch_norm(jnp.concatenate([a, b], axis=-1))
+
+
+class StdConv(nn.Module):
+    """operations.py StdConv: ReLU - Conv - BN."""
+
+    channels: int
+    kernel_size: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.channels,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            use_bias=False,
+        )(x)
+        return batch_norm(x)
+
+
+class SepConv(nn.Module):
+    """operations.py SepConv: two stacked (ReLU - depthwise - pointwise - BN)
+    blocks, stride applied in the first."""
+
+    channels: int
+    kernel_size: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        for i, stride in enumerate((self.stride, 1)):
+            x = nn.relu(x)
+            x = nn.Conv(
+                x.shape[-1],
+                (self.kernel_size, self.kernel_size),
+                strides=(stride, stride),
+                padding="SAME",
+                feature_group_count=x.shape[-1],
+                use_bias=False,
+                name=f"dw{i}",
+            )(x)
+            x = nn.Conv(self.channels, (1, 1), use_bias=False, name=f"pw{i}")(x)
+            x = batch_norm(x)
+        return x
+
+
+class DilConv(nn.Module):
+    """operations.py DilConv: ReLU - dilated depthwise - pointwise - BN."""
+
+    channels: int
+    kernel_size: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(
+            x.shape[-1],
+            (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            kernel_dilation=(self.dilation, self.dilation),
+            feature_group_count=x.shape[-1],
+            use_bias=False,
+            name="dw",
+        )(x)
+        x = nn.Conv(self.channels, (1, 1), use_bias=False, name="pw")(x)
+        return batch_norm(x)
+
+
+def make_op(name: str, channels: int, stride: int) -> nn.Module:
+    """operations.py OPS factory."""
+    if name == "none":
+        return Zero(stride=stride)
+    if name == "avg_pooling_3x3":
+        return PoolBN(pool_type="avg", stride=stride)
+    if name == "max_pooling_3x3":
+        return PoolBN(pool_type="max", stride=stride)
+    if name == "skip_connection":
+        return Identity() if stride == 1 else FactorizedReduce(channels=channels)
+    if name == "separable_convolution_3x3":
+        return SepConv(channels=channels, kernel_size=3, stride=stride)
+    if name == "separable_convolution_5x5":
+        return SepConv(channels=channels, kernel_size=5, stride=stride)
+    if name == "dilated_convolution_3x3":
+        return DilConv(channels=channels, kernel_size=3, stride=stride, dilation=2)
+    if name == "dilated_convolution_5x5":
+        return DilConv(channels=channels, kernel_size=5, stride=stride, dilation=2)
+    raise ValueError(f"unknown DARTS operation {name!r}")
+
+
+class MixedOp(nn.Module):
+    """Continuous relaxation: alpha-weighted sum of all candidate ops
+    (operations.py MixedOp)."""
+
+    primitives: Sequence[str]
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights):
+        outs = [
+            make_op(p, self.channels, self.stride)(x) for p in self.primitives
+        ]
+        stacked = jnp.stack(outs, axis=0)  # [n_ops, N, H, W, C]
+        return jnp.tensordot(weights, stacked, axes=1)

@@ -1,0 +1,86 @@
+"""Dataset loading for trial workloads.
+
+The reference trial images download CIFAR-10/MNIST via torchvision/Keras at
+container start. This environment has no network egress, so loaders look for
+an on-disk copy first and otherwise generate a *learnable* synthetic
+stand-in (class-conditional frequency patterns + noise) with identical
+shapes/dtypes — search dynamics and benchmarks exercise the same compute
+graph either way.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+CIFAR10_ENV = "KATIB_TPU_CIFAR10"  # path to an .npz with x_train/y_train/x_test/y_test
+
+
+def _synthetic_images(
+    n: int,
+    num_classes: int,
+    image_size: int,
+    channels: int,
+    rng: np.random.Generator,
+    noise: float = 0.4,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional 2-D sinusoid patterns; linearly separable enough to
+    learn, noisy enough that accuracy tracks model capacity."""
+    ys = rng.integers(0, num_classes, size=n)
+    yy, xx = np.meshgrid(np.arange(image_size), np.arange(image_size), indexing="ij")
+    base = np.zeros((num_classes, image_size, image_size, channels), dtype=np.float32)
+    for c in range(num_classes):
+        fx, fy = 1 + c % 4, 1 + (c // 4) % 4
+        phase = c * 0.7
+        pattern = np.sin(2 * np.pi * (fx * xx + fy * yy) / image_size + phase)
+        for ch in range(channels):
+            base[c, :, :, ch] = pattern * (0.5 + 0.5 * ((c + ch) % 2))
+    xs = base[ys] + noise * rng.standard_normal((n, image_size, image_size, channels)).astype(
+        np.float32
+    )
+    return xs.astype(np.float32), ys.astype(np.int32)
+
+
+def load_cifar10(
+    split: str = "train",
+    n: Optional[int] = None,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 (NHWC float32 in [-1,1]-ish, int32 labels). Falls back to a
+    synthetic 32x32x3/10-class dataset when no local copy exists."""
+    path = os.environ.get(CIFAR10_ENV)
+    if path and os.path.exists(path):
+        data = np.load(path)
+        x = data[f"x_{split}"].astype(np.float32)
+        y = data[f"y_{split}"].astype(np.int32).reshape(-1)
+        if x.ndim == 4 and x.shape[1] == 3:  # NCHW -> NHWC
+            x = x.transpose(0, 2, 3, 1)
+        if x.max() > 2.0:
+            x = (x / 127.5) - 1.0
+        if n is not None:
+            x, y = x[:n], y[:n]
+        return x, y
+    rng = np.random.default_rng(seed if split == "train" else seed + 1)
+    count = n if n is not None else (50000 if split == "train" else 10000)
+    return _synthetic_images(count, 10, 32, 3, rng)
+
+
+def load_mnist(
+    split: str = "train", n: Optional[int] = None, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """MNIST-shaped dataset (28x28x1, 10 classes), synthetic fallback."""
+    rng = np.random.default_rng(seed if split == "train" else seed + 1)
+    count = n if n is not None else (60000 if split == "train" else 10000)
+    return _synthetic_images(count, 10, 28, 1, rng)
+
+
+def batches(x: np.ndarray, y: np.ndarray, batch_size: int, rng: np.random.Generator):
+    """Shuffled full-epoch batch iterator (drops the ragged tail so shapes
+    stay static for jit)."""
+    idx = rng.permutation(len(x))
+    n_batches = len(x) // batch_size
+    for i in range(n_batches):
+        sel = idx[i * batch_size : (i + 1) * batch_size]
+        yield x[sel], y[sel]

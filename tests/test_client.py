@@ -1,0 +1,110 @@
+"""SDK client tests — KatibClient.tune (in-process and packed-subprocess) and
+result getters.
+
+Models the reference SDK behavior (katib_client.py:163-434) at the capability
+level: objective function -> experiment -> optimal hyperparameters.
+"""
+
+import pytest
+
+from katib_tpu.client import KatibClient, search
+
+
+@pytest.fixture
+def client(tmp_path):
+    c = KatibClient(root_dir=str(tmp_path), devices=list(range(4)))
+    yield c
+    c.controller.close()
+
+
+def objective_inprocess(params):
+    import katib_tpu
+
+    x = float(params["x"])
+    katib_tpu.report_metrics({"score": 1.0 - (x - 0.4) ** 2})
+
+
+def objective_packed(params):
+    # runs in a subprocess: source is serialized; prints name=value on return
+    x = float(params["x"])
+    return {"score": 1.0 - (x - 0.4) ** 2}
+
+
+class TestTune:
+    def test_tune_inprocess(self, client):
+        client.tune(
+            name="tune-inproc",
+            objective=objective_inprocess,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            algorithm_name="random",
+            algorithm_settings={"random_state": 0},
+            max_trial_count=4,
+            parallel_trial_count=2,
+        )
+        exp = client.run("tune-inproc", timeout=60)
+        assert exp.status.is_succeeded
+        best = client.get_optimal_hyperparameters("tune-inproc")
+        assert 0.0 <= float(best["parameter_assignments"]["x"]) <= 1.0
+        assert best["best_trial_name"]
+
+    def test_tune_packed_subprocess(self, client):
+        client.tune(
+            name="tune-packed",
+            objective=objective_packed,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=2,
+            parallel_trial_count=2,
+            pack=True,
+        )
+        exp = client.run("tune-packed", timeout=120)
+        assert exp.status.is_succeeded
+        details = client.get_success_trial_details("tune-packed")
+        assert len(details) == 2
+        for d in details:
+            assert "x" in d["parameter_assignments"]
+            assert d["metrics"][0]["name"] == "score"
+
+    def test_trial_metrics_from_store(self, client):
+        client.tune(
+            name="tune-metrics",
+            objective=objective_inprocess,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        client.run("tune-metrics", timeout=60)
+        trial = client.list_trials("tune-metrics")[0]
+        logs = client.get_trial_metrics(trial.name)
+        assert len(logs) == 1 and logs[0].metric_name == "score"
+
+    def test_wait_for_condition(self, client):
+        client.tune(
+            name="tune-wait",
+            objective=objective_inprocess,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        client.run("tune-wait", timeout=60)
+        exp = client.wait_for_experiment_condition("tune-wait", "Succeeded", timeout=5)
+        assert exp.status.is_succeeded
+        assert client.is_experiment_succeeded("tune-wait")
+
+
+class TestSearchBuilders:
+    def test_builders(self):
+        from katib_tpu.api import ParameterType
+
+        d = search.double(min=0.1, max=1.0, step=0.1)
+        assert d.parameter_type == ParameterType.DOUBLE
+        assert d.feasible_space.step == "0.1"
+        i = search.int_(min=1, max=10)
+        assert i.parameter_type == ParameterType.INT
+        c = search.categorical(["a", 2, 3.5])
+        assert c.feasible_space.list == ["a", "2", "3.5"]
+        lg = search.double(min=1e-5, max=1.0, distribution="logUniform")
+        assert lg.feasible_space.distribution is not None

@@ -1,0 +1,200 @@
+"""NAS tests: DARTS suggestion passthrough + supernet; ENAS controller
+sampling/training + child network decode.
+
+Models reference tests test_darts_service.py / test_enas_service.py plus the
+trial-image behavior (ModelConstructor decode, supernet genotype).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    GraphConfig,
+    NasConfig,
+    NasOperation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.suggest.base import SuggestionRequest, create
+from tests.test_suggest_algorithms import completed_trial
+
+
+def darts_nas_config():
+    return NasConfig(
+        graph_config=GraphConfig(num_layers=2, input_sizes=[16, 16, 3], output_sizes=[10]),
+        operations=[
+            NasOperation(
+                "convolution",
+                [ParameterSpec("filter_size", ParameterType.CATEGORICAL, FeasibleSpace(list=["3", "5"]))],
+            ),
+            NasOperation("skip_connection"),
+        ],
+    )
+
+
+def enas_nas_config():
+    return NasConfig(
+        graph_config=GraphConfig(num_layers=3, input_sizes=[16, 16, 3], output_sizes=[10]),
+        operations=[
+            NasOperation(
+                "convolution",
+                [
+                    ParameterSpec("filter_size", ParameterType.CATEGORICAL, FeasibleSpace(list=["3", "5"])),
+                    ParameterSpec("num_filter", ParameterType.CATEGORICAL, FeasibleSpace(list=["8", "16"])),
+                ],
+            ),
+            NasOperation(
+                "reduction",
+                [ParameterSpec("reduction_type", ParameterType.CATEGORICAL, FeasibleSpace(list=["max_pooling"]))],
+            ),
+        ],
+    )
+
+
+def nas_experiment(algo, nas_config, settings=None):
+    return ExperimentSpec(
+        name=f"{algo}-nas-test",
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="Validation-accuracy"),
+        algorithm=AlgorithmSpec(
+            algorithm_name=algo,
+            algorithm_settings=[AlgorithmSetting(k, str(v)) for k, v in (settings or {}).items()],
+        ),
+        nas_config=nas_config,
+        trial_template=TrialTemplate(function=lambda a, c: None),
+        max_trial_count=10,
+        parallel_trial_count=2,
+    )
+
+
+class TestDartsSuggestion:
+    def test_passthrough_assignments(self):
+        spec = nas_experiment("darts", darts_nas_config(), settings={"num_epochs": 3})
+        s = create("darts")
+        s.validate_algorithm_settings(spec)
+        reply = s.get_suggestions(SuggestionRequest(spec, [], 2))
+        assert len(reply.assignments) == 2
+        d = reply.assignments[0].assignments_dict()
+        assert d["num-layers"] == "2"
+        space = json.loads(d["search-space"].replace("'", '"'))
+        # conv expands per filter size; skip_connection passes through
+        assert space == ["convolution_3x3", "convolution_5x5", "skip_connection"]
+        settings = json.loads(d["algorithm-settings"].replace("'", '"'))
+        assert settings["num_epochs"] == "3"      # user override
+        assert settings["w_lr"] == 0.025           # default preserved
+
+    def test_validation(self):
+        s = create("darts")
+        bad = nas_experiment("darts", darts_nas_config(), settings={"num_epochs": 0})
+        with pytest.raises(ValueError, match="num_epochs"):
+            s.validate_algorithm_settings(bad)
+
+
+class TestDartsSupernet:
+    def test_forward_and_genotype(self):
+        from katib_tpu.models.darts_supernet import DartsSupernet, genotype
+
+        prims = ("max_pooling_3x3", "skip_connection", "none")
+        model = DartsSupernet(
+            primitives=prims, init_channels=4, num_layers=2, num_nodes=2, num_classes=10
+        )
+        x = jnp.zeros((2, 16, 16, 3))
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        logits = model.apply({"params": params}, x)
+        assert logits.shape == (2, 10)
+        gene = genotype(params, prims, num_nodes=2)
+        assert len(gene["normal"]) == 2
+        # top-2 edges per node, ops never 'none'
+        for node in gene["normal"]:
+            assert len(node) == 2
+            for op, edge in node:
+                assert op != "none"
+
+
+class TestEnasSuggestion:
+    def make(self):
+        return nas_experiment(
+            "enas",
+            enas_nas_config(),
+            settings={"controller_train_steps": 2, "controller_log_every_steps": 1},
+        )
+
+    def test_arc_format(self):
+        spec = self.make()
+        s = create("enas")
+        s.validate_algorithm_settings(spec)
+        reply = s.get_suggestions(SuggestionRequest(spec, [], 2))
+        assert len(reply.assignments) == 2
+        d = reply.assignments[0].assignments_dict()
+        arch = json.loads(d["architecture"].replace("'", '"'))
+        assert len(arch) == 3  # num_layers
+        # layer l has 1 op + (l) skip bits
+        for l, layer in enumerate(arch):
+            assert len(layer) == l + 1
+            assert 0 <= layer[0] < 3  # 2 conv variants + 1 reduction
+            assert all(b in (0, 1) for b in layer[1:])
+        nn_config = json.loads(d["nn_config"].replace("'", '"'))
+        assert nn_config["num_layers"] == 3
+        assert str(arch[0][0]) in nn_config["embedding"]
+
+    def test_controller_trains_on_results(self, tmp_path):
+        spec = self.make()
+        s = create("enas")
+        s.state_dir = str(tmp_path)
+        r1 = s.get_suggestions(SuggestionRequest(spec, [], 2))
+        trials = [
+            completed_trial(a.name, a.assignments_dict(), 0.8, labels=dict(a.labels))
+            for a in r1.assignments
+        ]
+        # rename metric to the experiment's objective
+        for t in trials:
+            t.observation.metrics[0].name = "Validation-accuracy"
+        r2 = s.get_suggestions(SuggestionRequest(spec, trials, 2))
+        assert len(r2.assignments) == 2
+        # controller checkpoint persisted for restart protection
+        assert (tmp_path / "enas_controller.pkl").exists()
+
+    def test_validation(self):
+        s = create("enas")
+        bad = self.make()
+        bad.algorithm.algorithm_settings = [AlgorithmSetting("controller_learning_rate", "5")]
+        with pytest.raises(ValueError, match="out of range"):
+            s.validate_algorithm_settings(bad)
+        bad.algorithm.algorithm_settings = [AlgorithmSetting("bogus_setting", "1")]
+        with pytest.raises(ValueError, match="unknown ENAS setting"):
+            s.validate_algorithm_settings(bad)
+
+
+class TestEnasChildNet:
+    def test_decode_and_forward(self):
+        """Controller output -> child net -> forward pass (ModelConstructor)."""
+        spec = nas_experiment("enas", enas_nas_config(),
+                              settings={"controller_train_steps": 1})
+        s = create("enas")
+        reply = s.get_suggestions(SuggestionRequest(spec, [], 1))
+        d = reply.assignments[0].assignments_dict()
+        arch = json.loads(d["architecture"].replace("'", '"'))
+        nn_config = json.loads(d["nn_config"].replace("'", '"'))
+
+        from katib_tpu.models.enas_child import EnasChildNet
+
+        model = EnasChildNet(
+            arch=tuple(tuple(l) for l in arch),
+            embedding=nn_config["embedding"],
+            num_classes=10,
+        )
+        x = jnp.zeros((2, 16, 16, 3))
+        variables = model.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, x)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+        assert bool(jnp.isfinite(logits).all())
