@@ -1,0 +1,99 @@
+"""Benchmark: DARTS CIFAR-10 supernet search, e2e-projected wall-clock.
+
+The reference publishes no performance numbers (BASELINE.md); its only
+quantitative envelope is the CI bound for the DARTS e2e experiment — the
+darts-cpu example (num_epochs=1, num_nodes=1, init_channels=1, batch 128,
+full CIFAR-10) must finish inside the 40-minute workflow timeout
+(reference test/e2e/v1beta1/scripts/gh-actions/run-e2e-experiment.py:10-11,
+examples/v1beta1/nas/darts-cpu.yaml).
+
+This bench runs the SAME search configuration on the available accelerator:
+it measures steady-state bilevel search-step latency (second-order architect
++ weight update, jitted) and projects the 1-epoch experiment wall-clock
+(390 steps for 50k/2 train images at batch 128, plus measured compile time).
+
+Output: one JSON line {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = baseline_seconds / projected_seconds (>1 means faster than the
+reference CI envelope).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SECONDS = 2400.0  # reference e2e CI bound (40 min)
+STEPS_PER_EPOCH = 390      # 25_000 train images (half of CIFAR-10) / batch 128
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from katib_tpu.models.darts_trainer import DartsSearch
+    from katib_tpu.utils.compilation import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    # darts-cpu.yaml e2e configuration
+    primitives = [
+        "max_pooling_3x3",
+        "skip_connection",
+        "separable_convolution_3x3",
+    ]
+    settings = {
+        "num_epochs": 1,
+        "num_nodes": 1,
+        "init_channels": 1,
+        "batch_size": 128,
+        "stem_multiplier": 3,
+    }
+    search = DartsSearch(primitives=primitives, num_layers=3, settings=settings)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 32, 32, 3)).astype("float32")
+    y = rng.integers(0, 10, 256).astype("int32")
+
+    t0 = time.time()
+    search.build((32, 32, 3), STEPS_PER_EPOCH)
+    bx, by = x[:128], y[:128]
+    vx, vy = x[128:], y[128:]
+    # first step includes compile
+    state = search._search_step(
+        search.weights, search.alphas, search.w_opt_state, search.a_opt_state,
+        search.step_idx, (bx, by), (vx, vy),
+    )
+    jax.block_until_ready(state[-1])
+    compile_s = time.time() - t0
+    search.weights, search.alphas, search.w_opt_state, search.a_opt_state = state[:4]
+
+    # steady state
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    t0 = time.time()
+    for _ in range(n_steps):
+        state = search._search_step(
+            search.weights, search.alphas, search.w_opt_state, search.a_opt_state,
+            search.step_idx, (bx, by), (vx, vy),
+        )
+        search.weights, search.alphas, search.w_opt_state, search.a_opt_state = state[:4]
+    jax.block_until_ready(state[-1])
+    step_s = (time.time() - t0) / n_steps
+
+    projected = compile_s + step_s * STEPS_PER_EPOCH
+    print(
+        json.dumps(
+            {
+                "metric": "darts_cifar10_e2e_projected_wallclock",
+                "value": round(projected, 2),
+                "unit": "seconds (1-epoch search epoch, darts-cpu e2e config; "
+                f"step {step_s*1000:.1f}ms x {STEPS_PER_EPOCH} + compile {compile_s:.1f}s)",
+                "vs_baseline": round(BASELINE_SECONDS / projected, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,16 @@ def cmd_algorithms(args) -> int:
     return 0
 
 
+def cmd_ui(args) -> int:
+    from .ui.server import serve_ui
+
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    print(f"serving dashboard on http://{args.host}:{args.port}")
+    serve_ui(ctrl, host=args.host, port=args.port, block=True)
+    return 0
+
+
 def _load_all(ctrl, root: Optional[str]) -> None:
     """Hydrate persisted experiments from the state root."""
     import os
@@ -181,6 +191,11 @@ def main(argv=None) -> int:
     me.set_defaults(fn=cmd_metrics)
 
     sub.add_parser("algorithms", help="list registered algorithms").set_defaults(fn=cmd_algorithms)
+
+    ui = sub.add_parser("ui", help="serve the web dashboard + REST API")
+    ui.add_argument("--host", default="127.0.0.1")
+    ui.add_argument("--port", type=int, default=8080)
+    ui.set_defaults(fn=cmd_ui)
 
     args = p.parse_args(argv)
     return args.fn(args)

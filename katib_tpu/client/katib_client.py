@@ -176,8 +176,16 @@ class KatibClient:
             template.retain = retain_trials
         else:
             fn = objective
-            n_args = len(inspect.signature(fn).parameters)
-            if n_args == 1:
+            try:
+                sig_params = inspect.signature(fn).parameters.values()
+                n_positional = sum(
+                    1
+                    for p in sig_params
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                ) + 2 * any(p.kind == p.VAR_POSITIONAL for p in sig_params)
+            except (TypeError, ValueError):  # C callables etc.: assume (assignments, ctx)
+                n_positional = 2
+            if n_positional <= 1:
                 wrapped = lambda assignments, ctx: fn(assignments)
             else:
                 wrapped = fn

@@ -1,0 +1,114 @@
+"""Framework configuration — the katib-config equivalent.
+
+reference pkg/apis/config/v1beta1/types.go:27-128 (KatibConfig:
+RuntimeConfig + InitConfig + per-algorithm SuggestionConfig /
+EarlyStoppingConfig / MetricsCollectorConfig, loaded from the katib-config
+ConfigMap by pkg/util/v1beta1/katibconfig/config.go) and the viper flag layer
+(cmd/katib-controller/v1beta1/main.go:76-104).
+
+Here: one typed dataclass loaded from JSON file + environment overrides.
+Per-algorithm config maps algorithm name -> either an import path overriding
+the built-in implementation (the reference's per-algorithm container image)
+or a service address to run it out-of-process over gRPC
+(katib_tpu.service.rpc.RemoteSuggester — the reference's pod topology).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+ENV_CONFIG_PATH = "KATIB_TPU_CONFIG"
+
+
+@dataclass
+class SuggestionConfig:
+    """reference types.go SuggestionConfig (image/resources -> import path /
+    service address / default settings)."""
+
+    import_path: Optional[str] = None    # "module:ClassName" override
+    service_address: Optional[str] = None  # run via gRPC instead of in-process
+    default_settings: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class EarlyStoppingConfig:
+    import_path: Optional[str] = None
+    default_settings: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class RuntimeConfig:
+    """reference types.go RuntimeConfig + controller flags."""
+
+    default_parallel_trial_count: int = 3
+    max_trial_restarts: int = 0            # retries for failed trials (0 = off)
+    trial_timeout_seconds: Optional[float] = None
+    obslog_backend: str = "auto"           # sqlite | native | memory | auto
+    xla_cache_dir: Optional[str] = None
+    devices_per_host: Optional[int] = None  # cap devices visible to the allocator
+    metrics_poll_interval: float = 0.1
+
+
+@dataclass
+class KatibConfig:
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    suggestions: Dict[str, SuggestionConfig] = field(default_factory=dict)
+    early_stopping: Dict[str, EarlyStoppingConfig] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KatibConfig":
+        cfg = cls()
+        r = d.get("runtime", {})
+        for f in dataclasses.fields(RuntimeConfig):
+            if f.name in r:
+                setattr(cfg.runtime, f.name, r[f.name])
+        for name, sd in d.get("suggestions", {}).items():
+            cfg.suggestions[name] = SuggestionConfig(
+                import_path=sd.get("importPath"),
+                service_address=sd.get("serviceAddress"),
+                default_settings=dict(sd.get("defaultSettings", {})),
+            )
+        for name, ed in d.get("earlyStopping", {}).items():
+            cfg.early_stopping[name] = EarlyStoppingConfig(
+                import_path=ed.get("importPath"),
+                default_settings=dict(ed.get("defaultSettings", {})),
+            )
+        return cfg
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "runtime": dataclasses.asdict(self.runtime),
+            "suggestions": {
+                k: {
+                    "importPath": v.import_path,
+                    "serviceAddress": v.service_address,
+                    "defaultSettings": v.default_settings,
+                }
+                for k, v in self.suggestions.items()
+            },
+            "earlyStopping": {
+                k: {"importPath": v.import_path, "defaultSettings": v.default_settings}
+                for k, v in self.early_stopping.items()
+            },
+        }
+
+
+def load_config(path: Optional[str] = None) -> KatibConfig:
+    """File -> env overrides, mirroring the reader + viper layering."""
+    path = path or os.environ.get(ENV_CONFIG_PATH)
+    cfg = KatibConfig()
+    if path and os.path.exists(path):
+        with open(path) as f:
+            cfg = KatibConfig.from_dict(json.load(f))
+    # env overrides (reference: env vars trump config, consts/const.go:93-103)
+    env_backend = os.environ.get("KATIB_TPU_OBSLOG_BACKEND")
+    if env_backend:
+        cfg.runtime.obslog_backend = env_backend
+    env_cache = os.environ.get("KATIB_TPU_XLA_CACHE")
+    if env_cache:
+        cfg.runtime.xla_cache_dir = env_cache
+    return cfg

@@ -1,0 +1,113 @@
+"""Event recording + Prometheus-style controller metrics.
+
+reference observability surface (SURVEY.md §5):
+- K8s Events on every state change (r.recorder.Eventf —
+  trial_controller_util.go:66/86/109);
+- Prometheus CounterVec/GaugeVec for experiments/trials
+  created/succeeded/failed/deleted (experiment/util/prometheus_metrics.go:29-78,
+  trial/util/prometheus_metrics.go).
+
+Here: an in-memory (optionally persisted) ring of typed events per
+experiment, and a metrics registry rendered in Prometheus text exposition
+format (served by katib_tpu.ui.server at /metrics).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+@dataclass
+class Event:
+    timestamp: float
+    kind: str          # Experiment | Trial
+    name: str
+    event_type: str    # Normal | Warning
+    reason: str
+    message: str
+
+    def to_dict(self):
+        return {
+            "timestamp": self.timestamp,
+            "kind": self.kind,
+            "name": self.name,
+            "type": self.event_type,
+            "reason": self.reason,
+            "message": self.message,
+        }
+
+
+class EventRecorder:
+    def __init__(self, max_events: int = 1000):
+        self._lock = threading.Lock()
+        self._events: Dict[str, Deque[Event]] = {}
+        self.max_events = max_events
+
+    def event(
+        self,
+        experiment: str,
+        kind: str,
+        name: str,
+        reason: str,
+        message: str,
+        warning: bool = False,
+    ) -> None:
+        e = Event(
+            timestamp=time.time(),
+            kind=kind,
+            name=name,
+            event_type="Warning" if warning else "Normal",
+            reason=reason,
+            message=message,
+        )
+        with self._lock:
+            q = self._events.setdefault(experiment, collections.deque(maxlen=self.max_events))
+            q.append(e)
+
+    def list(self, experiment: str) -> List[Event]:
+        with self._lock:
+            return list(self._events.get(experiment, ()))
+
+
+class MetricsRegistry:
+    """Counters/gauges labelled by experiment, Prometheus text format.
+
+    Metric names mirror the reference: katib_experiment_created_total,
+    katib_experiment_succeeded_total, katib_experiment_failed_total,
+    katib_trial_created_total, katib_trial_succeeded_total,
+    katib_trial_failed_total, katib_trial_early_stopped_total, plus running
+    gauges (prometheus_metrics.go).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for (name, labels), value in sorted(self._counters.items()):
+                lines.append(f"# TYPE {name} counter") if f"# TYPE {name} counter" not in lines else None
+                label_s = ",".join(f'{k}="{v}"' for k, v in labels)
+                lines.append(f"{name}{{{label_s}}} {value}")
+            for (name, labels), value in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {name} gauge") if f"# TYPE {name} gauge" not in lines else None
+                label_s = ",".join(f'{k}="{v}"' for k, v in labels)
+                lines.append(f"{name}{{{label_s}}} {value}")
+        return "\n".join(lines) + "\n"

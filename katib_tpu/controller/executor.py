@@ -128,10 +128,21 @@ class TrialExecution:
 class InProcessExecutor:
     def __init__(self, obs_store: ObservationStore):
         self.obs_store = obs_store
+        self._cache_enabled = False
 
     def execute(
         self, exp: Experiment, trial: Trial, ctx: TrialContext, handle: TrialExecution
     ) -> ExecutionResult:
+        if not self._cache_enabled:
+            # Shared XLA compile cache across trials — enabled lazily here so
+            # read-only CLI paths never pay the JAX import.
+            self._cache_enabled = True
+            try:
+                from ..utils.compilation import enable_compilation_cache
+
+                enable_compilation_cache()
+            except Exception:
+                pass
         fn = resolve_entry_point(exp.spec.trial_template)
         token = set_current_reporter(ctx.reporter)
         try:
@@ -208,6 +219,10 @@ class SubprocessExecutor:
 
         # Collect metrics from the produced output (sidecar CollectObservationLog).
         self._collect(trial, stdout_path, metrics_file, spec)
+        # Drain cross-process pushed metrics into the controller's store when
+        # they live in different backends (subprocesses always push to the
+        # SQLite file at db_path; the controller may use the native engine).
+        self._drain_pushed(trial)
 
         if outcome is not None:
             return outcome
@@ -283,6 +298,25 @@ class SubprocessExecutor:
                 pass
             proc.wait(timeout=5)
 
+    def _drain_pushed(self, trial: Trial) -> None:
+        from ..db.store import SqliteObservationStore
+
+        if not self.db_path:
+            return
+        if (
+            isinstance(self.obs_store, SqliteObservationStore)
+            and self.obs_store.path == self.db_path
+        ):
+            return  # same file: rows already visible
+        staging = SqliteObservationStore(self.db_path)
+        try:
+            rows = staging.get_observation_log(trial.name)
+            if rows:
+                self.obs_store.report_observation_log(trial.name, rows)
+                staging.delete_observation_log(trial.name)
+        finally:
+            staging.close()
+
     def _collect(
         self,
         trial: Trial,
@@ -294,6 +328,17 @@ class SubprocessExecutor:
         kind = mc.collector_kind
         if kind in (CollectorKind.NONE, CollectorKind.PUSH):
             return  # trial pushed directly (or reports nothing)
+        if kind == CollectorKind.TF_EVENT:
+            from ..runtime.tfevent import collect_tfevent_metrics
+
+            event_dir = mc.source.file_path if mc.source else None
+            if event_dir and not os.path.isabs(event_dir):
+                event_dir = os.path.join(os.path.dirname(stdout_path), event_dir)
+            if event_dir and os.path.isdir(event_dir):
+                logs = collect_tfevent_metrics(event_dir, spec.objective.all_metric_names())
+                if logs:
+                    self.obs_store.report_observation_log(trial.name, logs)
+            return
         path = stdout_path
         if kind == CollectorKind.FILE and metrics_file:
             path = metrics_file

@@ -63,6 +63,11 @@ class ExperimentController:
         self.obs_store: ObservationStore = open_store(db_path)
         self.db_path = db_path
         self.suggestions = SuggestionService(self.state, self.obs_store)
+        from .events import EventRecorder, MetricsRegistry
+
+        self.events = EventRecorder()
+        self.metrics = MetricsRegistry()
+        self._completed_seen: set = set()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
         self.scheduler = TrialScheduler(
             self.state,
@@ -70,6 +75,8 @@ class ExperimentController:
             devices=devices,
             db_path=db_path,
             workdir_root=workdir_root,
+            events=self.events,
+            metrics=self.metrics,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -89,6 +96,8 @@ class ExperimentController:
         )
         self.suggestions.forget(spec.name)  # stale state from a deleted namesake
         self.state.create_experiment(exp)
+        self.metrics.inc("katib_experiment_created_total", experiment=spec.name)
+        self.events.event(spec.name, "Experiment", spec.name, "ExperimentCreated", "Experiment is created")
         # Algorithm/early-stopping settings dry-run (validator.go:203-238 +
         # suggestion_controller.go:256-271). Done at admission like the
         # reference's validating webhook.
@@ -123,6 +132,7 @@ class ExperimentController:
                 ExperimentCondition.RESTARTING, ExperimentReason.NONE, "Experiment is restarted"
             )
             exp.status.completion_time = None
+            self._completed_seen.discard(name)
         self.state.update_experiment(exp)
         return exp
 
@@ -144,7 +154,8 @@ class ExperimentController:
                     ExperimentReason.SUGGESTION_FAILED,
                     str(e),
                 )
-        if exp.status.is_completed:
+        if exp.status.is_completed and name not in self._completed_seen:
+            self._completed_seen.add(name)
             self._on_completed(exp)
         self.state.update_experiment(exp)
         return exp
@@ -225,6 +236,14 @@ class ExperimentController:
 
     def _on_completed(self, exp: Experiment) -> None:
         self.suggestions.cleanup(exp)
+        outcome = "succeeded" if exp.status.is_succeeded else "failed"
+        self.metrics.inc(f"katib_experiment_{outcome}_total", experiment=exp.name)
+        self.events.event(
+            exp.name, "Experiment", exp.name,
+            exp.status.reason.value or exp.status.condition.value,
+            exp.status.message,
+            warning=not exp.status.is_succeeded,
+        )
 
     # -- run loop ------------------------------------------------------------
 
@@ -255,6 +274,8 @@ class ExperimentController:
                 self.scheduler.kill(t.name)
             self.obs_store.delete_observation_log(t.name)
         self.suggestions.forget(name)
+        self._completed_seen.discard(name)
+        self.metrics.inc("katib_experiment_deleted_total", experiment=name)
         self.state.delete_experiment(name)
 
     def close(self) -> None:

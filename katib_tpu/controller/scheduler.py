@@ -84,7 +84,11 @@ class TrialScheduler:
         devices: Optional[Sequence[Any]] = None,
         db_path: Optional[str] = None,
         workdir_root: Optional[str] = None,
+        events=None,
+        metrics=None,
     ):
+        self.recorder = events
+        self.metrics_registry = metrics
         if devices is None:
             devices = list(range(8))  # abstract slots when JAX not involved
         self.allocator = DeviceAllocator(devices)
@@ -107,6 +111,10 @@ class TrialScheduler:
     def submit(self, exp: Experiment, trial: Trial, checkpoint_dir: Optional[str] = None) -> None:
         trial.set_condition(TrialCondition.PENDING, "TrialPending", "waiting for devices")
         self.state.update_trial(trial)
+        if self.metrics_registry is not None:
+            self.metrics_registry.inc("katib_trial_created_total", experiment=exp.name)
+        if self.recorder is not None:
+            self.recorder.event(exp.name, "Trial", trial.name, "TrialCreated", "Trial is created")
         if checkpoint_dir:
             self._checkpoint_dirs[trial.name] = checkpoint_dir
         with self._lock:
@@ -256,3 +264,19 @@ class TrialScheduler:
         else:
             trial.set_condition(TrialCondition.SUCCEEDED, "TrialSucceeded", "Trial has succeeded")
         self.state.update_trial(trial)
+        if self.metrics_registry is not None:
+            bucket = {
+                TrialCondition.SUCCEEDED: "succeeded",
+                TrialCondition.FAILED: "failed",
+                TrialCondition.KILLED: "killed",
+                TrialCondition.EARLY_STOPPED: "early_stopped",
+                TrialCondition.METRICS_UNAVAILABLE: "metrics_unavailable",
+            }.get(trial.condition, "completed")
+            self.metrics_registry.inc(f"katib_trial_{bucket}_total", experiment=exp.name)
+        if self.recorder is not None:
+            warning = trial.condition in (TrialCondition.FAILED, TrialCondition.METRICS_UNAVAILABLE)
+            self.recorder.event(
+                exp.name, "Trial", trial.name,
+                trial.conditions[-1].reason if trial.conditions else trial.condition.value,
+                trial.message, warning=warning,
+            )

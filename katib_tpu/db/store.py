@@ -248,8 +248,25 @@ def objective_value(
     return _parse_float(raw)
 
 
-def open_store(path: Optional[str]) -> ObservationStore:
-    """Factory, reference pkg/db/v1beta1/db.go: path=None -> in-memory."""
-    if path is None:
+def open_store(path: Optional[str], backend: str = "auto") -> ObservationStore:
+    """Factory, reference pkg/db/v1beta1/db.go (driver selection by env).
+
+    backend: 'auto' (sqlite, or $KATIB_TPU_OBSLOG_BACKEND override),
+    'sqlite', 'memory', or 'native' (C++ engine, katib_tpu/native/obslog.cc —
+    single-writer-process; subprocess trials must push via gRPC or stdout
+    rather than opening the same file).
+    """
+    import os
+
+    if backend == "auto":
+        backend = os.environ.get("KATIB_TPU_OBSLOG_BACKEND", "sqlite")
+    if path is None or backend == "memory":
         return InMemoryObservationStore()
+    if backend == "native":
+        from ..native.obslog_store import open_native_store
+
+        store = open_native_store(path + ".ktob")
+        if store is not None:
+            return store
+        backend = "sqlite"  # toolchain unavailable: fall back
     return SqliteObservationStore(path)

@@ -186,8 +186,13 @@ def genotype(params: Dict[str, Any], primitives: Sequence[str], num_nodes: int) 
     import flax
 
     flat = flax.traverse_util.flatten_dict(alphas)
-    normal = [flat[k] for k in sorted(flat) if k[-1].startswith("alpha_normal_")]
-    reduce_ = [flat[k] for k in sorted(flat) if k[-1].startswith("alpha_reduce_")]
+
+    def node_index(key) -> int:  # numeric sort: alpha_normal_10 after _9
+        return int(key[-1].rsplit("_", 1)[1])
+
+    keys = sorted(flat, key=node_index)
+    normal = [flat[k] for k in keys if k[-1].startswith("alpha_normal_")]
+    reduce_ = [flat[k] for k in keys if k[-1].startswith("alpha_reduce_")]
     gene = {
         "normal": parse_genotype(normal, primitives),
         "normal_concat": list(range(2, 2 + num_nodes)),

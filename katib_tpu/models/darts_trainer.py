@@ -292,13 +292,8 @@ def run_darts_trial(assignments: Dict[str, str], ctx=None) -> None:
     # dataset size / epochs can be trimmed via settings for CI-scale runs
     n_train = int(settings.get("num_train_examples", 0) or 0) or None
     mesh = None
-    if ctx is not None and ctx.devices and len(ctx.devices) > 1:
-        # the scheduler may hand out abstract int slots (no JAX involved);
-        # only real jax devices can form a Mesh
-        if all(isinstance(d, jax.Device) for d in ctx.devices):
-            from jax.sharding import Mesh
-
-            mesh = Mesh(np.array(ctx.devices), ("data",))
+    if ctx is not None and len(ctx.jax_devices()) > 1:
+        mesh = ctx.mesh(axis_names=("data",))
 
     x, y = load_cifar10("train", n=n_train)
     half = len(x) // 2
@@ -325,4 +320,6 @@ def run_darts_trial(assignments: Dict[str, str], ctx=None) -> None:
             print(f"Validation-accuracy={acc}")
             print(f"Train-loss={loss}")
     gene = search.genotype()
+    # reference run_trial.py prints the best accuracy + genotype at the end
+    print(f"Best-accuracy={best_acc}")
     print(f"Best-Genotype={gene}")

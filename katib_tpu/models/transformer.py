@@ -1,0 +1,184 @@
+"""Decoder-only transformer LM with dp/fsdp/tp/sp sharding — the distributed
+flagship workload of the trial runtime.
+
+The reference framework contains no model code (distributed training is
+delegated to PyTorchJob/MPIJob trials — SURVEY.md §2.9); this module is the
+TPU-native equivalent deliverable: a trial workload that scales over a named
+mesh with XLA collectives instead of NCCL/Horovod.
+
+Sharding design (scaling-book recipe — pick a mesh, annotate, let XLA insert
+collectives):
+- activations: [B, T, E] with B over ('data','fsdp'), T over 'seq';
+- attention: heads over 'model' (TP); sequence blocks over 'seq' via ring
+  attention (katib_tpu.ops.ring_attention) — long-context first-class;
+- params: column-parallel in-projections P(fsdp, model), row-parallel
+  out-projections P(model, fsdp) — gradient reduce-scatters ride ICI;
+- rotary embeddings are computed from *global* positions so sequence sharding
+  is exact.
+
+bfloat16 activations/matmuls with f32 params + optimizer state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.ring_attention import dense_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    causal: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def rotary_embed(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """RoPE on [B, T, H, D] with explicit global positions [B, T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32) * (math.log(10000.0) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, half]
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h, d = cfg.num_heads, cfg.head_dim
+        qkv = nn.DenseGeneral((3, h, d), use_bias=False, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = rotary_embed(q, positions)
+        k = rotary_embed(k, positions)
+        if self.mesh is not None:
+            o = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
+        else:
+            o = dense_attention(q, k, v, causal=cfg.causal)
+        return nn.DenseGeneral(
+            cfg.embed_dim, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="out"
+        )(o)
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        hidden = cfg.embed_dim * cfg.mlp_ratio
+        up = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="up")(x)
+        gate = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="gate")(x)
+        return nn.Dense(cfg.embed_dim, use_bias=False, dtype=cfg.dtype, name="down")(
+            nn.silu(gate) * up
+        )
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.config, self.mesh, name="attn")(
+            RMSNorm(name="ln1")(x), positions
+        )
+        x = x + MLP(self.config, name="mlp")(RMSNorm(name="ln2")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    config: TransformerConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.config
+        if positions is None:
+            b, t = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        emb = self.param(
+            "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.embed_dim), jnp.float32
+        )
+        x = emb[tokens].astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, self.mesh, name=f"block{i}")(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        # tied output head
+        logits = jnp.einsum("bte,ve->btv", x.astype(jnp.float32), emb)
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+
+def param_sharding_rules(path: Tuple[str, ...]):
+    """Param-tree path -> PartitionSpec (TP column/row split + fsdp)."""
+    from jax.sharding import PartitionSpec as P
+
+    name = "/".join(path)
+    if "qkv/kernel" in name:
+        return P("fsdp", None, "model", None)     # [E, 3, H, D]
+    if "attn/out/kernel" in name:
+        return P("model", None, "fsdp")           # [H, D, E]
+    if "up/kernel" in name or "gate/kernel" in name:
+        return P("fsdp", "model")                 # [E, F]
+    if "down/kernel" in name:
+        return P("model", "fsdp")                 # [F, E]
+    if name == "embed":
+        return P(None, "fsdp")                    # [V, E]
+    return P()  # replicated (norms, biases)
+
+
+def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:
+    """Apply rules with jax.device_put (NamedSharding)."""
+    import flax
+    from jax.sharding import NamedSharding
+
+    flat = flax.traverse_util.flatten_dict(params)
+    out = {
+        k: jax.device_put(v, NamedSharding(mesh, param_sharding_rules(k)))
+        for k, v in flat.items()
+    }
+    return flax.traverse_util.unflatten_dict(out)
+
+
+def param_spec_tree(params: Dict[str, Any]):
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    specs = {k: param_sharding_rules(k) for k in flat}
+    return flax.traverse_util.unflatten_dict(specs)

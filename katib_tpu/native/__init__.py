@@ -1,0 +1,18 @@
+"""Native (C++) components, consumed via ctypes.
+
+Build on demand with ``python -m katib_tpu.native.build`` (g++ -O2 -fPIC
+-shared); every consumer falls back to the pure-Python implementation when
+the shared object is missing, so the framework has no hard toolchain
+dependency.
+"""
+
+from __future__ import annotations
+
+import os
+
+NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+OBSLOG_SO = os.path.join(NATIVE_DIR, "libobslog.so")
+
+
+def obslog_available() -> bool:
+    return os.path.exists(OBSLOG_SO)

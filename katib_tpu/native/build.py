@@ -1,0 +1,34 @@
+"""Build the native components: ``python -m katib_tpu.native.build``."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+from . import NATIVE_DIR, OBSLOG_SO
+
+
+def build(force: bool = False) -> bool:
+    src = os.path.join(NATIVE_DIR, "obslog.cc")
+    if os.path.exists(OBSLOG_SO) and not force:
+        if os.path.getmtime(OBSLOG_SO) >= os.path.getmtime(src):
+            return True
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        print("no C++ compiler found; native obslog store unavailable", file=sys.stderr)
+        return False
+    cmd = [gxx, "-O2", "-fPIC", "-shared", "-std=c++17", "-o", OBSLOG_SO, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        print(f"native build failed:\n{e.stderr}", file=sys.stderr)
+        return False
+    return True
+
+
+if __name__ == "__main__":
+    ok = build(force="--force" in sys.argv)
+    print("built" if ok else "build failed:", OBSLOG_SO)
+    sys.exit(0 if ok else 1)

@@ -1,0 +1,231 @@
+// Native observation-log store engine.
+//
+// C++ counterpart of the reference's data plane (katib-db-manager gRPC server
+// + observation_logs table — reference cmd/db-manager/v1beta1/main.go,
+// pkg/db/v1beta1/mysql/mysql.go:67-166). The schema is the same logical row
+// (trial_name, time, metric_name, value); storage is an append-only binary
+// log per store with an in-memory per-trial index, rebuilt on open by a
+// single sequential scan.
+//
+// Record framing (little-endian):
+//   u32 magic 'KTOB' | u32 record_len | f64 time | u16 trial_len |
+//   u16 metric_len | u16 value_len | bytes... (trial, metric, value)
+// Deletes append a tombstone (trial_len with high bit set); compaction is a
+// rewrite on close when enough rows are dead.
+//
+// Exposed as a C ABI consumed via ctypes (katib_tpu/native/__init__.py);
+// python-side fallback is the SQLite store, so the framework runs without a
+// compiler present.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x424F544B;  // 'KTOB'
+constexpr uint16_t kTombstone = 0x8000;
+
+struct Row {
+  double time;
+  std::string metric;
+  std::string value;
+};
+
+struct Store {
+  std::mutex mu;
+  std::string path;
+  FILE* f = nullptr;
+  std::unordered_map<std::string, std::vector<Row>> index;
+  size_t dead_rows = 0;
+  size_t live_rows = 0;
+};
+
+bool write_record(FILE* f, const std::string& trial, const Row& row,
+                  bool tombstone) {
+  uint16_t tlen = static_cast<uint16_t>(trial.size());
+  if (tombstone) tlen |= kTombstone;
+  uint16_t mlen = static_cast<uint16_t>(row.metric.size());
+  uint16_t vlen = static_cast<uint16_t>(row.value.size());
+  uint32_t rec_len = 8 + 2 + 2 + 2 + (tlen & ~kTombstone) + mlen + vlen;
+  if (std::fwrite(&kMagic, 4, 1, f) != 1) return false;
+  if (std::fwrite(&rec_len, 4, 1, f) != 1) return false;
+  if (std::fwrite(&row.time, 8, 1, f) != 1) return false;
+  if (std::fwrite(&tlen, 2, 1, f) != 1) return false;
+  if (std::fwrite(&mlen, 2, 1, f) != 1) return false;
+  if (std::fwrite(&vlen, 2, 1, f) != 1) return false;
+  if (!trial.empty() && std::fwrite(trial.data(), trial.size(), 1, f) != 1)
+    return false;
+  if (!row.metric.empty() &&
+      std::fwrite(row.metric.data(), row.metric.size(), 1, f) != 1)
+    return false;
+  if (!row.value.empty() &&
+      std::fwrite(row.value.data(), row.value.size(), 1, f) != 1)
+    return false;
+  return true;
+}
+
+void load_index(Store* s) {
+  FILE* f = std::fopen(s->path.c_str(), "rb");
+  if (!f) return;
+  while (true) {
+    uint32_t magic = 0, rec_len = 0;
+    if (std::fread(&magic, 4, 1, f) != 1) break;
+    if (magic != kMagic) break;  // torn tail: stop at first bad frame
+    if (std::fread(&rec_len, 4, 1, f) != 1) break;
+    std::vector<char> buf(rec_len);
+    if (rec_len < 14 || std::fread(buf.data(), 1, rec_len, f) != rec_len) break;
+    double time;
+    uint16_t tlen, mlen, vlen;
+    std::memcpy(&time, buf.data(), 8);
+    std::memcpy(&tlen, buf.data() + 8, 2);
+    std::memcpy(&mlen, buf.data() + 10, 2);
+    std::memcpy(&vlen, buf.data() + 12, 2);
+    bool tombstone = tlen & kTombstone;
+    tlen &= ~kTombstone;
+    if (14 + static_cast<size_t>(tlen) + mlen + vlen > rec_len) break;
+    std::string trial(buf.data() + 14, tlen);
+    if (tombstone) {
+      auto it = s->index.find(trial);
+      if (it != s->index.end()) {
+        s->dead_rows += it->second.size();
+        s->live_rows -= it->second.size();
+        s->index.erase(it);
+      }
+      continue;
+    }
+    Row row;
+    row.time = time;
+    row.metric.assign(buf.data() + 14 + tlen, mlen);
+    row.value.assign(buf.data() + 14 + tlen + mlen, vlen);
+    s->index[trial].push_back(std::move(row));
+    s->live_rows++;
+  }
+  std::fclose(f);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* obslog_open(const char* path) {
+  auto* s = new Store();
+  s->path = path;
+  load_index(s);
+  s->f = std::fopen(path, "ab");
+  if (!s->f) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// rows: arrays of length n. Returns 0 on success.
+int obslog_report(void* handle, const char* trial, const double* times,
+                  const char** metrics, const char** values, int n) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string trial_s(trial);
+  auto& rows = s->index[trial_s];
+  for (int i = 0; i < n; i++) {
+    Row row{times[i], metrics[i], values[i]};
+    if (!write_record(s->f, trial_s, row, false)) return 1;
+    rows.push_back(std::move(row));
+    s->live_rows++;
+  }
+  std::fflush(s->f);
+  return 0;
+}
+
+// Query rows for a trial; metric may be null; start/end may be NaN (no bound).
+// Results are written as a packed buffer the caller frees with obslog_free:
+//   n (i32) then per row: f64 time, u16 metric_len, u16 value_len, bytes.
+// Rows are returned sorted by time (stable).
+char* obslog_get(void* handle, const char* trial, const char* metric,
+                 double start_time, double end_time, int64_t* out_size) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->index.find(trial);
+  std::vector<const Row*> rows;
+  if (it != s->index.end()) {
+    for (const auto& row : it->second) {
+      if (metric && row.metric != metric) continue;
+      if (start_time == start_time && row.time < start_time) continue;
+      if (end_time == end_time && row.time > end_time) continue;
+      rows.push_back(&row);
+    }
+  }
+  std::stable_sort(rows.begin(), rows.end(),
+                   [](const Row* a, const Row* b) { return a->time < b->time; });
+  size_t size = 4;
+  for (const Row* r : rows) size += 8 + 2 + 2 + r->metric.size() + r->value.size();
+  char* out = static_cast<char*>(std::malloc(size));
+  if (!out) return nullptr;
+  char* p = out;
+  int32_t n = static_cast<int32_t>(rows.size());
+  std::memcpy(p, &n, 4);
+  p += 4;
+  for (const Row* r : rows) {
+    std::memcpy(p, &r->time, 8);
+    p += 8;
+    uint16_t mlen = static_cast<uint16_t>(r->metric.size());
+    uint16_t vlen = static_cast<uint16_t>(r->value.size());
+    std::memcpy(p, &mlen, 2);
+    p += 2;
+    std::memcpy(p, &vlen, 2);
+    p += 2;
+    std::memcpy(p, r->metric.data(), mlen);
+    p += mlen;
+    std::memcpy(p, r->value.data(), vlen);
+    p += vlen;
+  }
+  *out_size = static_cast<int64_t>(size);
+  return out;
+}
+
+int obslog_delete(void* handle, const char* trial) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Row empty{0.0, "", ""};
+  if (!write_record(s->f, trial, empty, true)) return 1;
+  std::fflush(s->f);
+  auto it = s->index.find(trial);
+  if (it != s->index.end()) {
+    s->dead_rows += it->second.size();
+    s->live_rows -= it->second.size();
+    s->index.erase(it);
+  }
+  return 0;
+}
+
+void obslog_free(char* buf) { std::free(buf); }
+
+void obslog_close(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->f) std::fclose(s->f);
+    s->f = nullptr;
+    // compact when most of the file is tombstoned rows
+    if (s->dead_rows > s->live_rows && s->dead_rows > 1024) {
+      std::string tmp = s->path + ".compact";
+      FILE* out = std::fopen(tmp.c_str(), "wb");
+      if (out) {
+        bool ok = true;
+        for (const auto& [trial, rows] : s->index)
+          for (const auto& row : rows)
+            if (!write_record(out, trial, row, false)) ok = false;
+        std::fclose(out);
+        if (ok) std::rename(tmp.c_str(), s->path.c_str());
+      }
+    }
+  }
+  delete s;
+}
+
+}  // extern "C"

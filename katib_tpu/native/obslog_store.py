@@ -1,0 +1,127 @@
+"""ctypes binding for the native observation-log store (obslog.cc).
+
+Drop-in ObservationStore implementation; ``open_native_store`` returns None
+when the shared object is absent so callers fall back to SQLite
+(katib_tpu.db.store.open_store semantics preserved).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import struct
+import threading
+from typing import List, Optional, Sequence
+
+from ..db.store import MetricLog, ObservationStore
+from . import OBSLOG_SO, obslog_available
+
+_NAN = float("nan")
+
+
+def _load_lib():
+    lib = ctypes.CDLL(OBSLOG_SO)
+    lib.obslog_open.restype = ctypes.c_void_p
+    lib.obslog_open.argtypes = [ctypes.c_char_p]
+    lib.obslog_report.restype = ctypes.c_int
+    lib.obslog_report.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+    ]
+    lib.obslog_get.restype = ctypes.POINTER(ctypes.c_char)
+    lib.obslog_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.obslog_delete.restype = ctypes.c_int
+    lib.obslog_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.obslog_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.obslog_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeObservationStore(ObservationStore):
+    def __init__(self, path: str):
+        self._lib = _load_lib()
+        self._lock = threading.Lock()
+        self._handle = self._lib.obslog_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot open native observation log at {path}")
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        n = len(logs)
+        if n == 0:
+            return
+        times = (ctypes.c_double * n)(*[l.timestamp for l in logs])
+        metrics = (ctypes.c_char_p * n)(*[l.metric_name.encode() for l in logs])
+        values = (ctypes.c_char_p * n)(*[str(l.value).encode() for l in logs])
+        with self._lock:
+            rc = self._lib.obslog_report(
+                self._handle, trial_name.encode(), times, metrics, values, n
+            )
+        if rc != 0:
+            raise OSError("native observation log write failed")
+
+    def get_observation_log(
+        self,
+        trial_name: str,
+        metric_name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+    ) -> List[MetricLog]:
+        size = ctypes.c_int64(0)
+        with self._lock:
+            buf = self._lib.obslog_get(
+                self._handle,
+                trial_name.encode(),
+                metric_name.encode() if metric_name else None,
+                _NAN if start_time is None else start_time,
+                _NAN if end_time is None else end_time,
+                ctypes.byref(size),
+            )
+        if not buf:
+            return []
+        try:
+            raw = ctypes.string_at(buf, size.value)
+        finally:
+            self._lib.obslog_free(buf)
+        (n,) = struct.unpack_from("<i", raw, 0)
+        pos = 4
+        out: List[MetricLog] = []
+        for _ in range(n):
+            t, mlen, vlen = struct.unpack_from("<dHH", raw, pos)
+            pos += 12
+            metric = raw[pos : pos + mlen].decode()
+            pos += mlen
+            value = raw[pos : pos + vlen].decode()
+            pos += vlen
+            out.append(MetricLog(timestamp=t, metric_name=metric, value=value))
+        return out
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        with self._lock:
+            self._lib.obslog_delete(self._handle, trial_name.encode())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.obslog_close(self._handle)
+                self._handle = None
+
+
+def open_native_store(path: str, auto_build: bool = True) -> Optional[NativeObservationStore]:
+    if not obslog_available() and auto_build:
+        from .build import build
+
+        build()
+    if not obslog_available():
+        return None
+    return NativeObservationStore(path)

@@ -1,0 +1,127 @@
+"""Ring attention — sequence/context parallelism over a named mesh axis.
+
+First-class long-context support (task requirement; absent from the reference,
+which has no model code — SURVEY.md §5 "long-context"): each device holds one
+sequence block of Q/K/V; K/V blocks rotate around the ring via
+``jax.lax.ppermute`` (XLA collective-permute over ICI) while a flash-style
+online softmax accumulates the output, so attention over sequence length T
+costs O(T/p) memory per device and fully overlaps compute with neighbor
+transfers.
+
+Differentiable end-to-end (pure jax ops through shard_map/fori_loop), so the
+same code path serves training. The blockwise update is the standard
+safe-softmax recurrence:
+
+    m' = max(m, rowmax(S))
+    l' = l * e^{m-m'} + rowsum(e^{S-m'})
+    o' = o * e^{m-m'} + e^{S-m'} V
+
+Causal masking uses global positions derived from the device's ring index, so
+a sharded causal LM matches the dense reference exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k_blk, v_blk, o, m, l, q_offset, k_offset, causal, scale):
+    """One ring step: accumulate attention of local q against one K/V block.
+
+    q: [B, Tq, H, D]; k_blk/v_blk: [B, Tk, H, D]; o: [B, Tq, H, D];
+    m, l: [B, H, Tq].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # [B, H, Tq, Tk]
+    if causal:
+        tq, tk = q.shape[1], k_blk.shape[1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where((k_pos > q_pos)[None, None], NEG_INF, s)
+    m_new = jnp.maximum(m, s.max(axis=-1))          # [B, H, Tq]
+    # guard fully-masked rows (m_new == NEG_INF): exp underflows to 0 safely
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
+    """Body to run INSIDE shard_map over ``axis_name``: local blocks of
+    q/k/v shaped [B, T_local, H, D]."""
+    p_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q_offset = my_idx * t_local
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def body(i, carry):
+        k_blk, v_blk, o, m, l = carry
+        src = (my_idx - i) % p_size            # block index currently held
+        o, m, l = _block_attn_update(
+            q, k_blk, v_blk, o, m, l, q_offset, src * t_local, causal, scale
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, o, m, l
+
+    o0 = jnp.zeros_like(q)
+    # Derive the accumulators from q so they inherit its varying-manual-axes
+    # type (fresh constants would mismatch the loop carry under shard_map).
+    base = q[:, :, :, 0].transpose(0, 2, 1)  # [B, H, Tq], varying like q
+    m0 = jnp.full_like(base, NEG_INF)
+    l0 = jnp.zeros_like(base)
+    _, _, o, m, l = jax.lax.fori_loop(0, p_size, body, (k, v, o0, m0, l0))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Reference (unsharded) attention, same layout [B, T, H, D]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh,
+    causal: bool = False,
+    seq_axis: str = "seq",
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str = "model",
+):
+    """shard_map wrapper: q/k/v [B, T, H, D] sharded T over ``seq_axis``,
+    B over ``batch_axes``, H over ``head_axis``. Falls back to dense attention
+    when the mesh has no sequence sharding."""
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get(seq_axis, 1) == 1:
+        return dense_attention(q, k, v, causal=causal)
+
+    spec = P(tuple(a for a in batch_axes if sizes.get(a, 1) > 1) or None, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,100 @@
+"""Device mesh construction + multi-host initialization.
+
+The reference delegates distributed training to per-trial K8s CRDs (PyTorchJob
+DDP / MPIJob Horovod — SURVEY.md §2.9); the TPU-native equivalent is a named
+``jax.sharding.Mesh`` over the trial's gang-allocated chips with XLA
+collectives over ICI within a slice and DCN across slices.
+
+Axis convention (the scaling-book recipe):
+- ``data``  — batch sharding (DP); gradients all-reduce (psum) over ICI
+- ``fsdp``  — parameter/optimizer sharding over the data axis (ZeRO-style)
+- ``model`` — tensor parallelism (TP); activations all-gather / reduce-scatter
+- ``seq``   — sequence/context parallelism (ring attention over ppermute)
+- ``expert``— expert parallelism for MoE layers
+- ``pipe``  — pipeline stages
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "model")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up — jax.distributed.initialize on TPU-VM workers.
+
+    Replaces the reference's dependence on the training-operator to wire
+    MASTER_ADDR/RANK into PyTorchJob pods: here the trial runtime calls this
+    on every host of the slice (no-op when single-process or when JAX already
+    auto-detects TPU pod topology).
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return  # already initialized
+    addr = coordinator_address or os.environ.get("KATIB_TPU_COORDINATOR")
+    nproc = num_processes or int(os.environ.get("KATIB_TPU_NUM_PROCESSES", "0"))
+    pid = process_id if process_id is not None else int(os.environ.get("KATIB_TPU_PROCESS_ID", "0"))
+    if addr and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nproc, process_id=pid
+        )
+
+
+def make_mesh(
+    devices: Optional[Sequence[Any]] = None,
+    *,
+    data: int = -1,
+    fsdp: int = 1,
+    model: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
+):
+    """Build a named Mesh; ``data=-1`` absorbs the remaining devices.
+
+    Axis order puts ``model`` (highest-bandwidth collectives) innermost so TP
+    rides the fastest ICI links, and ``pipe``/``data`` outermost (DCN-friendly)
+    — the standard TPU layout.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = {"pipe": pipe, "data": data, "fsdp": fsdp, "expert": expert, "seq": seq, "model": model}
+    fixed = 1
+    for name, s in sizes.items():
+        if s != -1:
+            fixed *= s
+    if sizes["data"] == -1:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes["data"] = n // fixed
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, got {n}")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def batch_spec():
+    """Canonical activation sharding: batch over data+fsdp, sequence over seq."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(("data", "fsdp"), "seq")

@@ -1,0 +1,137 @@
+"""Distributed training-step builder: pjit over a named mesh with full
+dp/fsdp/tp/sp shardings.
+
+The TPU-native counterpart of the reference's delegated distributed trials
+(PyTorchJob-DDP / MPIJob-Horovod, SURVEY.md §2.9): one jitted step where XLA
+inserts every collective — gradient psum/reduce-scatter over 'data'/'fsdp',
+activation all-gathers for TP ('model'), ring collective-permutes for
+sequence parallelism ('seq').
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    param_spec_tree,
+    param_sharding_rules,
+)
+
+
+def lm_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+
+
+def make_lm_train_step(
+    config: TransformerConfig,
+    mesh,
+    learning_rate: float = 1e-3,
+    seed: int = 0,
+):
+    """Returns (params, opt_state, step_fn, positions_fn).
+
+    step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss),
+    jitted with NamedShardings: tokens/targets P(('data','fsdp'), 'seq'),
+    params per katib_tpu.models.transformer.param_sharding_rules.
+    """
+    import flax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model = TransformerLM(config, mesh=mesh)
+    sample_tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    with jax.default_device(jax.devices()[0]):
+        params = model.init(jax.random.PRNGKey(seed), sample_tokens)["params"]
+
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+
+    # shard params + opt state
+    flat_specs = {
+        k: param_sharding_rules(k)
+        for k in flax.traverse_util.flatten_dict(params)
+    }
+    param_specs = flax.traverse_util.unflatten_dict(flat_specs)
+    params = jax.tree.map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params,
+        param_specs,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+    opt_state = tx.init(params)
+
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
+
+    def step(params, opt_state, tokens, targets, positions):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, positions)
+            return lm_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    def put_batch(tokens, targets, positions=None):
+        import numpy as np
+
+        if positions is None:
+            b, t = tokens.shape
+            positions = np.broadcast_to(np.arange(t, dtype="int32"), (b, t))
+        return (
+            jax.device_put(tokens, batch_sharding),
+            jax.device_put(targets, batch_sharding),
+            jax.device_put(positions, batch_sharding),
+        )
+
+    return params, opt_state, step_fn, put_batch
+
+
+def run_lm_trial(assignments: Dict[str, str], ctx=None) -> None:
+    """HPO trial over the distributed LM: hyperparameters learning_rate,
+    embed_dim, num_layers; reports per-epoch loss. Builds its mesh from the
+    trial's gang-allocated devices (dp [+ tp/sp via assignments])."""
+    import numpy as np
+
+    from .mesh import make_mesh
+
+    lr = float(assignments.get("learning_rate", "1e-3"))
+    embed_dim = int(assignments.get("embed_dim", "128"))
+    num_layers = int(assignments.get("num_layers", "2"))
+    num_heads = int(assignments.get("num_heads", "4"))
+    tp = int(assignments.get("tensor_parallel", "1"))
+    sp = int(assignments.get("sequence_parallel", "1"))
+    steps = int(assignments.get("num_steps", "20"))
+    batch = int(assignments.get("batch_size", "8"))
+    seq_len = int(assignments.get("seq_len", "128"))
+    vocab = int(assignments.get("vocab_size", "512"))
+
+    devices = ctx.jax_devices() or None if ctx is not None else None
+    mesh = make_mesh(devices, model=tp, seq=sp)
+
+    config = TransformerConfig(
+        vocab_size=vocab,
+        embed_dim=embed_dim,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        max_seq_len=seq_len,
+    )
+    params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, lr)
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, vocab, size=(batch, seq_len + 1), dtype=np.int32)
+    for i in range(steps):
+        tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
+        params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
+        if ctx is not None and (i + 1) % 5 == 0:
+            ctx.report(loss=float(loss))
+    if ctx is not None:
+        ctx.report(loss=float(loss))
+    else:
+        print(f"loss={float(loss)}")

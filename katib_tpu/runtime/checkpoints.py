@@ -1,0 +1,90 @@
+"""Checkpoint store — orbax-backed trial state persistence.
+
+Replaces the reference's PVC-based checkpoint flows (SURVEY.md §5):
+- PBT exploit/explore copies a parent trial's checkpoint dir
+  (pbt/service.py:260-268) — here the same directory contract is used by
+  katib_tpu.suggest.pbt, and this module gives trials a typed save/restore
+  API on top of it;
+- trial elastic resume (restart picks up the latest step).
+
+On TPU, orbax writes sharded arrays directly from device memory per host
+(OCDBT); the same API works single-host in tests. Falls back to pickle+numpy
+when orbax is unavailable so the framework has no hard dependency.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class CheckpointStore:
+    """Save/restore a pytree (params, opt state, step...) under a directory."""
+
+    def __init__(self, directory: str, use_orbax: Optional[bool] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        if use_orbax is None:
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                use_orbax = True
+            except ImportError:
+                use_orbax = False
+        self.use_orbax = use_orbax
+
+    # -- orbax path ----------------------------------------------------------
+
+    def _manager(self):
+        import orbax.checkpoint as ocp
+
+        return ocp.CheckpointManager(
+            self.directory, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+        )
+
+    def save(self, step: int, state: Dict[str, Any]) -> None:
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+
+            with self._manager() as mngr:
+                mngr.save(step, args=ocp.args.StandardSave(state))
+                mngr.wait_until_finished()
+        else:
+            host_state = jax.tree.map(np.asarray, state)
+            path = os.path.join(self.directory, f"ckpt_{step}.pkl")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({"step": step, "state": host_state}, f)
+            os.replace(tmp, path)
+
+    def latest_step(self) -> Optional[int]:
+        if self.use_orbax:
+            with self._manager() as mngr:
+                return mngr.latest_step()
+        steps = [
+            int(f[len("ckpt_") : -len(".pkl")])
+            for f in os.listdir(self.directory)
+            if f.startswith("ckpt_") and f.endswith(".pkl")
+        ]
+        return max(steps) if steps else None
+
+    def restore(self, step: Optional[int] = None, template: Optional[Any] = None) -> Optional[Dict[str, Any]]:
+        """Restore state at ``step`` (default latest); None when empty."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+
+            with self._manager() as mngr:
+                if template is not None:
+                    return mngr.restore(step, args=ocp.args.StandardRestore(template))
+                return mngr.restore(step)
+        path = os.path.join(self.directory, f"ckpt_{step}.pkl")
+        with open(path, "rb") as f:
+            return pickle.load(f)["state"]

@@ -32,6 +32,17 @@ class TrialContext:
         early-stopping rules have tripped."""
         self.reporter.report(**metrics)
 
+    def jax_devices(self):
+        """The trial's allocated devices that are real jax.Device objects.
+
+        The scheduler hands out abstract int slots when no accelerator is
+        attached to allocation (subprocess-only experiments); trials building
+        meshes must use this filtered view — empty means "use jax.devices()".
+        """
+        import jax
+
+        return [d for d in (self.devices or []) if isinstance(d, jax.Device)]
+
     def mesh(self, axis_names=("data",), shape=None):
         """Build a jax.sharding.Mesh over this trial's allocated devices.
 
@@ -41,7 +52,7 @@ class TrialContext:
         import numpy as np
         from jax.sharding import Mesh
 
-        devices = self.devices
+        devices = self.jax_devices()
         if not devices:
             import jax
 

@@ -168,7 +168,9 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
     trial = os.environ.get(ENV_TRIAL_NAME)
     db = os.environ.get(ENV_DB_PATH)
     if trial and db:
-        store = open_store(db)
+        # Always SQLite here: the native engine is single-writer-process and
+        # the controller may hold it open; SQLite handles cross-process writes.
+        store = open_store(db, backend="sqlite")
         try:
             MetricsReporter(store=store, trial_name=trial).report(**merged)
         finally:

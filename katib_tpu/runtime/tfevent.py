@@ -1,0 +1,170 @@
+"""TFEvent metrics collector — scalar extraction from tfevent files.
+
+reference pkg/metricscollector/v1beta1/tfevent-metricscollector/
+tfevent_loader.py:45-114 (TFEventFileParser walks the event dir with
+TensorBoard's EventAccumulator and reports named scalars as observation
+logs). This environment ships no TensorFlow/TensorBoard, so the TFRecord
+framing and the Event/Summary protobuf wire format are decoded directly:
+
+- TFRecord frame: u64 length, u32 masked-crc(length), payload,
+  u32 masked-crc(payload)  (CRCs are not verified — tolerant reader);
+- Event proto: wall_time=1 (double), step=2 (int64), summary=5 (message);
+- Summary.Value: tag=1 (string), simple_value=2 (float, TF1) or
+  tensor=8 with float content (TF2 scalar summaries).
+
+Metric naming matches the reference: a metric named "accuracy" matches tags
+"accuracy" and "<anything>/accuracy" (tfevent_loader.py parse_summary).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..db.store import MetricLog
+
+_WIRE_VARINT = 0
+_WIRE_64BIT = 1
+_WIRE_LEN = 2
+_WIRE_32BIT = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield (field_number, wire_type, raw_value) over a message buffer."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == _WIRE_VARINT:
+            v, pos = _read_varint(buf, pos)
+            yield field, wire, v
+        elif wire == _WIRE_64BIT:
+            yield field, wire, buf[pos : pos + 8]
+            pos += 8
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos : pos + ln]
+            pos += ln
+        elif wire == _WIRE_32BIT:
+            yield field, wire, buf[pos : pos + 4]
+            pos += 4
+        else:
+            return  # unknown wire type: stop parsing this message
+
+
+def _parse_tensor_scalar(buf: bytes) -> Optional[float]:
+    """TensorProto: float_val=5 (packed/repeated float), double_val=6,
+    tensor_content=4 (raw bytes), dtype=1."""
+    dtype = None
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == _WIRE_VARINT:
+            dtype = val
+        elif field == 5:
+            if wire == _WIRE_32BIT:
+                return struct.unpack("<f", val)[0]
+            if wire == _WIRE_LEN and len(val) >= 4:
+                return struct.unpack("<f", val[:4])[0]
+        elif field == 6:
+            if wire == _WIRE_64BIT:
+                return struct.unpack("<d", val)[0]
+            if wire == _WIRE_LEN and len(val) >= 8:
+                return struct.unpack("<d", val[:8])[0]
+        elif field == 4 and wire == _WIRE_LEN and val:
+            if dtype in (None, 1) and len(val) >= 4:  # DT_FLOAT
+                return struct.unpack("<f", val[:4])[0]
+            if dtype == 2 and len(val) >= 8:  # DT_DOUBLE
+                return struct.unpack("<d", val[:8])[0]
+    return None
+
+
+def _parse_summary_value(buf: bytes) -> Tuple[Optional[str], Optional[float]]:
+    tag = None
+    value = None
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == _WIRE_LEN:
+            tag = val.decode("utf-8", errors="replace")
+        elif field == 2 and wire == _WIRE_32BIT:
+            value = struct.unpack("<f", val)[0]
+        elif field == 8 and wire == _WIRE_LEN:
+            v = _parse_tensor_scalar(val)
+            if v is not None:
+                value = v
+    return tag, value
+
+
+def _parse_event(buf: bytes) -> Tuple[float, int, List[Tuple[str, float]]]:
+    wall_time = 0.0
+    step = 0
+    scalars: List[Tuple[str, float]] = []
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == _WIRE_64BIT:
+            wall_time = struct.unpack("<d", val)[0]
+        elif field == 2 and wire == _WIRE_VARINT:
+            step = val
+        elif field == 5 and wire == _WIRE_LEN:
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == _WIRE_LEN:
+                    tag, value = _parse_summary_value(v2)
+                    if tag is not None and value is not None:
+                        scalars.append((tag, value))
+    return wall_time, step, scalars
+
+
+def read_tfevents(path: str) -> Iterator[Tuple[float, int, List[Tuple[str, float]]]]:
+    """Yield (wall_time, step, [(tag, value)]) per event record."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    n = len(data)
+    while pos + 12 <= n:
+        (length,) = struct.unpack("<Q", data[pos : pos + 8])
+        pos += 12  # length + length-crc
+        if pos + length > n:
+            break
+        payload = data[pos : pos + length]
+        pos += length + 4  # payload + payload-crc
+        try:
+            yield _parse_event(payload)
+        except (IndexError, struct.error):
+            continue  # truncated/corrupt record
+
+
+def collect_tfevent_metrics(
+    directory: str,
+    metric_names: Sequence[str],
+) -> List[MetricLog]:
+    """Walk a tfevent directory and extract the named scalars
+    (tfevent_loader.py MetricsCollector.parse_file). Tag matching: exact or
+    trailing path component."""
+    wanted = set(metric_names)
+    out: List[MetricLog] = []
+    for root, _dirs, files in os.walk(directory):
+        for fname in sorted(files):
+            if "tfevents" not in fname:
+                continue
+            for wall_time, step, scalars in read_tfevents(os.path.join(root, fname)):
+                for tag, value in scalars:
+                    name = tag if tag in wanted else tag.rsplit("/", 1)[-1]
+                    if name in wanted:
+                        out.append(
+                            MetricLog(
+                                timestamp=wall_time or float(step),
+                                metric_name=name,
+                                value=repr(float(value)),
+                            )
+                        )
+    return sorted(out, key=lambda l: l.timestamp)

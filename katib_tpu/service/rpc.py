@@ -1,0 +1,321 @@
+"""gRPC plane — out-of-process suggestion / early-stopping / DB-manager
+services.
+
+reference pkg/apis/manager/v1beta1/api.proto: services ``Suggestion``
+(GetSuggestions, ValidateAlgorithmSettings), ``EarlyStopping``
+(GetEarlyStoppingRules, SetTrialStatus, ValidateEarlyStoppingSettings) and
+``DBManager`` (ReportObservationLog, GetObservationLog,
+DeleteObservationLog), each served on port 6789 with a gRPC health service
+(cmd/suggestion/*/main.py:26-42, cmd/db-manager/main.go).
+
+The in-process engine (katib_tpu.suggest.base.Suggester, earlystop,
+db.store) is the primary path; this module exposes the SAME contracts over
+gRPC so algorithm services can run as separate processes/pods exactly like
+the reference's per-experiment deployments. Messages are the dataclasses'
+JSON encodings over a generic bytes codec (no protoc codegen dependency —
+grpc_python_plugin is not available in this image; the method surface and
+semantics mirror api.proto one-to-one and are documented per handler).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import grpc
+
+from ..api.spec import EarlyStoppingRule, ExperimentSpec, TrialAssignment
+from ..api.status import Trial, TrialCondition
+from ..db.store import MetricLog, ObservationStore
+from ..earlystop.medianstop import EarlyStopper, create_early_stopper
+from ..suggest.base import Suggester, SuggestionRequest, create
+
+DEFAULT_PORT = 6789
+SERVICE = "katib.tpu.v1.Api"
+
+_ident = lambda b: b
+
+
+def _json_bytes(obj: Any) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _request(experiment: ExperimentSpec, trials: Sequence[Trial], current: int, total: int) -> Dict:
+    return {
+        "experiment": experiment.to_dict(),
+        "trials": [t.to_dict() for t in trials],
+        "currentRequestNumber": current,
+        "totalRequestNumber": total,
+    }
+
+
+class ApiServicer:
+    """All three api.proto services behind one JSON-bytes gRPC service."""
+
+    def __init__(
+        self,
+        suggester_factory: Callable[[str], Suggester] = create,
+        store: Optional[ObservationStore] = None,
+    ):
+        self._suggester_factory = suggester_factory
+        self._suggesters: Dict[str, Suggester] = {}
+        self._early_stoppers: Dict[str, EarlyStopper] = {}
+        self._lock = threading.Lock()
+        self.store = store
+        self.trial_status_overrides: Dict[str, str] = {}
+
+    def _suggester(self, algo: str, experiment_name: str) -> Suggester:
+        key = f"{experiment_name}/{algo}"
+        with self._lock:
+            if key not in self._suggesters:
+                self._suggesters[key] = self._suggester_factory(algo)
+            return self._suggesters[key]
+
+    # -- Suggestion service (api.proto:36-43) -------------------------------
+
+    def get_suggestions(self, payload: Dict) -> Dict:
+        spec = ExperimentSpec.from_dict(payload["experiment"])
+        trials = [Trial.from_dict(t) for t in payload.get("trials", [])]
+        req = SuggestionRequest(
+            experiment=spec,
+            trials=trials,
+            current_request_number=int(payload.get("currentRequestNumber", 0)),
+            total_request_number=int(payload.get("totalRequestNumber", 0)),
+        )
+        reply = self._suggester(spec.algorithm.algorithm_name, spec.name).get_suggestions(req)
+        return {
+            "assignments": [a.to_dict() for a in reply.assignments],
+            "algorithmSettings": reply.algorithm_settings,
+            "searchEnded": reply.search_ended,
+        }
+
+    def validate_algorithm_settings(self, payload: Dict) -> Dict:
+        spec = ExperimentSpec.from_dict(payload["experiment"])
+        self._suggester(spec.algorithm.algorithm_name, spec.name).validate_algorithm_settings(spec)
+        return {}
+
+    # -- EarlyStopping service (api.proto:45-48) -----------------------------
+
+    def _early_stopper(self, algo: str, experiment_name: str) -> EarlyStopper:
+        key = f"{experiment_name}/{algo}"
+        with self._lock:
+            if key not in self._early_stoppers:
+                self._early_stoppers[key] = create_early_stopper(algo)
+            return self._early_stoppers[key]
+
+    def get_early_stopping_rules(self, payload: Dict) -> Dict:
+        spec = ExperimentSpec.from_dict(payload["experiment"])
+        trials = [Trial.from_dict(t) for t in payload.get("trials", [])]
+        assert spec.early_stopping is not None
+        stopper = self._early_stopper(spec.early_stopping.algorithm_name, spec.name)
+        if self.store is None:
+            raise RuntimeError("early stopping service requires an observation store")
+        rules = stopper.get_early_stopping_rules(spec, trials, self.store)
+        return {"earlyStoppingRules": [r.to_dict() for r in rules]}
+
+    def validate_early_stopping_settings(self, payload: Dict) -> Dict:
+        spec = ExperimentSpec.from_dict(payload["experiment"])
+        assert spec.early_stopping is not None
+        self._early_stopper(spec.early_stopping.algorithm_name, spec.name).validate_settings(spec)
+        return {}
+
+    def set_trial_status(self, payload: Dict) -> Dict:
+        """medianstop SetTrialStatus (service.py:193-247): mark EarlyStopped.
+        In-process orchestrators read trial_status_overrides."""
+        self.trial_status_overrides[payload["trialName"]] = TrialCondition.EARLY_STOPPED.value
+        return {}
+
+    # -- DBManager service (api.proto:13-31) ---------------------------------
+
+    def report_observation_log(self, payload: Dict) -> Dict:
+        assert self.store is not None
+        logs = [
+            MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
+            for l in payload.get("metricLogs", [])
+        ]
+        self.store.report_observation_log(payload["trialName"], logs)
+        return {}
+
+    def get_observation_log(self, payload: Dict) -> Dict:
+        assert self.store is not None
+        rows = self.store.get_observation_log(
+            payload["trialName"],
+            metric_name=payload.get("metricName"),
+            start_time=payload.get("startTime"),
+            end_time=payload.get("endTime"),
+        )
+        return {
+            "metricLogs": [
+                {"timestamp": r.timestamp, "metricName": r.metric_name, "value": r.value}
+                for r in rows
+            ]
+        }
+
+    def delete_observation_log(self, payload: Dict) -> Dict:
+        assert self.store is not None
+        self.store.delete_observation_log(payload["trialName"])
+        return {}
+
+    # ------------------------------------------------------------------
+
+    METHODS = {
+        "GetSuggestions": get_suggestions,
+        "ValidateAlgorithmSettings": validate_algorithm_settings,
+        "GetEarlyStoppingRules": get_early_stopping_rules,
+        "ValidateEarlyStoppingSettings": validate_early_stopping_settings,
+        "SetTrialStatus": set_trial_status,
+        "ReportObservationLog": report_observation_log,
+        "GetObservationLog": get_observation_log,
+        "DeleteObservationLog": delete_observation_log,
+    }
+
+
+def _make_handler(servicer: ApiServicer):
+    def handle(method_name: str):
+        fn = ApiServicer.METHODS[method_name]
+
+        def unary_unary(request: bytes, context) -> bytes:
+            try:
+                payload = json.loads(request.decode()) if request else {}
+                return _json_bytes(fn(servicer, payload))
+            except (ValueError, KeyError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except Exception as e:  # pragma: no cover - defensive
+                context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_unary, request_deserializer=_ident, response_serializer=_ident
+        )
+
+    return grpc.method_handlers_generic_handler(
+        SERVICE, {name: handle(name) for name in ApiServicer.METHODS}
+    )
+
+
+def serve(
+    servicer: Optional[ApiServicer] = None,
+    port: int = DEFAULT_PORT,
+    store: Optional[ObservationStore] = None,
+    max_workers: int = 8,
+    block: bool = False,
+) -> grpc.Server:
+    """Start the service — the cmd/suggestion/*/main.py pattern (ThreadPool
+    gRPC server + health service on 0.0.0.0:<port>)."""
+    servicer = servicer or ApiServicer(store=store)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_make_handler(servicer),))
+    try:
+        from grpc_health.v1 import health, health_pb2, health_pb2_grpc
+
+        health_servicer = health.HealthServicer()
+        health_pb2_grpc.add_HealthServicer_to_server(health_servicer, server)
+        health_servicer.set("", health_pb2.HealthCheckResponse.SERVING)
+    except ImportError:
+        pass
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.bound_port = bound  # actual port (when port=0 the OS picks one)
+    server.start()
+    if block:
+        server.wait_for_termination()
+    return server
+
+
+class ApiClient:
+    """JSON-bytes client for the service above."""
+
+    def __init__(self, address: str = f"localhost:{DEFAULT_PORT}", timeout: float = 60.0):
+        self.channel = grpc.insecure_channel(address)
+        self.timeout = timeout
+
+    def _call(self, method: str, payload: Dict) -> Dict:
+        rpc = self.channel.unary_unary(
+            f"/{SERVICE}/{method}", request_serializer=_ident, response_deserializer=_ident
+        )
+        out = rpc(_json_bytes(payload), timeout=self.timeout)
+        return json.loads(out.decode()) if out else {}
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class RemoteSuggester(Suggester):
+    """Suggester backed by a remote service — lets the controller use
+    out-of-process algorithms exactly like the reference's per-experiment
+    suggestion pods (grpc retry: consts/const.go:88-91 is mirrored by the
+    channel's default retry on UNAVAILABLE)."""
+
+    name = "remote"
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self.client = ApiClient(address, timeout=timeout)
+
+    def get_suggestions(self, request: SuggestionRequest):
+        from ..suggest.base import SuggestionReply
+
+        out = self.client._call(
+            "GetSuggestions",
+            _request(
+                request.experiment,
+                request.trials,
+                request.current_request_number,
+                request.total_request_number,
+            ),
+        )
+        return SuggestionReply(
+            assignments=[TrialAssignment.from_dict(a) for a in out.get("assignments", [])],
+            algorithm_settings=dict(out.get("algorithmSettings", {})),
+            search_ended=bool(out.get("searchEnded", False)),
+        )
+
+    def validate_algorithm_settings(self, experiment: ExperimentSpec) -> None:
+        try:
+            self.client._call(
+                "ValidateAlgorithmSettings", {"experiment": experiment.to_dict()}
+            )
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.INVALID_ARGUMENT:
+                raise ValueError(e.details()) from e
+            raise
+
+
+class RemoteObservationStore(ObservationStore):
+    """ObservationStore backed by the remote DBManager — what a trial pod on
+    another host uses to push metrics (api/report_metrics.py push mode)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.client = ApiClient(address, timeout=timeout)
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        self.client._call(
+            "ReportObservationLog",
+            {
+                "trialName": trial_name,
+                "metricLogs": [
+                    {"timestamp": l.timestamp, "metricName": l.metric_name, "value": l.value}
+                    for l in logs
+                ],
+            },
+        )
+
+    def get_observation_log(self, trial_name, metric_name=None, start_time=None, end_time=None):
+        out = self.client._call(
+            "GetObservationLog",
+            {
+                "trialName": trial_name,
+                "metricName": metric_name,
+                "startTime": start_time,
+                "endTime": end_time,
+            },
+        )
+        return [
+            MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
+            for l in out.get("metricLogs", [])
+        ]
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        self.client._call("DeleteObservationLog", {"trialName": trial_name})
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,176 @@
+"""Web UI — REST backend + embedded dashboard.
+
+reference cmd/ui/v1beta1/main.go:42-75 (REST endpoints fetch_experiments,
+fetch_experiment, fetch_hp_job_info, fetch_trial_logs, fetch_suggestion) +
+the Angular frontend (pkg/ui/v1beta1/frontend). The TPU-native replacement is
+a zero-dependency threaded http.server with the same information surface:
+
+  GET /api/experiments                      list with status summary
+  GET /api/experiments/<name>               full spec+status
+  GET /api/experiments/<name>/trials        fetch_hp_job_info view
+  GET /api/experiments/<name>/events        event stream (K8s Events parity)
+  GET /api/experiments/<name>/suggestion    suggestion state
+  GET /api/trials/<name>/metrics            raw observation log (trial logs)
+  GET /api/algorithms                       registered algorithms
+  GET /metrics                              Prometheus text exposition
+  GET /                                     single-page HTML dashboard
+
+Read-only: serves from a live ExperimentController or from a persisted state
+root (``katib-tpu ui --root ...``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import unquote, urlparse
+
+_DASHBOARD = """<!DOCTYPE html>
+<html><head><title>katib-tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+h1{font-size:1.4rem} h2{font-size:1.1rem;margin-top:1.5rem}
+table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+th,td{text-align:left;padding:.4rem .7rem;border-bottom:1px solid #eee;font-size:.9rem}
+th{background:#f0f0f3} .Succeeded{color:#0a7d36}.Failed{color:#b3261e}
+.Running{color:#0b57d0}.EarlyStopped{color:#7b5ea7} code{font-size:.85em}
+</style></head><body>
+<h1>katib-tpu experiments</h1>
+<div id="exps">loading...</div>
+<h2 id="selname"></h2><div id="trials"></div>
+<script>
+async function j(u){return (await fetch(u)).json()}
+const esc=s=>String(s??'').replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+function table(rows, cols){if(!rows.length)return '<i>none</i>';
+ let h='<table><tr>'+cols.map(c=>`<th>${esc(c)}</th>`).join('')+'</tr>';
+ for(const r of rows)h+='<tr>'+cols.map(c=>`<td class="${esc(r[c+'_cls']??'')}">${r[c]??''}</td>`).join('')+'</tr>';
+ return h+'</table>'}
+async function load(){
+ const es=await j('/api/experiments');
+ document.getElementById('exps').innerHTML=table(es.map(e=>({
+  name:`<a href="#" data-name="${esc(e.name)}" class="explink">${esc(e.name)}</a>`,
+  status:esc(e.status),status_cls:e.status,reason:esc(e.reason),algorithm:esc(e.algorithm),
+  succeeded:`${esc(e.trialsSucceeded)}/${esc(e.trials)}`,best:esc(e.bestTrialName)})),
+  ['name','status','reason','algorithm','succeeded','best']);
+ for(const a of document.querySelectorAll('.explink'))
+  a.onclick=(ev)=>{ev.preventDefault();sel(a.dataset.name)}}
+async function sel(n){
+ const ts=await j(`/api/experiments/${encodeURIComponent(n)}/trials`);
+ document.getElementById('selname').textContent=`trials of ${n}`;
+ document.getElementById('trials').innerHTML=table(ts.map(t=>({
+  trial:esc(t.name),status:esc(t.condition),status_cls:t.condition,
+  assignments:`<code>${esc(JSON.stringify(t.assignments))}</code>`,
+  metric:esc(t.objective??'')})),['trial','status','assignments','metric'])}
+load();setInterval(load,3000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    controller = None  # injected by serve_ui
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, payload: Any, content_type="application/json", code=200) -> None:
+        body = (
+            payload.encode()
+            if isinstance(payload, str)
+            else json.dumps(payload).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802
+        ctrl = self.controller
+        path = unquote(urlparse(self.path).path).rstrip("/")
+        try:
+            if path == "" or path == "/":
+                return self._send(_DASHBOARD, "text/html")
+            if path == "/metrics":
+                return self._send(ctrl.metrics.render(), "text/plain; version=0.0.4")
+            if path == "/api/algorithms":
+                from ..earlystop.medianstop import registered_early_stoppers
+                from ..suggest.base import registered_algorithms
+
+                return self._send(
+                    {
+                        "suggestion": sorted(registered_algorithms()),
+                        "earlyStopping": sorted(registered_early_stoppers()),
+                    }
+                )
+            if path == "/api/experiments":
+                out = []
+                for e in ctrl.state.list_experiments():
+                    s = e.status
+                    out.append(
+                        {
+                            "name": e.name,
+                            "status": s.condition.value,
+                            "reason": s.reason.value,
+                            "algorithm": e.spec.algorithm.algorithm_name,
+                            "trials": s.trials,
+                            "trialsSucceeded": s.trials_succeeded,
+                            "trialsFailed": s.trials_failed,
+                            "bestTrialName": s.current_optimal_trial.best_trial_name,
+                        }
+                    )
+                return self._send(out)
+            parts = path.split("/")
+            if len(parts) >= 4 and parts[1] == "api" and parts[2] == "experiments":
+                name = parts[3]
+                exp = ctrl.state.get_experiment(name)
+                if exp is None:
+                    return self._send({"error": f"experiment {name!r} not found"}, code=404)
+                if len(parts) == 4:
+                    return self._send(exp.to_dict())
+                sub = parts[4]
+                if sub == "trials":
+                    out = []
+                    for t in ctrl.state.list_trials(name):
+                        obj = None
+                        if t.observation:
+                            m = t.observation.metric(exp.spec.objective.objective_metric_name)
+                            if m:
+                                obj = m.latest
+                        out.append(
+                            {
+                                "name": t.name,
+                                "condition": t.condition.value,
+                                "assignments": t.assignments_dict(),
+                                "objective": obj,
+                                "labels": t.labels,
+                            }
+                        )
+                    return self._send(out)
+                if sub == "events":
+                    return self._send([e.to_dict() for e in ctrl.events.list(name)])
+                if sub == "suggestion":
+                    s = ctrl.state.get_suggestion(name)
+                    return self._send(s.to_dict() if s else None)
+            if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
+                logs = ctrl.obs_store.get_observation_log(parts[3])
+                return self._send(
+                    [
+                        {"timestamp": l.timestamp, "metric": l.metric_name, "value": l.value}
+                        for l in logs
+                    ]
+                )
+            return self._send({"error": "not found"}, code=404)
+        except Exception as e:  # pragma: no cover - defensive
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+
+
+def serve_ui(controller, host: str = "127.0.0.1", port: int = 8080, block: bool = False):
+    """Start the UI server; returns the ThreadingHTTPServer."""
+    handler = type("BoundHandler", (_Handler,), {"controller": controller})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    if block:
+        httpd.serve_forever()
+    else:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True, name="katib-ui")
+        t.start()
+    return httpd

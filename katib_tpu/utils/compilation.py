@@ -1,0 +1,33 @@
+"""JAX persistent compilation cache setup.
+
+TPU-native operational win with no reference counterpart: trial processes in
+an HPO sweep compile the SAME program shapes over and over (only
+hyperparameter *values* differ, and most are baked as runtime scalars, not
+shapes). Pointing every trial at a shared on-disk XLA compilation cache turns
+the 20-150s first-compile into a cache hit for all subsequent trials —
+usually the single largest wall-clock lever for a 50-trial experiment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "katib_tpu", "xla")
+_initialized = False
+
+
+def enable_compilation_cache(directory: Optional[str] = None) -> str:
+    """Idempotently enable the persistent cache; returns the cache dir."""
+    global _initialized
+    import jax
+
+    cache_dir = directory or os.environ.get("KATIB_TPU_XLA_CACHE", _DEFAULT_DIR)
+    if _initialized:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _initialized = True
+    return cache_dir

@@ -3,8 +3,17 @@ sharding paths (Mesh/pjit/shard_map) are exercised without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize registers a TPU backend at interpreter start and
+# forces jax_platforms to it; tests must run on the virtual CPU mesh for
+# determinism and an 8-device sharding topology, so force it back before any
+# backend initializes.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,69 @@
+"""Native C++ observation-store tests: build, parity with the SQLite store,
+persistence across reopen, tombstone deletes."""
+
+import pytest
+
+from katib_tpu.db.store import MetricLog, fold_observation
+
+
+@pytest.fixture(scope="module")
+def native_cls():
+    from katib_tpu.native.build import build
+
+    if not build():
+        pytest.skip("no C++ toolchain")
+    from katib_tpu.native.obslog_store import NativeObservationStore
+
+    return NativeObservationStore
+
+
+def logs(*rows):
+    return [MetricLog(timestamp=t, metric_name=n, value=v) for (t, n, v) in rows]
+
+
+class TestNativeStore:
+    def test_report_get_parity(self, native_cls, tmp_path):
+        s = native_cls(str(tmp_path / "obs.ktob"))
+        s.report_observation_log("t1", logs((2.0, "acc", "0.7"), (1.0, "acc", "0.5")))
+        got = s.get_observation_log("t1")
+        # sorted by time like the SQLite query
+        assert [(r.timestamp, r.value) for r in got] == [(1.0, "0.5"), (2.0, "0.7")]
+        assert s.get_observation_log("t1", metric_name="nope") == []
+        assert len(s.get_observation_log("t1", start_time=1.5)) == 1
+        s.close()
+
+    def test_persistence_across_reopen(self, native_cls, tmp_path):
+        p = str(tmp_path / "obs.ktob")
+        s = native_cls(p)
+        s.report_observation_log("t1", logs((1.0, "m", "1"), (2.0, "m", "2")))
+        s.report_observation_log("t2", logs((1.0, "m", "9")))
+        s.delete_observation_log("t2")
+        s.close()
+
+        s2 = native_cls(p)
+        assert [r.value for r in s2.get_observation_log("t1")] == ["1", "2"]
+        assert s2.get_observation_log("t2") == []  # tombstone replayed
+        s2.close()
+
+    def test_fold_compatible(self, native_cls, tmp_path):
+        s = native_cls(str(tmp_path / "obs.ktob"))
+        s.report_observation_log("t", logs((1.0, "acc", "0.2"), (2.0, "acc", "0.9")))
+        obs = fold_observation(s.get_observation_log("t"), ["acc"])
+        m = obs.metric("acc")
+        assert float(m.min) == 0.2 and float(m.max) == 0.9 and float(m.latest) == 0.9
+        s.close()
+
+    def test_unicode_and_empty_values(self, native_cls, tmp_path):
+        s = native_cls(str(tmp_path / "obs.ktob"))
+        s.report_observation_log("t-ü", logs((1.0, "métric", "nän")))
+        got = s.get_observation_log("t-ü")
+        assert got[0].metric_name == "métric" and got[0].value == "nän"
+        s.close()
+
+    def test_open_store_native_backend(self, native_cls, tmp_path):
+        from katib_tpu.db.store import open_store
+
+        s = open_store(str(tmp_path / "obs.db"), backend="native")
+        s.report_observation_log("t", logs((1.0, "m", "1")))
+        assert len(s.get_observation_log("t")) == 1
+        s.close()

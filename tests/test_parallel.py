@@ -1,0 +1,112 @@
+"""Distributed-layer tests on the 8-device virtual CPU mesh:
+ring attention numerics vs dense, mesh factoring, sharded LM train step
+(dp/fsdp/tp/sp), gradient flow through the ring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.ops.ring_attention import dense_attention, ring_attention
+from katib_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+class TestMesh:
+    def test_factoring(self, devices):
+        mesh = make_mesh(devices, model=2, seq=2)
+        sizes = mesh_axis_sizes(mesh)
+        assert sizes["model"] == 2 and sizes["seq"] == 2 and sizes["data"] == 2
+
+    def test_bad_factoring(self, devices):
+        with pytest.raises(ValueError):
+            make_mesh(devices, model=3)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, devices, causal):
+        mesh = make_mesh(devices, seq=4)  # data=2, seq=4
+        rng = np.random.default_rng(0)
+        b, t, h, d = 2, 32, 4, 8
+        q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+
+        expected = dense_attention(q, k, v, causal=causal)
+        with mesh:
+            got = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_differentiable(self, devices):
+        mesh = make_mesh(devices, seq=4)
+        rng = np.random.default_rng(1)
+        b, t, h, d = 2, 16, 2, 4
+        q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+
+        def ring_loss(q, k, v):
+            with mesh:
+                return ring_attention(q, k, v, mesh, causal=True).sum()
+
+        def dense_loss(q, k, v):
+            return dense_attention(q, k, v, causal=True).sum()
+
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=2e-4, rtol=2e-4)
+
+    def test_single_shard_fallback(self, devices):
+        mesh = make_mesh(devices)  # seq=1 -> dense path
+        q = jnp.ones((2, 8, 2, 4))
+        out = ring_attention(q, q, q, mesh, causal=False)
+        assert out.shape == q.shape
+
+
+class TestShardedTrainStep:
+    def test_dp_tp_sp_step_runs_and_learns(self, devices):
+        from katib_tpu.models.transformer import TransformerConfig
+        from katib_tpu.parallel.train import make_lm_train_step
+
+        mesh = make_mesh(devices, model=2, seq=2)  # data=2, model=2, seq=2
+        config = TransformerConfig(
+            vocab_size=64, embed_dim=32, num_layers=2, num_heads=2, max_seq_len=32,
+            dtype=jnp.float32,
+        )
+        params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, 1e-2)
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 64, size=(4, 33), dtype=np.int32)
+        losses = []
+        for _ in range(10):
+            tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # memorizes the repeated batch
+        # params actually sharded over the mesh
+        import flax
+
+        flat = flax.traverse_util.flatten_dict(params)
+        qkv = [v for k, v in flat.items() if "qkv" in k][0]
+        assert len(qkv.sharding.device_set) == 8
+
+    def test_run_lm_trial_entry(self, devices):
+        from katib_tpu.parallel.train import run_lm_trial
+
+        # entry-point smoke: dp-only tiny run without a ctx
+        run_lm_trial(
+            {
+                "learning_rate": "1e-3", "embed_dim": "16", "num_layers": "1",
+                "num_heads": "2", "num_steps": "2", "batch_size": "8",
+                "seq_len": "16", "vocab_size": "32",
+            }
+        )

@@ -1,0 +1,131 @@
+"""Metric collector tests: TEXT/JSON line parsers, tfevent decoding, and the
+checkpoint store. Models reference tfevent collector tests
+(test/unit/v1beta1/metricscollector) with a hand-encoded event file instead
+of checked-in TF fixtures."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from katib_tpu.db.store import MetricLog
+from katib_tpu.runtime.metrics import parse_json_lines, parse_text_lines
+from katib_tpu.runtime.tfevent import collect_tfevent_metrics, read_tfevents
+
+
+# -- minimal protobuf/TFRecord writer (test-side encoder) --------------------
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _len_field(num: int, payload: bytes) -> bytes:
+    return _field(num, 2) + _varint(len(payload)) + payload
+
+
+def encode_event(wall_time: float, step: int, scalars, use_tensor=False) -> bytes:
+    summary = b""
+    for tag, value in scalars:
+        if use_tensor:
+            tensor = _field(1, 0) + _varint(1)  # dtype DT_FLOAT
+            tensor += _len_field(5, struct.pack("<f", value))  # packed float_val
+            val_msg = _len_field(1, tag.encode()) + _len_field(8, tensor)
+        else:
+            val_msg = _len_field(1, tag.encode()) + _field(2, 5) + struct.pack("<f", value)
+        summary += _len_field(1, val_msg)
+    event = _field(1, 1) + struct.pack("<d", wall_time)
+    event += _field(2, 0) + _varint(step)
+    event += _len_field(5, summary)
+    return event
+
+
+def write_tfrecord(path, events) -> None:
+    with open(path, "wb") as f:
+        for payload in events:
+            f.write(struct.pack("<Q", len(payload)))
+            f.write(b"\x00" * 4)  # length crc (not verified)
+            f.write(payload)
+            f.write(b"\x00" * 4)  # data crc
+
+
+class TestTfEvent:
+    def test_simple_value_scalars(self, tmp_path):
+        p = tmp_path / "events.out.tfevents.123.host"
+        write_tfrecord(
+            p,
+            [
+                encode_event(100.0, 1, [("accuracy", 0.5), ("loss", 1.2)]),
+                encode_event(101.0, 2, [("train/accuracy", 0.7)]),
+            ],
+        )
+        logs = collect_tfevent_metrics(str(tmp_path), ["accuracy"])
+        assert [round(float(l.value), 4) for l in logs] == [0.5, 0.7]
+        assert all(l.metric_name == "accuracy" for l in logs)
+
+    def test_tensor_scalars_tf2_style(self, tmp_path):
+        p = tmp_path / "events.out.tfevents.tf2"
+        write_tfrecord(p, [encode_event(50.0, 1, [("accuracy", 0.25)], use_tensor=True)])
+        logs = collect_tfevent_metrics(str(tmp_path), ["accuracy", "loss"])
+        assert len(logs) == 1 and round(float(logs[0].value), 4) == 0.25
+
+    def test_corrupt_tail_tolerated(self, tmp_path):
+        p = tmp_path / "events.out.tfevents.corrupt"
+        write_tfrecord(p, [encode_event(1.0, 1, [("m", 1.0)])])
+        with open(p, "ab") as f:
+            f.write(b"\x99" * 7)  # truncated garbage frame
+        assert len(list(read_tfevents(str(p)))) == 1
+
+
+class TestLineParsers:
+    def test_text_default_filter(self):
+        lines = ["epoch 1", "accuracy=0.91 loss=0.3", "noise", "accuracy = 0.95"]
+        logs = parse_text_lines(lines, ["accuracy", "loss"], base_time=0.0)
+        assert [(l.metric_name, l.value) for l in logs] == [
+            ("accuracy", "0.91"),
+            ("loss", "0.3"),
+            ("accuracy", "0.95"),
+        ]
+        # report order is preserved through synthetic timestamps
+        assert logs[0].timestamp < logs[2].timestamp
+
+    def test_text_custom_filter(self):
+        lines = ["{metricName: acc, metricValue: 0.85}"]
+        logs = parse_text_lines(
+            lines, ["acc"], filters=[r"{metricName: ([\w|-]+), metricValue: ((-?\d+)(\.\d+)?)}"]
+        )
+        assert logs[0].value == "0.85"
+
+    def test_json_lines(self):
+        lines = ['{"acc": 0.5, "step": 1}', "not json", '{"acc": "0.9", "timestamp": 42.0}']
+        logs = parse_json_lines(lines, ["acc"], base_time=0.0)
+        assert [l.value for l in logs] == ["0.5", "0.9"]
+        assert logs[1].timestamp == 42.0
+
+
+class TestCheckpointStore:
+    @pytest.mark.parametrize("use_orbax", [False, True])
+    def test_roundtrip(self, tmp_path, use_orbax):
+        if use_orbax:
+            pytest.importorskip("orbax.checkpoint")
+        from katib_tpu.runtime.checkpoints import CheckpointStore
+
+        store = CheckpointStore(str(tmp_path / "ckpt"), use_orbax=use_orbax)
+        state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "step": np.int32(7)}
+        store.save(1, state)
+        store.save(3, {"w": state["w"] * 2, "step": np.int32(9)})
+        assert store.latest_step() == 3
+        restored = store.restore()
+        np.testing.assert_allclose(restored["w"], state["w"] * 2)
+        old = store.restore(step=1)
+        np.testing.assert_allclose(old["w"], state["w"])

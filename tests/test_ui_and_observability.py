@@ -1,0 +1,138 @@
+"""UI REST backend + events + Prometheus metrics + config tests.
+
+Models the reference UI backend surface (cmd/ui/v1beta1/main.go REST routes)
+and the observability parity items (SURVEY.md §5).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.controller.experiment import ExperimentController
+from katib_tpu.ui.server import serve_ui
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ui")
+    ctrl = ExperimentController(root_dir=str(tmp), devices=list(range(2)))
+    spec = ExperimentSpec(
+        name="ui-exp",
+        parameters=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(function=lambda a, c: c.report(score=float(a["x"]))),
+        max_trial_count=3,
+        parallel_trial_count=2,
+    )
+    ctrl.create_experiment(spec)
+    ctrl.run("ui-exp", timeout=60)
+    httpd = serve_ui(ctrl, port=0)
+    port = httpd.server_address[1]
+    yield f"http://127.0.0.1:{port}", ctrl
+    httpd.shutdown()
+    ctrl.close()
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+        return r.status, r.headers.get("Content-Type", ""), body
+
+
+class TestUIServer:
+    def test_experiment_list(self, stack):
+        base, _ = stack
+        status, ctype, body = get(f"{base}/api/experiments")
+        assert status == 200 and "json" in ctype
+        exps = json.loads(body)
+        assert exps[0]["name"] == "ui-exp"
+        assert exps[0]["status"] == "Succeeded"
+        assert exps[0]["trialsSucceeded"] == 3
+        assert exps[0]["bestTrialName"]
+
+    def test_experiment_detail_and_trials(self, stack):
+        base, _ = stack
+        _, _, body = get(f"{base}/api/experiments/ui-exp")
+        detail = json.loads(body)
+        assert detail["spec"]["algorithm"]["algorithmName"] == "random"
+        _, _, body = get(f"{base}/api/experiments/ui-exp/trials")
+        trials = json.loads(body)
+        assert len(trials) == 3
+        assert all(t["condition"] == "Succeeded" for t in trials)
+        assert all("x" in t["assignments"] for t in trials)
+
+    def test_trial_metrics(self, stack):
+        base, ctrl = stack
+        trial = ctrl.state.list_trials("ui-exp")[0]
+        _, _, body = get(f"{base}/api/trials/{trial.name}/metrics")
+        logs = json.loads(body)
+        assert logs and logs[0]["metric"] == "score"
+
+    def test_events(self, stack):
+        base, _ = stack
+        _, _, body = get(f"{base}/api/experiments/ui-exp/events")
+        events = json.loads(body)
+        reasons = {e["reason"] for e in events}
+        assert "ExperimentCreated" in reasons
+        assert "TrialCreated" in reasons
+        assert any(e["kind"] == "Trial" and e["reason"] == "TrialSucceeded" for e in events)
+
+    def test_prometheus_metrics(self, stack):
+        base, _ = stack
+        status, ctype, body = get(f"{base}/metrics")
+        assert status == 200 and "text/plain" in ctype
+        assert 'katib_experiment_created_total{experiment="ui-exp"} 1.0' in body
+        assert 'katib_trial_succeeded_total{experiment="ui-exp"} 3.0' in body
+        assert 'katib_experiment_succeeded_total{experiment="ui-exp"} 1.0' in body
+
+    def test_dashboard_and_404(self, stack):
+        base, _ = stack
+        status, ctype, body = get(f"{base}/")
+        assert status == 200 and "html" in ctype and "katib-tpu" in body
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(f"{base}/api/experiments/nope")
+        assert ei.value.code == 404
+
+    def test_algorithms_endpoint(self, stack):
+        base, _ = stack
+        _, _, body = get(f"{base}/api/algorithms")
+        algos = json.loads(body)
+        assert "tpe" in algos["suggestion"] and "medianstop" in algos["earlyStopping"]
+
+
+class TestConfig:
+    def test_load_roundtrip(self, tmp_path):
+        from katib_tpu.config import KatibConfig, load_config
+
+        cfg_path = tmp_path / "katib-config.json"
+        cfg_path.write_text(json.dumps({
+            "runtime": {"default_parallel_trial_count": 5, "obslog_backend": "sqlite"},
+            "suggestions": {"tpe": {"defaultSettings": {"n_startup_trials": "7"}}},
+            "earlyStopping": {"medianstop": {"defaultSettings": {"start_step": "2"}}},
+        }))
+        cfg = load_config(str(cfg_path))
+        assert cfg.runtime.default_parallel_trial_count == 5
+        assert cfg.suggestions["tpe"].default_settings["n_startup_trials"] == "7"
+        again = KatibConfig.from_dict(cfg.to_dict())
+        assert again.to_dict() == cfg.to_dict()
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        from katib_tpu.config import load_config
+
+        monkeypatch.setenv("KATIB_TPU_OBSLOG_BACKEND", "native")
+        cfg = load_config(None)
+        assert cfg.runtime.obslog_backend == "native"
