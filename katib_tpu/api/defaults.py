@@ -33,15 +33,16 @@ def _default_strategy_for(objective_type: ObjectiveType) -> MetricStrategyType:
     return MetricStrategyType.LATEST
 
 
-def set_defaults(spec: ExperimentSpec) -> ExperimentSpec:
+def set_defaults(spec: ExperimentSpec, default_parallel: int = None) -> ExperimentSpec:
     """Fill all defaultable fields in place (and return the spec).
 
     Order follows Experiment.SetDefault (experiment_defaults.go:27-33):
     parallelTrialCount, resumePolicy, objective metric strategies,
-    trial template conditions, metrics collector.
+    trial template conditions, metrics collector. ``default_parallel``
+    overrides the built-in parallel-trial default (KatibConfig runtime).
     """
     if spec.parallel_trial_count is None:
-        spec.parallel_trial_count = DEFAULT_PARALLEL_TRIAL_COUNT
+        spec.parallel_trial_count = default_parallel or DEFAULT_PARALLEL_TRIAL_COUNT
     if not spec.resume_policy:
         spec.resume_policy = DEFAULT_RESUME_POLICY
 

@@ -129,6 +129,17 @@ class FeasibleSpace:
     step: Optional[str] = None
     distribution: Optional[Distribution] = None
 
+    def __post_init__(self):
+        # accept plain strings ("logUniform") and numbers at the API boundary
+        if self.distribution is not None and not isinstance(self.distribution, Distribution):
+            self.distribution = Distribution(self.distribution)
+        for f in ("min", "max", "step"):
+            v = getattr(self, f)
+            if v is not None and not isinstance(v, str):
+                setattr(self, f, str(v))
+        if self.list is not None:
+            self.list = [str(x) for x in self.list]
+
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
         if self.min is not None:

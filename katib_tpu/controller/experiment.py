@@ -55,20 +55,31 @@ class ExperimentController:
         root_dir: Optional[str] = None,
         devices: Optional[Sequence[Any]] = None,
         persist: bool = True,
+        config: Optional["KatibConfig"] = None,
     ):
+        from ..config import load_config
+
+        self.config = config if config is not None else load_config()
+        rt = self.config.runtime
+        if rt.xla_cache_dir:
+            # picked up by utils.compilation.enable_compilation_cache in
+            # whichever process first touches JAX
+            os.environ.setdefault("KATIB_TPU_XLA_CACHE", rt.xla_cache_dir)
         self.root_dir = root_dir
         state_root = os.path.join(root_dir, "state") if (root_dir and persist) else None
         db_path = os.path.join(root_dir, "observations.db") if root_dir else None
         self.state = ExperimentStateStore(state_root)
-        self.obs_store: ObservationStore = open_store(db_path)
+        self.obs_store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
         self.db_path = db_path
-        self.suggestions = SuggestionService(self.state, self.obs_store)
+        self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
         from .events import EventRecorder, MetricsRegistry
 
         self.events = EventRecorder()
         self.metrics = MetricsRegistry()
         self._completed_seen: set = set()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
+        if devices is not None and rt.devices_per_host:
+            devices = list(devices)[: rt.devices_per_host]
         self.scheduler = TrialScheduler(
             self.state,
             self.obs_store,
@@ -77,6 +88,9 @@ class ExperimentController:
             workdir_root=workdir_root,
             events=self.events,
             metrics=self.metrics,
+            trial_timeout=rt.trial_timeout_seconds,
+            max_trial_restarts=rt.max_trial_restarts,
+            poll_interval=rt.metrics_poll_interval,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -84,7 +98,7 @@ class ExperimentController:
     def create_experiment(self, spec: ExperimentSpec) -> Experiment:
         """Defaulting + validation webhooks, then experiment creation
         (SURVEY.md §3.1)."""
-        set_defaults(spec)
+        set_defaults(spec, default_parallel=self.config.runtime.default_parallel_trial_count)
         validate_experiment(
             spec,
             known_algorithms=registered_algorithms(),

@@ -86,6 +86,9 @@ class TrialScheduler:
         workdir_root: Optional[str] = None,
         events=None,
         metrics=None,
+        trial_timeout: Optional[float] = None,
+        max_trial_restarts: int = 0,
+        poll_interval: Optional[float] = None,
     ):
         self.recorder = events
         self.metrics_registry = metrics
@@ -96,8 +99,13 @@ class TrialScheduler:
         self.obs_store = obs_store
         self.events: "queue.Queue[TrialEvent]" = queue.Queue()
         self.workdir_root = workdir_root
+        self.trial_timeout = trial_timeout
+        self.max_trial_restarts = max_trial_restarts
+        self._restarts: Dict[str, int] = {}
         self._in_process = InProcessExecutor(obs_store)
         self._subprocess = SubprocessExecutor(obs_store, db_path=db_path)
+        if poll_interval:
+            self._subprocess.POLL_INTERVAL = poll_interval
         self._handles: Dict[str, TrialExecution] = {}
         self._pending: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -183,9 +191,21 @@ class TrialScheduler:
             self._waiting = still_waiting
 
     def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
+        restarted = False
+        timer = None
+        timed_out = threading.Event()
         try:
             trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "Trial is running")
             self.state.update_trial(trial)
+
+            if self.trial_timeout:
+                def _deadline():
+                    timed_out.set()
+                    handle.kill()
+
+                timer = threading.Timer(self.trial_timeout, _deadline)
+                timer.daemon = True
+                timer.start()
 
             ctx = self._build_context(exp, trial, devices)
             spec = exp.spec
@@ -194,16 +214,46 @@ class TrialScheduler:
             else:
                 result = self._in_process.execute(exp, trial, ctx, handle)
 
-            self._finalize(exp, trial, result)
+            if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
+                # deadline exceeded counts against maxFailedTrialCount
+                result = ExecutionResult(
+                    TrialOutcome.FAILED,
+                    f"trial exceeded timeout of {self.trial_timeout}s",
+                )
+            restarted = self._maybe_restart(exp, trial, result)
+            if not restarted:
+                self._finalize(exp, trial, result)
         except Exception:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
             self.state.update_trial(trial)
         finally:
+            if timer is not None:
+                timer.cancel()
             self.allocator.release(devices)
             self._handles.pop(trial.name, None)
-            self._checkpoint_dirs.pop(trial.name, None)
+            if not restarted:
+                self._checkpoint_dirs.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
+
+    def _maybe_restart(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> bool:
+        """Retry failed trials up to KatibConfig max_trial_restarts times
+        (the reference leaves retries to the trial job's backoffLimit)."""
+        if result.outcome != TrialOutcome.FAILED or not self.max_trial_restarts:
+            return False
+        attempts = self._restarts.get(trial.name, 0)
+        if attempts >= self.max_trial_restarts:
+            return False
+        self._restarts[trial.name] = attempts + 1
+        trial.set_condition(
+            TrialCondition.PENDING,
+            "TrialRestarting",
+            f"retry {attempts + 1}/{self.max_trial_restarts}: {result.message}",
+        )
+        self.state.update_trial(trial)
+        with self._lock:
+            self._waiting.append((exp, trial))
+        return True
 
     def _build_context(self, exp: Experiment, trial: Trial, devices) -> TrialContext:
         spec = exp.spec

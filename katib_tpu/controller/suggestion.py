@@ -38,12 +38,27 @@ class SuggestionService:
     """One instance per orchestrator; holds per-experiment Suggester and
     EarlyStopper instances (the reference's per-experiment suggestion pods)."""
 
-    def __init__(self, state: ExperimentStateStore, obs_store: ObservationStore):
+    def __init__(
+        self,
+        state: ExperimentStateStore,
+        obs_store: ObservationStore,
+        config=None,
+    ):
         self.state = state
         self.obs_store = obs_store
+        self.config = config  # KatibConfig; per-algorithm overrides (types.go)
         self._suggesters: Dict[str, Suggester] = {}
         self._early_stoppers: Dict[str, EarlyStopper] = {}
         self._search_ended: Dict[str, bool] = {}
+
+    @staticmethod
+    def _import_class(import_path: str):
+        import importlib
+
+        mod_name, _, cls_name = import_path.partition(":")
+        if not cls_name:
+            raise ValueError(f"importPath {import_path!r} must be 'module:ClassName'")
+        return getattr(importlib.import_module(mod_name), cls_name)
 
     def suggester_for(self, exp: Experiment) -> Suggester:
         name = exp.name
@@ -61,7 +76,18 @@ class SuggestionService:
                 )
             elif algo == "enas":
                 kwargs["state_dir"] = exp_dir
-            self._suggesters[name] = create(algo, **kwargs)
+            # KatibConfig per-algorithm override: out-of-process service
+            # address (the reference's per-experiment suggestion pod) or a
+            # custom implementation import path (the custom container image).
+            scfg = self.config.suggestions.get(algo) if self.config else None
+            if scfg is not None and scfg.service_address:
+                from ..service.rpc import RemoteSuggester
+
+                self._suggesters[name] = RemoteSuggester(scfg.service_address)
+            elif scfg is not None and scfg.import_path:
+                self._suggesters[name] = self._import_class(scfg.import_path)(**kwargs)
+            else:
+                self._suggesters[name] = create(algo, **kwargs)
         return self._suggesters[name]
 
     def early_stopper_for(self, exp: Experiment) -> Optional[EarlyStopper]:
@@ -69,9 +95,12 @@ class SuggestionService:
             return None
         name = exp.name
         if name not in self._early_stoppers:
-            self._early_stoppers[name] = create_early_stopper(
-                exp.spec.early_stopping.algorithm_name
-            )
+            algo = exp.spec.early_stopping.algorithm_name
+            ecfg = self.config.early_stopping.get(algo) if self.config else None
+            if ecfg is not None and ecfg.import_path:
+                self._early_stoppers[name] = self._import_class(ecfg.import_path)()
+            else:
+                self._early_stoppers[name] = create_early_stopper(algo)
         return self._early_stoppers[name]
 
     def validate(self, exp: Experiment) -> None:
@@ -125,6 +154,7 @@ class SuggestionService:
             filled = ExperimentSpec.from_json(exp.spec.to_json())
             if exp.spec.trial_template.function is not None:
                 filled.trial_template.function = exp.spec.trial_template.function
+            self._apply_config_defaults(filled)
             self._overlay_settings(filled, suggestion.algorithm_settings)
 
             request = SuggestionRequest(
@@ -163,6 +193,29 @@ class SuggestionService:
 
         trial_names = {t.name for t in trials}
         return [a for a in suggestion.suggestions if a.name not in trial_names]
+
+    def _apply_config_defaults(self, spec: ExperimentSpec) -> None:
+        """KatibConfig defaultSettings fill unset algorithm settings
+        (reference SuggestionConfig defaults merged by the composer)."""
+        if self.config is None:
+            return
+        scfg = self.config.suggestions.get(spec.algorithm.algorithm_name)
+        if scfg is not None and scfg.default_settings:
+            existing = {s.name for s in spec.algorithm.algorithm_settings}
+            for k, v in scfg.default_settings.items():
+                if k not in existing:
+                    spec.algorithm.algorithm_settings.append(
+                        AlgorithmSetting(name=k, value=str(v))
+                    )
+        if spec.early_stopping is not None:
+            ecfg = self.config.early_stopping.get(spec.early_stopping.algorithm_name)
+            if ecfg is not None and ecfg.default_settings:
+                existing = {s.name for s in spec.early_stopping.algorithm_settings}
+                for k, v in ecfg.default_settings.items():
+                    if k not in existing:
+                        spec.early_stopping.algorithm_settings.append(
+                            AlgorithmSetting(name=k, value=str(v))
+                        )
 
     @staticmethod
     def _overlay_settings(spec: ExperimentSpec, settings: Dict[str, str]) -> None:

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.flash_attention import flash_attention, sharded_flash_attention
 from ..ops.ring_attention import dense_attention, ring_attention
 
 
@@ -84,9 +85,17 @@ class Attention(nn.Module):
         q = rotary_embed(q, positions)
         k = rotary_embed(k, positions)
         if self.mesh is not None:
-            o = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
+            from ..parallel.mesh import mesh_axis_sizes
+
+            sizes = mesh_axis_sizes(self.mesh)
+            if sizes.get("seq", 1) > 1:
+                # cross-device sequence blocks: ring schedule over ppermute
+                o = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
+            else:
+                # seq unsharded: fused Pallas flash kernel per local shard
+                o = sharded_flash_attention(q, k, v, self.mesh, causal=cfg.causal)
         else:
-            o = dense_attention(q, k, v, causal=cfg.causal)
+            o = flash_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(
             cfg.embed_dim, axis=(-2, -1), use_bias=False, dtype=cfg.dtype, name="out"
         )(o)

@@ -78,8 +78,13 @@ void load_index(Store* s) {
     if (std::fread(&magic, 4, 1, f) != 1) break;
     if (magic != kMagic) break;  // torn tail: stop at first bad frame
     if (std::fread(&rec_len, 4, 1, f) != 1) break;
+    // Bound before allocating: a torn/corrupt length field must stop the
+    // scan, not trigger a multi-GiB allocation. Max legal record is the
+    // header plus three max-u16 strings.
+    constexpr uint32_t kMaxRecord = 14 + 3u * 65535u;
+    if (rec_len < 14 || rec_len > kMaxRecord) break;
     std::vector<char> buf(rec_len);
-    if (rec_len < 14 || std::fread(buf.data(), 1, rec_len, f) != rec_len) break;
+    if (std::fread(buf.data(), 1, rec_len, f) != rec_len) break;
     double time;
     uint16_t tlen, mlen, vlen;
     std::memcpy(&time, buf.data(), 8);

@@ -1,0 +1,394 @@
+"""Flash attention — fused Pallas TPU kernels for the unsharded-sequence path.
+
+The hot op of the transformer trial runtime (katib_tpu.models.transformer).
+The reference has no kernel code at all (its trials delegate to
+PyTorch/TF images — SURVEY.md §2.8/§2.9); on TPU the idiomatic equivalent is
+a Pallas kernel that keeps the O(T^2) score matrix out of HBM entirely:
+Q/K/V blocks stream HBM→VMEM, scores live only as a [block_q, block_k] VMEM
+tile feeding the MXU, and the online-softmax recurrence
+
+    m' = max(m, rowmax(S));  l' = l·e^{m−m'} + rowsum(e^{S−m'})
+    acc' = acc·e^{m−m'} + e^{S−m'}·V
+
+accumulates the output in fp32 scratch. The backward pass is the standard
+two-kernel recomputation (dQ with KV innermost; dK/dV with Q innermost) from
+the saved logsumexp — no attention matrix is ever materialized in either
+direction.
+
+Sequence-sharded attention is handled by katib_tpu.ops.ring_attention (the
+ring schedule rotates K/V between devices); this kernel is the within-device
+fast path and the two compose: ring for cross-device blocks, flash for the
+local block compute.
+
+Falls back to interpret mode off-TPU (CPU tests) and to dense attention for
+shapes the tiling cannot cover (tiny or non-divisible sequence lengths).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+_LANES = 128  # TPU lane width; scratch vectors are padded to this
+
+
+def _on_tpu() -> bool:
+    try:
+        d = jax.devices()[0]
+        return "tpu" in d.platform.lower() or "TPU" in getattr(d, "device_kind", "")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal: bool, sm_scale: float, block_q: int, block_k: int,
+                kv_steps: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0].astype(jnp.float32)           # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale                                # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+
+        m_prev = m_ref[:, 0:1]                      # [bq, 1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                      # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0:1] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    grid = (bh, t // block_q, t // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, kv_steps=t // block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (recompute from saved logsumexp)
+# ---------------------------------------------------------------------------
+
+def _recompute_p_ds(q, k, v, do, lse, delta, qi, ki, causal, sm_scale,
+                    block_q, block_k):
+    """Shared bwd block math: p [bq,bk] and ds [bq,bk] (pre-scaled)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos > q_pos, NEG_INF, s)
+    p = jnp.exp(s - lse)                            # lse [bq, 1] broadcasts
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                               # [bq, bk]
+    ds = p * (dp - delta) * sm_scale                # delta [bq, 1]
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, causal, sm_scale, block_q, block_k, kv_steps):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0], delta_ref[0], qi, ki, causal, sm_scale,
+            block_q, block_k,
+        )
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, causal, sm_scale, block_q, block_k, q_steps):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _recompute_p_ds(
+            q, k, v, do, lse_ref[0], delta_ref[0], qi, ki, causal, sm_scale,
+            block_q, block_k,
+        )
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == q_steps - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, T, 1]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_dq = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, kv_steps=t // block_k,
+        ),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid iterates q blocks innermost for a fixed kv block.
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, q_steps=t // block_q,
+        ),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper on [BH, T, D]
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_bhtd_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhtd_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                      interpret)
+    return dq, dk, dv
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention on [B, T, H, D] (same layout as ring/dense attention).
+
+    Differentiable (custom VJP, recompute-based backward). Sequences that the
+    tiling cannot cover (T < 2 MXU rows or not divisible by the block size)
+    fall back to dense attention — semantics are identical.
+    """
+    from .ring_attention import dense_attention
+
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k or t < 16:
+        return dense_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = not _on_tpu()
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    o = _flash_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v),
+        causal, float(sm_scale), block_q, block_k, bool(interpret),
+    )
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh,
+    causal: bool = False,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str = "model",
+    **kw,
+) -> jnp.ndarray:
+    """shard_map wrapper for the seq-unsharded case: batch over data/fsdp,
+    heads over model — each device runs the flash kernel on its local heads
+    with no collectives (heads are independent)."""
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = jax.shard_map
+
+    from ..parallel.mesh import mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
+    b, _, h, _ = q.shape
+    # Shard only over axes the actual shape divides; anything else computes
+    # replicated on those devices (correct, just redundant).
+    batch_list, prod = [], 1
+    for a in batch_axes:
+        s = sizes.get(a, 1)
+        if s > 1 and b % (prod * s) == 0:
+            batch_list.append(a)
+            prod *= s
+    batch = tuple(batch_list) or None
+    head_size = sizes.get(head_axis, 1)
+    head = head_axis if head_size > 1 and h % head_size == 0 else None
+    spec = P(batch, None, head, None)
+    fn = shard_map(
+        functools.partial(flash_attention, causal=causal, **kw),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,  # pallas_call outputs carry no vma annotation
+    )
+    return fn(q, k, v)

@@ -113,7 +113,9 @@ def ring_attention(
     when the mesh has no sequence sharding."""
     from jax.sharding import PartitionSpec as P
 
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    from ..parallel.mesh import mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
     if sizes.get(seq_axis, 1) == 1:
         return dense_attention(q, k, v, causal=causal)
 

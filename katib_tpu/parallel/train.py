@@ -132,6 +132,7 @@ def run_lm_trial(assignments: Dict[str, str], ctx=None) -> None:
         if ctx is not None and (i + 1) % 5 == 0:
             ctx.report(loss=float(loss))
     if ctx is not None:
-        ctx.report(loss=float(loss))
+        if steps % 5 != 0:  # final value not yet reported by the loop
+            ctx.report(loss=float(loss))
     else:
         print(f"loss={float(loss)}")

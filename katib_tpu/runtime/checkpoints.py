@@ -60,6 +60,17 @@ class CheckpointStore:
             with open(tmp, "wb") as f:
                 pickle.dump({"step": step, "state": host_state}, f)
             os.replace(tmp, path)
+            # same retention as the orbax path (max_to_keep=3)
+            steps = sorted(
+                int(f[len("ckpt_") : -len(".pkl")])
+                for f in os.listdir(self.directory)
+                if f.startswith("ckpt_") and f.endswith(".pkl")
+            )
+            for old in steps[:-3]:
+                try:
+                    os.remove(os.path.join(self.directory, f"ckpt_{old}.pkl"))
+                except OSError:
+                    pass
 
     def latest_step(self) -> Optional[int]:
         if self.use_orbax:

@@ -215,6 +215,8 @@ def serve(
     except ImportError:
         pass
     bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise RuntimeError(f"failed to bind gRPC server to port {port}")
     server.bound_port = bound  # actual port (when port=0 the OS picks one)
     server.start()
     if block:
