@@ -41,6 +41,7 @@ from ..runtime.metrics import (
     EarlyStopped,
     EarlyStoppingMonitor,
     MetricsReporter,
+    TrialKilled,
     parse_json_lines,
     parse_text_lines,
     set_current_reporter,
@@ -124,6 +125,10 @@ class TrialExecution:
     def kill_requested(self) -> bool:
         return self._kill_requested.is_set()
 
+    @property
+    def kill_event(self) -> threading.Event:
+        return self._kill_requested
+
 
 class InProcessExecutor:
     def __init__(self, obs_store: ObservationStore):
@@ -161,6 +166,8 @@ class InProcessExecutor:
             return ExecutionResult(TrialOutcome.COMPLETED)
         except EarlyStopped:
             return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+        except TrialKilled:
+            return ExecutionResult(TrialOutcome.KILLED, "kill requested")
         except Exception:
             return ExecutionResult(TrialOutcome.FAILED, traceback.format_exc(limit=10))
         finally:

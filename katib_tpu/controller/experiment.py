@@ -78,8 +78,6 @@ class ExperimentController:
         self.metrics = MetricsRegistry()
         self._completed_seen: set = set()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
-        if devices is not None and rt.devices_per_host:
-            devices = list(devices)[: rt.devices_per_host]
         self.scheduler = TrialScheduler(
             self.state,
             self.obs_store,
@@ -91,6 +89,7 @@ class ExperimentController:
             trial_timeout=rt.trial_timeout_seconds,
             max_trial_restarts=rt.max_trial_restarts,
             poll_interval=rt.metrics_poll_interval,
+            devices_per_host=rt.devices_per_host,
         )
 
     # -- lifecycle -----------------------------------------------------------

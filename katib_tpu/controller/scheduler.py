@@ -89,11 +89,14 @@ class TrialScheduler:
         trial_timeout: Optional[float] = None,
         max_trial_restarts: int = 0,
         poll_interval: Optional[float] = None,
+        devices_per_host: Optional[int] = None,
     ):
         self.recorder = events
         self.metrics_registry = metrics
         if devices is None:
             devices = list(range(8))  # abstract slots when JAX not involved
+        if devices_per_host:
+            devices = list(devices)[:devices_per_host]
         self.allocator = DeviceAllocator(devices)
         self.state = state
         self.obs_store = obs_store
@@ -207,12 +210,13 @@ class TrialScheduler:
                 timer.daemon = True
                 timer.start()
 
-            ctx = self._build_context(exp, trial, devices)
+            ctx = self._build_context(exp, trial, devices, handle)
             spec = exp.spec
             if spec.trial_template.command is not None:
-                result = self._subprocess.execute(exp, trial, ctx, handle)
+                executor = self._subprocess
             else:
-                result = self._in_process.execute(exp, trial, ctx, handle)
+                executor = self._in_process
+            result = self._execute_bounded(executor, exp, trial, ctx, handle, timed_out)
 
             if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
                 # deadline exceeded counts against maxFailedTrialCount
@@ -233,8 +237,54 @@ class TrialScheduler:
             self._handles.pop(trial.name, None)
             if not restarted:
                 self._checkpoint_dirs.pop(trial.name, None)
+                self._restarts.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
+
+    KILL_GRACE_SECONDS = 30.0
+
+    def _execute_bounded(
+        self, executor, exp: Experiment, trial: Trial, ctx, handle: TrialExecution,
+        timed_out: threading.Event,
+    ) -> ExecutionResult:
+        """Run the executor on a worker thread so a kill/timeout cannot leak
+        the gang allocation. Subprocess trials die on SIGTERM; in-process
+        trials unwind cooperatively (TrialKilled raised at their next
+        ctx.report()). A function that never reports and never returns is
+        abandoned after a grace period — its daemon thread keeps running (a
+        Python thread can't be force-killed), but the devices and the
+        scheduler slot are reclaimed, mirroring the reference's pod kill."""
+        box: Dict[str, Any] = {}
+
+        def _exec():
+            try:
+                box["result"] = executor.execute(exp, trial, ctx, handle)
+            except BaseException:
+                box["error"] = traceback.format_exc(limit=5)
+
+        worker = threading.Thread(
+            target=_exec, name=f"trial-exec-{trial.name}", daemon=True
+        )
+        worker.start()
+        abandon_at = None
+        while worker.is_alive():
+            worker.join(timeout=0.2)
+            if handle.kill_requested and abandon_at is None:
+                abandon_at = time.time() + self.KILL_GRACE_SECONDS
+            if abandon_at is not None and time.time() > abandon_at and worker.is_alive():
+                reason = (
+                    f"trial exceeded timeout of {self.trial_timeout}s"
+                    if timed_out.is_set()
+                    else "kill requested"
+                )
+                return ExecutionResult(
+                    TrialOutcome.FAILED if timed_out.is_set() else TrialOutcome.KILLED,
+                    f"{reason}; trial did not stop within "
+                    f"{self.KILL_GRACE_SECONDS}s grace, abandoned",
+                )
+        if "error" in box:
+            return ExecutionResult(TrialOutcome.FAILED, box["error"])
+        return box["result"]
 
     def _maybe_restart(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> bool:
         """Retry failed trials up to KatibConfig max_trial_restarts times
@@ -255,7 +305,9 @@ class TrialScheduler:
             self._waiting.append((exp, trial))
         return True
 
-    def _build_context(self, exp: Experiment, trial: Trial, devices) -> TrialContext:
+    def _build_context(
+        self, exp: Experiment, trial: Trial, devices, handle: Optional[TrialExecution] = None
+    ) -> TrialContext:
         spec = exp.spec
         monitor = None
         if trial.early_stopping_rules:
@@ -265,7 +317,10 @@ class TrialScheduler:
                 spec.objective.type,
             )
         reporter = MetricsReporter(
-            store=self.obs_store, trial_name=trial.name, monitor=monitor
+            store=self.obs_store,
+            trial_name=trial.name,
+            monitor=monitor,
+            kill_event=handle.kill_event if handle is not None else None,
         )
         workdir = None
         if self.workdir_root:

@@ -44,6 +44,11 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     causal: bool = True
+    # Mixture-of-experts: 0 = dense MLP in every block; otherwise every block
+    # uses a top-1 routed MoE with experts sharded over the mesh 'expert' axis.
+    num_experts: int = 0
+    expert_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
 
     @property
     def head_dim(self) -> int:
@@ -115,6 +120,88 @@ class MLP(nn.Module):
         )
 
 
+class MoE(nn.Module):
+    """Top-1 routed mixture-of-experts FFN (Switch style) with experts laid
+    out over the mesh 'expert' axis.
+
+    Expert parallelism, TPU-native: per-expert FFN weights are [X, E, F]
+    sharded P('expert', 'fsdp', 'model'); the dispatched token buffer
+    [B, X, C, E] carries a sharding constraint that puts X on 'expert', so XLA
+    inserts the token all-to-all over ICI (the reference delegates any such
+    layout to trial-image NCCL — SURVEY.md §2.9). A load-balance aux loss is
+    sown under 'intermediates'/'moe_aux_loss' for the train step to collect.
+    """
+
+    config: TransformerConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, t, e = x.shape
+        nx = cfg.num_experts
+        hidden = cfg.embed_dim * cfg.mlp_ratio
+        capacity = max(1, int(cfg.expert_capacity_factor * t / nx))
+
+        router_logits = nn.Dense(nx, use_bias=False, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # [B, T, X]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)          # [B, T]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B, T]
+
+        onehot = jax.nn.one_hot(expert_idx, nx, dtype=jnp.float32)  # [B, T, X]
+        # position of each token within its expert's buffer, per batch row
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0             # [B, T, X]
+        keep = (pos >= 0) & (pos < capacity)
+        dispatch = onehot[..., None] * jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [B, T, X, C]
+        dispatch = jnp.where(keep[..., None], dispatch, 0.0)
+        combine = dispatch * gate[:, :, None, None]
+
+        # load balance: fraction of tokens per expert vs mean router prob
+        frac_tokens = jnp.mean(onehot, axis=(0, 1))
+        frac_probs = jnp.mean(probs, axis=(0, 1))
+        aux = cfg.moe_aux_weight * nx * jnp.sum(frac_tokens * frac_probs)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (nx, e, hidden), jnp.float32
+        )
+        w_gate = self.param(
+            "w_gate", nn.initializers.lecun_normal(), (nx, e, hidden), jnp.float32
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (nx, hidden, e), jnp.float32
+        )
+
+        expert_in = jnp.einsum(
+            "btxc,bte->bxce", dispatch.astype(cfg.dtype), x
+        )  # [B, X, C, E]
+        constraint = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import mesh_axis_sizes
+
+            sizes = mesh_axis_sizes(self.mesh)
+            batch_par = sizes.get("data", 1) * sizes.get("fsdp", 1)
+            batch_axes = ("data", "fsdp") if b % batch_par == 0 else None
+            x_axis = "expert" if nx % sizes.get("expert", 1) == 0 else None
+            if batch_axes or x_axis:
+                constraint = NamedSharding(self.mesh, P(batch_axes, x_axis, None, None))
+        if constraint is not None:
+            # routes the token all-to-all over the 'expert' ICI axis
+            expert_in = jax.lax.with_sharding_constraint(expert_in, constraint)
+        h = jnp.einsum("bxce,xef->bxcf", expert_in, w_in.astype(cfg.dtype))
+        g = jnp.einsum("bxce,xef->bxcf", expert_in, w_gate.astype(cfg.dtype))
+        out = jnp.einsum("bxcf,xfe->bxce", nn.silu(g) * h, w_out.astype(cfg.dtype))
+        if constraint is not None:
+            out = jax.lax.with_sharding_constraint(out, constraint)
+        return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+
+
 class Block(nn.Module):
     config: TransformerConfig
     mesh: Optional[Any] = None
@@ -124,7 +211,10 @@ class Block(nn.Module):
         x = x + Attention(self.config, self.mesh, name="attn")(
             RMSNorm(name="ln1")(x), positions
         )
-        x = x + MLP(self.config, name="mlp")(RMSNorm(name="ln2")(x))
+        if self.config.num_experts > 0:
+            x = x + MoE(self.config, self.mesh, name="moe")(RMSNorm(name="ln2")(x))
+        else:
+            x = x + MLP(self.config, name="mlp")(RMSNorm(name="ln2")(x))
         return x
 
 
@@ -167,9 +257,13 @@ def param_sharding_rules(path: Tuple[str, ...]):
         return P("fsdp", "model")                 # [E, F]
     if "down/kernel" in name:
         return P("model", "fsdp")                 # [F, E]
+    if "moe/w_in" in name or "moe/w_gate" in name:
+        return P("expert", "fsdp", "model")       # [X, E, F]
+    if "moe/w_out" in name:
+        return P("expert", "model", "fsdp")       # [X, F, E]
     if name == "embed":
         return P(None, "fsdp")                    # [V, E]
-    return P()  # replicated (norms, biases)
+    return P()  # replicated (norms, biases, router)
 
 
 def shard_params(params: Dict[str, Any], mesh) -> Dict[str, Any]:

@@ -334,10 +334,21 @@ def flash_attention(
     b, t, h, d = q.shape
     block_q = min(block_q, t)
     block_k = min(block_k, t)
+
+    def dense_fallback():
+        # dense_attention hard-codes 1/sqrt(d); fold a custom sm_scale into q
+        # so fallback results match the kernel on every platform
+        qs = q if sm_scale is None else q * (sm_scale * math.sqrt(d))
+        return dense_attention(qs, k, v, causal=causal)
+
     if t % block_q or t % block_k or t < 16:
-        return dense_attention(q, k, v, causal=causal)
+        return dense_fallback()
     if interpret is None:
-        interpret = not _on_tpu()
+        # off-TPU, interpret-mode Pallas is orders of magnitude slower than
+        # one fused XLA attention; reserve it for explicit kernel tests
+        if not _on_tpu():
+            return dense_fallback()
+        interpret = False
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
 

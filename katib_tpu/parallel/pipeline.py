@@ -1,0 +1,204 @@
+"""Pipeline-parallel LM training: GPipe microbatch schedule over the mesh
+'pipe' axis, built from XLA collectives inside one jitted step.
+
+The reference has no pipeline engine of its own — distributed trials delegate
+to PyTorchJob/MPIJob images (SURVEY.md §2.9). The TPU-native equivalent is an
+SPMD rotational pipeline (the scaling-book construction): transformer blocks
+are stacked [n_stages, layers_per_stage, ...] and sharded over 'pipe'; inside
+``jax.shard_map`` each device applies its stage and hands activations to the
+next stage with ``lax.ppermute`` while stage 0 feeds in a fresh microbatch —
+so after the (n_stages−1)-step bubble every stage computes concurrently.
+The backward pipeline falls out of autodiff: ppermute's transpose is the
+reverse rotation, so jax.grad of the scanned forward IS the reverse schedule.
+
+Gradient reductions are explicit (pmap-style manual collectives): stage
+params take no cross-'pipe' reduction (each device owns its stage), shared
+params (embedding, final norm) psum over 'pipe', and everything pmeans over
+'data'.
+
+Embedding and the tied LM head live outside the rotation (computed on every
+pipe device; only stage 0's embedding and the last stage's head carry
+gradients — masking in the schedule routes cotangents correctly).
+
+Constraints: batch divisible by n_microbatches × data-axis size; positions
+are the standard arange(T) (identical across microbatches, so RoPE state
+doesn't need to travel with activations); mesh axes fsdp/seq/model/expert
+must be 1 on this path (compose TP/SP within a stage is future work —
+pipeline composes with pure DP here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..models.transformer import Block, RMSNorm, TransformerConfig
+from .mesh import mesh_axis_sizes
+
+
+def _stack_block_init(config: TransformerConfig, n_stages: int, layers_per_stage: int, seed: int):
+    """Init num_layers independent blocks, stacked to [n_stages, lps, ...]."""
+    block = Block(config, mesh=None)
+    sample_x = jnp.zeros((1, 8, config.embed_dim), config.dtype)
+    sample_pos = jnp.zeros((1, 8), jnp.int32)
+    n = n_stages * layers_per_stage
+    rngs = jax.random.split(jax.random.PRNGKey(seed), n)
+
+    def init_one(rng):
+        return block.init(rng, sample_x, sample_pos)["params"]
+
+    stacked = jax.vmap(init_one)(rngs)
+    return jax.tree.map(
+        lambda a: a.reshape((n_stages, layers_per_stage) + a.shape[1:]), stacked
+    )
+
+
+def make_pipeline_lm_train_step(
+    config: TransformerConfig,
+    mesh,
+    learning_rate: float = 1e-3,
+    num_microbatches: Optional[int] = None,
+    seed: int = 0,
+):
+    """Returns (params, opt_state, step_fn) with
+    step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss).
+
+    tokens/targets: [B, T] int32, B sharded over 'data'. params is
+    {'embed': [V, E], 'blocks': pytree with leading [n_stages, lps],
+    'ln_f': [E]} with blocks sharded over 'pipe'.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sizes = mesh_axis_sizes(mesh)
+    n_stages = sizes.get("pipe", 1)
+    if n_stages < 2:
+        raise ValueError("pipeline path needs mesh axis 'pipe' >= 2")
+    for axis in ("fsdp", "seq", "model", "expert"):
+        if sizes.get(axis, 1) != 1:
+            raise ValueError(f"pipeline path requires mesh axis '{axis}' == 1")
+    if config.num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers {config.num_layers} not divisible by pipe={n_stages}"
+        )
+    lps = config.num_layers // n_stages
+    n_micro = num_microbatches or 2 * n_stages
+
+    block = Block(config, mesh=None)
+
+    embed = jax.random.normal(
+        jax.random.PRNGKey(seed + 1), (config.vocab_size, config.embed_dim), jnp.float32
+    ) * 0.02
+    blocks = _stack_block_init(config, n_stages, lps, seed)
+    params = {
+        "embed": jax.device_put(embed, NamedSharding(mesh, P(None, None))),
+        "blocks": jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(*(("pipe",) + (None,) * (a.ndim - 1))))),
+            blocks,
+        ),
+        "ln_f": jax.device_put(jnp.ones((config.embed_dim,)), NamedSharding(mesh, P(None))),
+    }
+
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    def stage_apply(blocks_local, x, positions):
+        # blocks_local leaves [1, lps, ...]; scan over the stage's layers
+        layer_params = jax.tree.map(lambda a: a[0], blocks_local)
+
+        def one(carry, p):
+            return block.apply({"params": p}, carry, positions), None
+
+        x, _ = jax.lax.scan(one, x, layer_params)
+        return x
+
+    def device_loss(embed_p, blocks_local, lnf, tokens, targets):
+        # tokens/targets: [B_local, T]
+        b, t = tokens.shape
+        mb = b // n_micro
+        stage = jax.lax.axis_index("pipe")
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+
+        x = embed_p[tokens].astype(config.dtype).reshape(n_micro, mb, t, -1)
+        tgt = targets.reshape(n_micro, mb, t)
+
+        def body(carry, step_i):
+            state, out_buf = carry
+            shifted = jax.lax.ppermute(
+                state, "pipe", [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            inp = jnp.where(
+                step_i < n_micro,
+                jax.lax.dynamic_index_in_dim(
+                    x, jnp.minimum(step_i, n_micro - 1), 0, keepdims=False
+                ),
+                jnp.zeros_like(x[0]),
+            )
+            x_in = jnp.where(stage == 0, inp, shifted)
+            y = stage_apply(blocks_local, x_in, positions)
+            widx = jnp.clip(step_i - (n_stages - 1), 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, widx, 0, keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(step_i >= n_stages - 1, y, cur), widx, 0
+            )
+            return (y, out_buf), None
+
+        state0 = jnp.zeros_like(x[0])
+        out_buf0 = jnp.zeros_like(x)
+        (_, out_buf), _ = jax.lax.scan(
+            body, (state0, out_buf0), jnp.arange(n_micro + n_stages - 1)
+        )
+
+        # head on the last stage only; psum makes the scalar global
+        h = RMSNorm().apply({"params": {"scale": lnf}}, out_buf)
+        logits = jnp.einsum("mbte,ve->mbtv", h.astype(jnp.float32), embed_p)
+        local = optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+        masked = jnp.where(stage == n_stages - 1, local, 0.0)
+        return jax.lax.psum(masked, "pipe")
+
+    def spmd_step(embed_p, blocks_local, lnf, tokens, targets):
+        loss, grads = jax.value_and_grad(device_loss, argnums=(0, 1, 2))(
+            embed_p, blocks_local, lnf, tokens, targets
+        )
+        g_embed, g_blocks, g_lnf = grads
+        g_embed = jax.lax.pmean(jax.lax.psum(g_embed, "pipe"), "data")
+        g_lnf = jax.lax.pmean(jax.lax.psum(g_lnf, "pipe"), "data")
+        g_blocks = jax.tree.map(lambda g: jax.lax.pmean(g, "data"), g_blocks)
+        loss = jax.lax.pmean(loss, "data")
+        return loss, g_embed, g_blocks, g_lnf
+
+    blocks_spec = jax.tree.map(
+        lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), params["blocks"]
+    )
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(None, None), blocks_spec, P(None), P("data", None), P("data", None)),
+        out_specs=(P(), P(None, None), blocks_spec, P(None)),
+        check_vma=False,
+    )
+
+    def step(params, opt_state, tokens, targets):
+        loss, g_embed, g_blocks, g_lnf = sharded(
+            params["embed"], params["blocks"], params["ln_f"], tokens, targets
+        )
+        grads = {"embed": g_embed, "blocks": g_blocks, "ln_f": g_lnf}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    batch_sharding = NamedSharding(mesh, P("data", None))
+
+    def put_batch(tokens, targets):
+        return (
+            jax.device_put(tokens, batch_sharding),
+            jax.device_put(targets, batch_sharding),
+        )
+
+    return params, opt_state, step_fn, put_batch

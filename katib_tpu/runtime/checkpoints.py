@@ -16,10 +16,22 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
+
+MAX_TO_KEEP = 3
+
+
+def _pickle_steps(directory: str) -> List[int]:
+    steps = []
+    for f in os.listdir(directory):
+        if f.startswith("ckpt_") and f.endswith(".pkl"):
+            stem = f[len("ckpt_"):-len(".pkl")]
+            if stem.isdigit():  # ignore foreign files like ckpt_best.pkl
+                steps.append(int(stem))
+    return sorted(steps)
 
 
 class CheckpointStore:
@@ -43,7 +55,7 @@ class CheckpointStore:
         import orbax.checkpoint as ocp
 
         return ocp.CheckpointManager(
-            self.directory, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+            self.directory, options=ocp.CheckpointManagerOptions(max_to_keep=MAX_TO_KEEP)
         )
 
     def save(self, step: int, state: Dict[str, Any]) -> None:
@@ -60,13 +72,9 @@ class CheckpointStore:
             with open(tmp, "wb") as f:
                 pickle.dump({"step": step, "state": host_state}, f)
             os.replace(tmp, path)
-            # same retention as the orbax path (max_to_keep=3)
-            steps = sorted(
-                int(f[len("ckpt_") : -len(".pkl")])
-                for f in os.listdir(self.directory)
-                if f.startswith("ckpt_") and f.endswith(".pkl")
-            )
-            for old in steps[:-3]:
+            # same retention as the orbax path
+            steps = _pickle_steps(self.directory)
+            for old in steps[:-MAX_TO_KEEP]:
                 try:
                     os.remove(os.path.join(self.directory, f"ckpt_{old}.pkl"))
                 except OSError:
@@ -76,11 +84,7 @@ class CheckpointStore:
         if self.use_orbax:
             with self._manager() as mngr:
                 return mngr.latest_step()
-        steps = [
-            int(f[len("ckpt_") : -len(".pkl")])
-            for f in os.listdir(self.directory)
-            if f.startswith("ckpt_") and f.endswith(".pkl")
-        ]
+        steps = _pickle_steps(self.directory)
         return max(steps) if steps else None
 
     def restore(self, step: Optional[int] = None, template: Optional[Any] = None) -> Optional[Dict[str, Any]]:

@@ -29,7 +29,7 @@ import os
 import re
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from ..api.spec import ComparisonType, EarlyStoppingRule, ObjectiveType
 from ..db.store import MetricLog, ObservationStore, open_store
@@ -46,6 +46,12 @@ ENV_METRICS_FILE = "KATIB_TPU_METRICS_FILE"
 
 class EarlyStopped(Exception):
     """Raised inside trial code when all early-stopping rules tripped."""
+
+
+class TrialKilled(Exception):
+    """Raised inside in-process trial code when the scheduler requested a
+    kill (timeout or deleteTrials-style shrink) — the cooperative equivalent
+    of the reference sidecar killing the training process."""
 
 
 class EarlyStoppingMonitor:
@@ -116,6 +122,7 @@ class MetricsReporter:
     trial_name: str
     monitor: Optional[EarlyStoppingMonitor] = None
     raise_on_stop: bool = True
+    kill_event: Optional[Any] = None  # threading.Event from the scheduler
     _stopped: bool = False
 
     def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
@@ -124,6 +131,9 @@ class MetricsReporter:
             MetricLog(timestamp=ts, metric_name=k, value=str(v)) for k, v in metrics.items()
         ]
         self.store.report_observation_log(self.trial_name, logs)
+        # after the write, so a killed trial's final metrics are not lost
+        if self.kill_event is not None and self.kill_event.is_set():
+            raise TrialKilled(f"trial {self.trial_name} killed")
         if self.monitor is not None:
             for k, v in metrics.items():
                 try:

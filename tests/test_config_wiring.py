@@ -147,3 +147,60 @@ def test_trial_timeout_fails_trial(tmp_path):
         assert "timeout" in trials[0].message
     finally:
         c.close()
+
+
+def _report_forever(assignments, ctx):
+    while True:
+        ctx.report(objective=0.5)
+        time.sleep(0.05)
+
+
+def test_trial_timeout_kills_in_process_trial(tmp_path):
+    """In-process trials unwind cooperatively: TrialKilled raised at the
+    next ctx.report() after the deadline."""
+    cfg = KatibConfig(runtime=RuntimeConfig(trial_timeout_seconds=0.5))
+    c = ExperimentController(root_dir=str(tmp_path), config=cfg)
+    try:
+        spec = _spec("cfg-timeout-inproc", max_trials=1, parallel=1)
+        spec.trial_template = TrialTemplate(function=_report_forever)
+        c.create_experiment(spec)
+        exp = c.run("cfg-timeout-inproc", timeout=60)
+        trials = c.state.list_trials("cfg-timeout-inproc")
+        assert trials and trials[0].condition == TrialCondition.FAILED
+        assert "timeout" in trials[0].message
+    finally:
+        c.close()
+
+
+def _hang_without_reporting(assignments, ctx):
+    time.sleep(60)
+
+
+def test_trial_timeout_abandons_hung_in_process_trial(tmp_path):
+    """A function that never reports is abandoned after the grace period and
+    its slot/devices reclaimed."""
+    from katib_tpu.controller.scheduler import TrialScheduler
+
+    cfg = KatibConfig(runtime=RuntimeConfig(trial_timeout_seconds=0.3))
+    c = ExperimentController(root_dir=str(tmp_path), config=cfg)
+    c.scheduler.KILL_GRACE_SECONDS = 0.5
+    try:
+        spec = _spec("cfg-timeout-hang", max_trials=1, parallel=1)
+        spec.trial_template = TrialTemplate(function=_hang_without_reporting)
+        c.create_experiment(spec)
+        exp = c.run("cfg-timeout-hang", timeout=30)
+        trials = c.state.list_trials("cfg-timeout-hang")
+        assert trials and trials[0].condition == TrialCondition.FAILED
+        assert "abandoned" in trials[0].message
+        assert c.scheduler.allocator.free_count == c.scheduler.allocator.total
+    finally:
+        c.close()
+
+
+def test_devices_per_host_caps_default_allocator(tmp_path):
+    cfg = KatibConfig(runtime=RuntimeConfig(devices_per_host=2))
+    c = ExperimentController(root_dir=str(tmp_path), config=cfg)
+    try:
+        assert c.scheduler.allocator.total == 2
+    finally:
+        c.close()
