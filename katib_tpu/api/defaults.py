@@ -68,6 +68,9 @@ def set_defaults(spec: ExperimentSpec, default_parallel: int = None) -> Experime
     if spec.metrics_collector_spec is None:
         spec.metrics_collector_spec = MetricsCollectorSpec()
     mc = spec.metrics_collector_spec
+    if mc.collector_kind == CollectorKind.PROMETHEUS and mc.source is None:
+        # reference experiment_defaults.go: scrape defaults path=/metrics port=8080
+        mc.source = SourceSpec()
     if mc.collector_kind in (CollectorKind.FILE, CollectorKind.TF_EVENT) and mc.source is None:
         mc.source = SourceSpec(file_path=DEFAULT_METRICS_FILE)
     if spec.trial_template.command is not None and mc.collector_kind == CollectorKind.PUSH:

@@ -365,15 +365,16 @@ class FilterSpec:
 
 @dataclass
 class SourceSpec:
-    """Subset of reference common_types.go:154-203 relevant without K8s:
-
-    file_system_path + filter. (Prometheus http source is represented but the
-    TPU-native path is PUSH.)
-    """
+    """reference common_types.go:154-203: file_system_path + filter, plus the
+    PrometheusMetric httpGet source (host/port/path) scraped by the
+    subprocess executor while the trial runs."""
 
     file_path: Optional[str] = None
     file_format: str = "TEXT"  # TEXT | JSON, reference common_types.go FileSystemKind
     filter: Optional[FilterSpec] = None
+    http_host: str = "127.0.0.1"
+    http_port: int = 8080   # reference experiment_defaults.go Prometheus case
+    http_path: str = "/metrics"
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"fileFormat": self.file_format}
@@ -381,15 +382,21 @@ class SourceSpec:
             d["filePath"] = self.file_path
         if self.filter:
             d["filter"] = self.filter.to_dict()
+        if (self.http_host, self.http_port, self.http_path) != ("127.0.0.1", 8080, "/metrics"):
+            d["httpGet"] = {"host": self.http_host, "port": self.http_port, "path": self.http_path}
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SourceSpec":
         filt = d.get("filter")
+        http = d.get("httpGet") or {}
         return cls(
             file_path=d.get("filePath"),
             file_format=d.get("fileFormat", "TEXT"),
             filter=FilterSpec(metrics_format=list(filt.get("metricsFormat", []))) if filt else None,
+            http_host=http.get("host", "127.0.0.1"),
+            http_port=int(http.get("port", 8080)),
+            http_path=http.get("path", "/metrics"),
         )
 
 

@@ -213,6 +213,7 @@ class SubprocessExecutor:
                 spec.objective.type,
             )
 
+        prom_logs: List[MetricLog] = []
         with open(stdout_path, "wb") as out:
             proc = subprocess.Popen(
                 cmd,
@@ -222,7 +223,11 @@ class SubprocessExecutor:
                 cwd=spec.trial_template.working_dir or workdir,
                 start_new_session=True,
             )
-            outcome = self._wait(proc, stdout_path, metrics_file, monitor, spec, handle)
+            outcome = self._wait(
+                proc, stdout_path, metrics_file, monitor, spec, handle, prom_logs
+            )
+        if prom_logs:
+            self.obs_store.report_observation_log(trial.name, prom_logs)
 
         # Collect metrics from the produced output (sidecar CollectObservationLog).
         self._collect(trial, stdout_path, metrics_file, spec)
@@ -239,6 +244,47 @@ class SubprocessExecutor:
             TrialOutcome.FAILED, f"process exited with code {proc.returncode}"
         )
 
+    SCRAPE_INTERVAL = 1.0  # seconds between Prometheus scrapes
+
+    def _scrape_prometheus(
+        self, spec: ExperimentSpec, prom_logs: List[MetricLog],
+        monitor: Optional[EarlyStoppingMonitor], last_scraped: Dict[str, str],
+    ) -> Optional[ExecutionResult]:
+        from urllib.request import urlopen
+
+        from ..runtime.metrics import parse_prometheus_text
+
+        src = spec.metrics_collector_spec.source
+        url = f"http://{src.http_host}:{src.http_port}{src.http_path}"
+        try:
+            with urlopen(url, timeout=2) as resp:
+                text = resp.read().decode(errors="replace")
+        except Exception:
+            # endpoint not up (yet), mid-shutdown, or speaking non-HTTP —
+            # skip this scrape and keep polling (urllib raises OSError,
+            # http.client.* and ValueError variants here)
+            return None
+        logs = parse_prometheus_text(text, spec.objective.all_metric_names())
+        # scrapes sample state, they are not reports: only record changes so
+        # the log and the early-stopping step counter advance per new value,
+        # not per wall-clock second
+        fresh = [
+            log for log in logs
+            if last_scraped.get(log.metric_name) != log.value
+        ]
+        for log in fresh:
+            last_scraped[log.metric_name] = log.value
+        prom_logs.extend(fresh)
+        if monitor is not None:
+            for log in fresh:
+                try:
+                    value = float(log.value)
+                except ValueError:
+                    continue
+                if monitor.observe(log.metric_name, value):
+                    return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+        return None
+
     def _wait(
         self,
         proc: subprocess.Popen,
@@ -247,17 +293,32 @@ class SubprocessExecutor:
         monitor: Optional[EarlyStoppingMonitor],
         spec: ExperimentSpec,
         handle: TrialExecution,
+        prom_logs: Optional[List[MetricLog]] = None,
     ) -> Optional[ExecutionResult]:
         """Poll for exit; tail output applying stop rules (the reference
-        sidecar's watchMetricsFile loop)."""
+        sidecar's watchMetricsFile loop); scrape the trial's Prometheus
+        endpoint when the collector kind asks for it."""
         watch_path = metrics_file or stdout_path
         offset = 0
         buffered = ""
+        scrape = (
+            spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
+            and spec.metrics_collector_spec.source is not None
+            and prom_logs is not None
+        )
+        last_scrape = 0.0
+        last_scraped: Dict[str, str] = {}  # per-trial change detection
         while True:
             if handle.kill_requested:
                 self._terminate(proc)
                 return ExecutionResult(TrialOutcome.KILLED, "kill requested")
             rc = proc.poll()
+            if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
+                last_scrape = time.time()
+                stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                if stopped is not None:
+                    self._terminate(proc)
+                    return stopped
             if monitor is not None and os.path.exists(watch_path):
                 with open(watch_path, "r", errors="replace") as f:
                     f.seek(offset)
@@ -333,8 +394,8 @@ class SubprocessExecutor:
     ) -> None:
         mc = spec.metrics_collector_spec
         kind = mc.collector_kind
-        if kind in (CollectorKind.NONE, CollectorKind.PUSH):
-            return  # trial pushed directly (or reports nothing)
+        if kind in (CollectorKind.NONE, CollectorKind.PUSH, CollectorKind.PROMETHEUS):
+            return  # pushed directly, scraped during _wait, or reports nothing
         if kind == CollectorKind.TF_EVENT:
             from ..runtime.tfevent import collect_tfevent_metrics
 

@@ -27,7 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.darts_ops import FactorizedReduce, MixedOp, StdConv, batch_norm
+from ..ops.darts_ops import FactorizedReduce, MatmulConv, MixedOp, StdConv, batch_norm
 
 
 class Cell(nn.Module):
@@ -110,7 +110,7 @@ class DartsSupernet(nn.Module):
         w_reduce = [jax.nn.softmax(a, axis=-1) for a in alpha_reduce]
 
         c_cur = self.stem_multiplier * self.init_channels
-        s = nn.Conv(c_cur, (3, 3), padding="SAME", use_bias=False, name="stem")(x)
+        s = MatmulConv(c_cur, (3, 3), name="stem")(x)
         s = batch_norm(s)
         s0 = s1 = s
 

@@ -80,10 +80,10 @@ def architect_alpha_grad(
         momentum_buf,
     )
 
-    # validation grads at (w', alpha)
+    # validation grads at (w', alpha) — one joint backward pass for both
+    # cotangents (graph size == compile time on TPU; see bench.py)
     val_loss = lambda w, a: _loss_fn(model, w, a, valid_batch)
-    dalpha = jax.grad(val_loss, argnums=1)(v_weights, alphas)
-    dw = jax.grad(val_loss, argnums=0)(v_weights, alphas)
+    dw, dalpha = jax.grad(val_loss, argnums=(0, 1))(v_weights, alphas)
 
     # finite-difference Hessian (compute_hessian): eps = 0.01 / ||dw||
     eps = 0.01 / (_tree_norm(dw) + 1e-12)

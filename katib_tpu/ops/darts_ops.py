@@ -17,7 +17,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Sequence
+from typing import Callable, Dict, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -29,6 +29,45 @@ def batch_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     mean = x.mean(axis=(0, 1, 2), keepdims=True)
     var = x.var(axis=(0, 1, 2), keepdims=True)
     return (x - mean) * jax.lax.rsqrt(var + eps)
+
+
+class MatmulConv(nn.Module):
+    """Drop-in for nn.Conv (NHWC, SAME, no bias) lowered to an im2col matmul.
+
+    DARTS search cells have tiny channel counts, and XLA:TPU's backward pass
+    for direct low-channel convolutions compiles ~5x slower than the
+    equivalent [B*H*W, C*kh*kw] x [C*kh*kw, F] GEMM — which is also the shape
+    the MXU wants. 1x1 convs skip patch extraction entirely (stride by
+    slicing + one einsum). Param name/shape match nn.Conv ('kernel',
+    [kh, kw, C, F]) so genotypes/checkpoints are interchangeable."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    kernel_dilation: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        c = x.shape[-1]
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(), (kh, kw, c, self.features)
+        )
+        if (kh, kw) == (1, 1) and self.kernel_dilation == (1, 1):
+            sh, sw = self.strides
+            if (sh, sw) != (1, 1):
+                x = x[:, ::sh, ::sw, :]
+            return jnp.einsum("bhwc,cf->bhwf", x, w[0, 0])
+        patches = jax.lax.conv_general_dilated_patches(
+            x,
+            (kh, kw),
+            self.strides,
+            "SAME",
+            rhs_dilation=self.kernel_dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # [..., C*kh*kw] with feature order C x kh x kw
+        wmat = w.transpose(2, 0, 1, 3).reshape(c * kh * kw, self.features)
+        return patches @ wmat
 
 
 class Zero(nn.Module):
@@ -74,8 +113,8 @@ class FactorizedReduce(nn.Module):
     def __call__(self, x):
         x = nn.relu(x)
         h = self.channels // 2
-        a = nn.Conv(h, (1, 1), strides=(2, 2), use_bias=False, name="conv1")(x)
-        b = nn.Conv(self.channels - h, (1, 1), strides=(2, 2), use_bias=False, name="conv2")(
+        a = MatmulConv(h, (1, 1), strides=(2, 2), name="conv1")(x)
+        b = MatmulConv(self.channels - h, (1, 1), strides=(2, 2), name="conv2")(
             x[:, 1:, 1:, :]
         )
         return batch_norm(jnp.concatenate([a, b], axis=-1))
@@ -91,12 +130,10 @@ class StdConv(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
-        x = nn.Conv(
+        x = MatmulConv(
             self.channels,
             (self.kernel_size, self.kernel_size),
             strides=(self.stride, self.stride),
-            padding="SAME",
-            use_bias=False,
         )(x)
         return batch_norm(x)
 
@@ -122,7 +159,7 @@ class SepConv(nn.Module):
                 use_bias=False,
                 name=f"dw{i}",
             )(x)
-            x = nn.Conv(self.channels, (1, 1), use_bias=False, name=f"pw{i}")(x)
+            x = MatmulConv(self.channels, (1, 1), name=f"pw{i}")(x)
             x = batch_norm(x)
         return x
 
@@ -148,7 +185,7 @@ class DilConv(nn.Module):
             use_bias=False,
             name="dw",
         )(x)
-        x = nn.Conv(self.channels, (1, 1), use_bias=False, name="pw")(x)
+        x = MatmulConv(self.channels, (1, 1), name="pw")(x)
         return batch_norm(x)
 
 

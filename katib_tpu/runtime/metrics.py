@@ -217,6 +217,33 @@ def parse_text_lines(
     return out
 
 
+_PROM_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+([-+0-9.eEnaifNI]+)(?:\s+\d+)?$"
+)
+
+
+def parse_prometheus_text(
+    text: str,
+    metric_names: Sequence[str],
+    base_time: Optional[float] = None,
+) -> List[MetricLog]:
+    """Prometheus text exposition -> MetricLogs for the wanted names
+    (reference CollectorKind PrometheusMetric, common_types.go:205-227;
+    scraped by the subprocess executor instead of a sidecar)."""
+    wanted = set(metric_names)
+    t0 = base_time if base_time is not None else time.time()
+    out: List[MetricLog] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE_RE.match(line)
+        if m is None or m.group(1) not in wanted:
+            continue
+        out.append(MetricLog(timestamp=t0, metric_name=m.group(1), value=m.group(2)))
+    return out
+
+
 def parse_json_lines(
     lines: Sequence[str],
     metric_names: Sequence[str],

@@ -12,11 +12,16 @@ a zero-dependency threaded http.server with the same information surface:
   GET /api/experiments/<name>/suggestion    suggestion state
   GET /api/trials/<name>/metrics            raw observation log (trial logs)
   GET /api/algorithms                       registered algorithms
+  GET /api/experiments/<name>/nas           NAS architecture graph (nas.go:109)
   GET /metrics                              Prometheus text exposition
   GET /                                     single-page HTML dashboard
+  POST /api/experiments                     create + start (UI create_experiment)
+  DELETE /api/experiments/<name>            delete experiment
 
-Read-only: serves from a live ExperimentController or from a persisted state
-root (``katib-tpu ui --root ...``).
+Serves from a live ExperimentController or from a persisted state root
+(``katib-tpu ui --root ...``). POSTed specs are JSON (command/entry_point
+trial templates only — functions aren't serializable) and are run on a
+background thread.
 """
 
 from __future__ import annotations
@@ -64,6 +69,42 @@ async function sel(n){
   metric:esc(t.objective??'')})),['trial','status','assignments','metric'])}
 load();setInterval(load,3000);
 </script></body></html>"""
+
+
+def nas_graph(exp, trials) -> Dict[str, Any]:
+    """Decode ENAS ``architecture``/``nn_config`` trial assignments into a
+    node/edge graph per trial (reference pkg/ui/v1beta1/nas.go)."""
+    out = []
+    for t in trials:
+        a = t.assignments_dict()
+        if "architecture" not in a:
+            continue
+        try:
+            arch = json.loads(a["architecture"].replace("'", '"'))
+            cfg = json.loads(a.get("nn_config", "{}").replace("'", '"'))
+            if not all(isinstance(layer, list) and layer for layer in arch):
+                raise TypeError("architecture must be a list of non-empty lists")
+        except (json.JSONDecodeError, TypeError):
+            continue  # skip malformed trials, keep the rest of the graph
+        embedding = cfg.get("embedding", {})
+        nodes, edges = [{"id": 0, "label": "input"}], []
+        for i, layer in enumerate(arch, start=1):
+            op = embedding.get(str(layer[0]), {})
+            label = op.get("opt_id", layer[0])
+            if isinstance(op, dict) and op.get("opt_type"):
+                label = f"{op['opt_type']}:{op.get('opt_id', layer[0])}"
+            nodes.append({"id": i, "label": str(label)})
+            edges.append({"from": i - 1, "to": i})
+            for prev, bit in enumerate(layer[1:], start=1):
+                if bit:  # skip connection from layer `prev` to this one
+                    edges.append({"from": prev, "to": i, "skip": True})
+        obj = None
+        if t.observation:
+            m = t.observation.metric(exp.spec.objective.objective_metric_name)
+            if m:
+                obj = m.latest
+        out.append({"trial": t.name, "nodes": nodes, "edges": edges, "objective": obj})
+    return {"experiment": exp.name, "architectures": out}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -151,6 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if sub == "suggestion":
                     s = ctrl.state.get_suggestion(name)
                     return self._send(s.to_dict() if s else None)
+                if sub == "nas":
+                    return self._send(nas_graph(exp, ctrl.state.list_trials(name)))
             if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
                 logs = ctrl.obs_store.get_observation_log(parts[3])
                 return self._send(
@@ -162,6 +205,51 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send({"error": "not found"}, code=404)
         except Exception as e:  # pragma: no cover - defensive
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+
+    def do_POST(self) -> None:  # noqa: N802
+        ctrl = self.controller
+        path = unquote(urlparse(self.path).path).rstrip("/")
+        try:
+            if path == "/api/experiments":
+                from ..api.spec import ExperimentSpec
+
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length).decode()
+                spec = ExperimentSpec.from_json(body)
+                exp = ctrl.create_experiment(spec)
+
+                def _run_quiet(name=exp.name):
+                    try:
+                        ctrl.run(name)
+                    except KeyError:
+                        pass  # experiment deleted while running
+                    except Exception:  # noqa: BLE001 - daemon thread, log only
+                        import traceback as tb
+
+                        tb.print_exc()
+
+                threading.Thread(
+                    target=_run_quiet, daemon=True, name=f"ui-run-{exp.name}"
+                ).start()
+                return self._send({"created": exp.name}, code=201)
+            return self._send({"error": "not found"}, code=404)
+        except Exception as e:
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        ctrl = self.controller
+        path = unquote(urlparse(self.path).path).rstrip("/")
+        try:
+            parts = path.split("/")
+            if len(parts) == 4 and parts[1] == "api" and parts[2] == "experiments":
+                name = parts[3]
+                if ctrl.state.get_experiment(name) is None:
+                    return self._send({"error": f"experiment {name!r} not found"}, code=404)
+                ctrl.delete_experiment(name)
+                return self._send({"deleted": name})
+            return self._send({"error": "not found"}, code=404)
+        except Exception as e:
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
 
 
 def serve_ui(controller, host: str = "127.0.0.1", port: int = 8080, block: bool = False):

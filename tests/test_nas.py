@@ -198,3 +198,45 @@ class TestEnasChildNet:
         logits = model.apply(variables, x, train=False)
         assert logits.shape == (2, 10)
         assert bool(jnp.isfinite(logits).all())
+
+
+class TestMatmulConv:
+    """MatmulConv must match nn.Conv exactly (same param shape/layout) —
+    it exists purely as a compile-time optimization on TPU."""
+
+    @pytest.mark.parametrize(
+        "ks,st",
+        [((1, 1), (1, 1)), ((1, 1), (2, 2)), ((3, 3), (1, 1)), ((3, 3), (2, 2)), ((5, 5), (1, 1))],
+    )
+    def test_matches_nn_conv(self, ks, st):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from katib_tpu.ops.darts_ops import MatmulConv
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 13, 13, 3)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(ks + (3, 7)), jnp.float32) * 0.1
+        ref = nn.Conv(7, ks, strides=st, padding="SAME", use_bias=False).apply(
+            {"params": {"kernel": w}}, x
+        )
+        got = MatmulConv(7, ks, strides=st).apply({"params": {"kernel": w}}, x)
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+    def test_dilated(self):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from katib_tpu.ops.darts_ops import MatmulConv
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((2, 11, 11, 4)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((3, 3, 4, 5)), jnp.float32) * 0.1
+        ref = nn.Conv(
+            5, (3, 3), padding="SAME", kernel_dilation=(2, 2), use_bias=False
+        ).apply({"params": {"kernel": w}}, x)
+        got = MatmulConv(5, (3, 3), kernel_dilation=(2, 2)).apply(
+            {"params": {"kernel": w}}, x
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)

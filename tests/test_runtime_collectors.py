@@ -129,3 +129,83 @@ class TestCheckpointStore:
         np.testing.assert_allclose(restored["w"], state["w"] * 2)
         old = store.restore(step=1)
         np.testing.assert_allclose(old["w"], state["w"])
+
+
+class TestPrometheusCollector:
+    def test_parse_prometheus_text(self):
+        from katib_tpu.runtime.metrics import parse_prometheus_text
+
+        text = (
+            "# HELP accuracy model accuracy\n"
+            "# TYPE accuracy gauge\n"
+            'accuracy{step="5"} 0.93\n'
+            "loss 0.12 1700000000\n"
+            "other_metric 42\n"
+        )
+        logs = parse_prometheus_text(text, ["accuracy", "loss"])
+        assert {(l.metric_name, l.value) for l in logs} == {("accuracy", "0.93"), ("loss", "0.12")}
+
+    def test_subprocess_prometheus_scrape_e2e(self, tmp_path):
+        """Subprocess trial serving /metrics; executor scrapes it
+        (reference CollectorKind PrometheusMetric)."""
+        import socket
+
+        from katib_tpu.api.spec import (
+            AlgorithmSpec,
+            CollectorKind,
+            ExperimentSpec,
+            FeasibleSpace,
+            MetricsCollectorSpec,
+            ObjectiveSpec,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+            SourceSpec,
+            TrialTemplate,
+        )
+        from katib_tpu.api.status import TrialCondition
+        from katib_tpu.controller.experiment import ExperimentController
+
+        with socket.socket() as s:  # pick a free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        server_py = (
+            "import http.server, threading, time, sys\n"
+            "class H(http.server.BaseHTTPRequestHandler):\n"
+            "    def log_message(self, *a): pass\n"
+            "    def do_GET(self):\n"
+            "        body = b'accuracy 0.88\\n'\n"
+            "        self.send_response(200); self.send_header('Content-Length', str(len(body)))\n"
+            "        self.end_headers(); self.wfile.write(body)\n"
+            f"srv = http.server.HTTPServer(('127.0.0.1', {port}), H)\n"
+            "threading.Thread(target=srv.serve_forever, daemon=True).start()\n"
+            "time.sleep(2.5)\n"
+        )
+        ctrl = ExperimentController(root_dir=str(tmp_path))
+        try:
+            spec = ExperimentSpec(
+                name="prom-e2e",
+                parameters=[
+                    ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+                ],
+                objective=ObjectiveSpec(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+                ),
+                algorithm=AlgorithmSpec("random"),
+                trial_template=TrialTemplate(command=["python", "-c", server_py]),
+                metrics_collector_spec=MetricsCollectorSpec(
+                    collector_kind=CollectorKind.PROMETHEUS,
+                    source=SourceSpec(http_port=port),
+                ),
+                max_trial_count=1,
+                parallel_trial_count=1,
+            )
+            ctrl.create_experiment(spec)
+            exp = ctrl.run("prom-e2e", timeout=60)
+            trials = ctrl.state.list_trials("prom-e2e")
+            assert trials and trials[0].condition == TrialCondition.SUCCEEDED
+            m = trials[0].observation.metric("accuracy")
+            assert m is not None and m.latest == "0.88"
+        finally:
+            ctrl.close()

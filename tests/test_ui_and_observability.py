@@ -136,3 +136,95 @@ class TestConfig:
         monkeypatch.setenv("KATIB_TPU_OBSLOG_BACKEND", "native")
         cfg = load_config(None)
         assert cfg.runtime.obslog_backend == "native"
+
+
+class TestUIWriteEndpoints:
+    def test_create_run_and_delete_experiment(self, stack):
+        """POST a JSON spec (reference UI create_experiment), watch it run,
+        then DELETE it."""
+        import time
+
+        base, ctrl = stack
+        spec_json = json.dumps({
+            "name": "ui-posted",
+            "parameters": [
+                {"name": "x", "parameterType": "double",
+                 "feasibleSpace": {"min": "0", "max": "1"}}
+            ],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "random"},
+            "trialTemplate": {
+                "command": ["python", "-c",
+                            "print('score=${trialParameters.x}')"],
+                "trialParameters": [{"name": "x", "reference": "x"}],
+            },
+            "maxTrialCount": 2,
+            "parallelTrialCount": 1,
+        })
+        req = urllib.request.Request(
+            f"{base}/api/experiments", data=spec_json.encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+            assert json.loads(r.read())["created"] == "ui-posted"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            status, _, body = get(f"{base}/api/experiments/ui-posted")
+            if json.loads(body)["status"]["conditions"][-1]["type"] == "Succeeded":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("posted experiment did not succeed in time")
+
+        dreq = urllib.request.Request(
+            f"{base}/api/experiments/ui-posted", method="DELETE"
+        )
+        with urllib.request.urlopen(dreq, timeout=10) as r:
+            assert json.loads(r.read())["deleted"] == "ui-posted"
+        status, _, _ = get_status(f"{base}/api/experiments/ui-posted")
+        assert status == 404
+
+    def test_post_invalid_spec_rejected(self, stack):
+        base, ctrl = stack
+        req = urllib.request.Request(
+            f"{base}/api/experiments", data=b'{"name": "bad"}', method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_nas_graph_endpoint(self, stack):
+        base, ctrl = stack
+        from katib_tpu.api.status import Trial
+        from katib_tpu.api.spec import ParameterAssignment
+
+        # synthesize an ENAS-style trial under the existing experiment
+        t = Trial(
+            name="ui-exp-nas1", experiment_name="ui-exp",
+            parameter_assignments=[
+                ParameterAssignment("architecture", "[[2], [0, 1]]"),
+                ParameterAssignment(
+                    "nn_config",
+                    "{'embedding': {'2': {'opt_type': 'convolution', 'opt_id': 2}, "
+                    "'0': {'opt_type': 'reduction', 'opt_id': 0}}}",
+                ),
+            ],
+        )
+        ctrl.state.update_trial(t)
+        status, _, body = get(f"{base}/api/experiments/ui-exp/nas")
+        graph = json.loads(body)
+        archs = graph["architectures"]
+        assert len(archs) == 1 and archs[0]["trial"] == "ui-exp-nas1"
+        assert {"from": 1, "to": 2, "skip": True} in archs[0]["edges"]
+        assert any("convolution" in n["label"] for n in archs[0]["nodes"])
+
+
+def get_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, "", ""
