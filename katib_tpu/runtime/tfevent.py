@@ -168,3 +168,100 @@ def collect_tfevent_metrics(
                             )
                         )
     return sorted(out, key=lambda l: l.timestamp)
+
+
+# -- writer ------------------------------------------------------------------
+# JAX trials that want TensorBoard-compatible output (the reference's
+# tf-mnist-with-summaries workload writes summaries via tf.summary) can emit
+# valid event files without a TensorFlow dependency. Masked CRC32C framing
+# per the TFRecord spec so real TensorBoard accepts the files.
+
+_CRC32C_TABLE = None
+_WRITER_SEQ = 0
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _write_varint(v: int) -> bytes:
+    if v < 0:
+        v &= (1 << 64) - 1  # two's-complement int64, protobuf varint rule
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _encode_field(num: int, wire: int) -> bytes:
+    return _write_varint((num << 3) | wire)
+
+
+def _encode_len_field(num: int, payload: bytes) -> bytes:
+    return _encode_field(num, _WIRE_LEN) + _write_varint(len(payload)) + payload
+
+
+def encode_scalar_event(wall_time: float, step: int, scalars: Dict[str, float]) -> bytes:
+    """Event proto bytes with TF1 simple_value scalars."""
+    summary = b""
+    for tag, value in scalars.items():
+        val_msg = _encode_len_field(1, tag.encode())
+        val_msg += _encode_field(2, _WIRE_32BIT) + struct.pack("<f", float(value))
+        summary += _encode_len_field(1, val_msg)
+    event = _encode_field(1, _WIRE_64BIT) + struct.pack("<d", wall_time)
+    event += _encode_field(2, _WIRE_VARINT) + _write_varint(step)
+    event += _encode_len_field(5, summary)
+    return event
+
+
+def write_scalar_events(
+    directory: str,
+    events: Sequence[Tuple[int, Dict[str, float]]],
+    filename: Optional[str] = None,
+) -> str:
+    """Write (step, {tag: value}) sequences as one tfevents file; returns
+    its path. Usable from any trial (no TF needed); the TfEvent collector
+    and TensorBoard both read the result."""
+    import time as _time
+
+    os.makedirs(directory, exist_ok=True)
+    if filename is None:
+        # time alone collides for calls in the same second (TF disambiguates
+        # with hostname+pid; we also need uniqueness within a process)
+        global _WRITER_SEQ
+        _WRITER_SEQ += 1
+        filename = (
+            f"events.out.tfevents.{int(_time.time())}.{os.getpid()}.{_WRITER_SEQ}.katib-tpu"
+        )
+    path = os.path.join(directory, filename)
+    base = _time.time()
+    with open(path, "wb") as f:
+        for i, (step, scalars) in enumerate(events):
+            payload = encode_scalar_event(base + i * 1e-3, step, scalars)
+            header = struct.pack("<Q", len(payload))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc(payload)))
+    return path
