@@ -23,6 +23,7 @@ from katib_tpu.api import (
     ObjectiveType,
     ParameterSpec,
     ParameterType,
+    ResumePolicy,
     TrialTemplate,
 )
 from katib_tpu.controller.experiment import ExperimentController
@@ -211,3 +212,46 @@ class TestSubprocessTrialE2E:
         assert exp.status.trials_succeeded == 3
         best = float(exp.status.current_optimal_trial.observation.metric("score").max)
         assert 0.0 < best <= 1.0
+
+
+class TestResumePolicies:
+    """Resume semantics e2e (experiment_controller.go:187-206,
+    status_util.go:240-246): LongRunning restarts on a raised budget, Never
+    rejects the edit."""
+
+    def _spec(self, name, policy):
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=lambda a, c: c.report(score=float(a["x"]))),
+            max_trial_count=3,
+            parallel_trial_count=2,
+            resume_policy=policy,
+        )
+
+    def test_long_running_resumes_on_budget_raise(self, controller):
+        controller.create_experiment(self._spec("resume-e2e", ResumePolicy.LONG_RUNNING))
+        exp = controller.run("resume-e2e", timeout=60)
+        assert exp.status.is_succeeded and exp.status.trials_succeeded == 3
+
+        controller.edit_experiment_budget("resume-e2e", max_trial_count=6)
+        exp = controller.run("resume-e2e", timeout=60)
+        assert exp.status.is_succeeded, exp.status.message
+        assert exp.status.trials_succeeded == 6
+        # suggestion state survived the restart: count matches total trials
+        s = controller.state.get_suggestion("resume-e2e")
+        assert s.suggestion_count == 6
+
+    def test_never_policy_rejects_restart(self, controller):
+        from katib_tpu.api.validation import ValidationError
+
+        controller.create_experiment(self._spec("never-e2e", ResumePolicy.NEVER))
+        exp = controller.run("never-e2e", timeout=60)
+        assert exp.status.is_succeeded
+
+        with pytest.raises(ValidationError):
+            controller.edit_experiment_budget("never-e2e", max_trial_count=6)
