@@ -123,6 +123,24 @@ def cmd_ui(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the algorithm/DB gRPC service standalone — the reference's
+    suggestion-pod / db-manager deployment shape (cmd/suggestion/*/main.py,
+    cmd/db-manager). Controllers on other hosts reach it via
+    service.rpc.ApiClient / RemoteSuggester / RemoteObservationStore."""
+    import os
+
+    from .db.store import open_store
+    from .service.rpc import serve
+
+    db_path = os.path.join(args.root, "observations.db") if args.root else None
+    store = open_store(db_path)
+    server = serve(port=args.port, store=store)
+    print(f"serving suggestion/early-stopping/db-manager gRPC on :{server.bound_port}")
+    server.wait_for_termination()
+    return 0
+
+
 def _load_all(ctrl, root: Optional[str]) -> None:
     """Hydrate persisted experiments from the state root."""
     import os
@@ -196,6 +214,12 @@ def main(argv=None) -> int:
     ui.add_argument("--host", default="127.0.0.1")
     ui.add_argument("--port", type=int, default=8080)
     ui.set_defaults(fn=cmd_ui)
+
+    sv = sub.add_parser(
+        "serve", help="run the suggestion/early-stopping/db-manager gRPC service"
+    )
+    sv.add_argument("--port", type=int, default=6789)
+    sv.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -248,6 +248,13 @@ def objective_value(
     return _parse_float(raw)
 
 
+def obs_db_path(root: Optional[str]) -> Optional[str]:
+    """Canonical observation-log DB location under a state root."""
+    import os
+
+    return os.path.join(root, "observations.db") if root else None
+
+
 def open_store(path: Optional[str], backend: str = "auto") -> ObservationStore:
     """Factory, reference pkg/db/v1beta1/db.go (driver selection by env).
 

@@ -78,3 +78,43 @@ class TestRemoteDBManager:
         assert [r.value for r in rows] == ["0.5", "0.9"]
         db.delete_observation_log("rpc-t1")
         assert db.get_observation_log("rpc-t1") == []
+
+
+def test_cli_serve_starts_service(tmp_path):
+    """katib-tpu serve runs the gRPC plane standalone; a RemoteSuggester can
+    fetch assignments from it (reference suggestion-pod topology)."""
+    import socket
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "katib_tpu.cli", "--root", str(tmp_path), "serve",
+         "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    try:
+        from katib_tpu.service.rpc import RemoteObservationStore
+        from katib_tpu.db.store import MetricLog
+
+        store = RemoteObservationStore(f"localhost:{port}", timeout=5)
+        deadline = time.time() + 30
+        logs = None
+        while time.time() < deadline:
+            try:
+                store.report_observation_log(
+                    "cli-serve-t1", [MetricLog(timestamp=1.0, metric_name="m", value="0.5")]
+                )
+                logs = store.get_observation_log("cli-serve-t1")
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert logs and logs[0].value == "0.5", logs
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
