@@ -1,0 +1,58 @@
+"""The literal driver entry points (``__graft_entry__``) must work — round-1
+failed precisely here (MULTICHIP rc=124): the multichip dryrun hung on TPU
+backend bring-up because nothing forced the CPU platform. These tests call
+the entry points the way the driver does, under hard timeouts.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_inprocess():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_multichip_subprocess_under_timeout():
+    """The driver invocation shape: fresh interpreter, hard timeout well under
+    the driver's budget. Must finish in <150s on 8 virtual CPU devices."""
+    env = dict(os.environ)
+    # Simulate the hostile round-1 environment: platform env pointing at a
+    # non-CPU backend; dryrun_multichip must force CPU itself.
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK [tp/sp/ep/dp]" in proc.stdout
+    assert "dryrun_multichip OK [pp/dp]" in proc.stdout
+
+
+def test_entry_compiles_single_device():
+    import jax
+
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (8, 10)
+    finally:
+        sys.path.remove(REPO)
