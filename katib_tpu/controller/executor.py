@@ -245,10 +245,14 @@ class SubprocessExecutor:
         )
 
     SCRAPE_INTERVAL = 1.0  # seconds between Prometheus scrapes
+    # A metric legitimately reporting the SAME value across steps must still
+    # produce observations (early-stopping step counters advance per record):
+    # identical values are deduped only within this window, then re-recorded.
+    SCRAPE_DEDUP_WINDOW = 10.0
 
     def _scrape_prometheus(
         self, spec: ExperimentSpec, prom_logs: List[MetricLog],
-        monitor: Optional[EarlyStoppingMonitor], last_scraped: Dict[str, str],
+        monitor: Optional[EarlyStoppingMonitor], last_scraped: Dict[str, Any],
     ) -> Optional[ExecutionResult]:
         from urllib.request import urlopen
 
@@ -265,15 +269,18 @@ class SubprocessExecutor:
             # http.client.* and ValueError variants here)
             return None
         logs = parse_prometheus_text(text, spec.objective.all_metric_names())
-        # scrapes sample state, they are not reports: only record changes so
-        # the log and the early-stopping step counter advance per new value,
-        # not per wall-clock second
-        fresh = [
-            log for log in logs
-            if last_scraped.get(log.metric_name) != log.value
-        ]
-        for log in fresh:
-            last_scraped[log.metric_name] = log.value
+        # scrapes sample state, they are not reports: dedup on (value, time
+        # bucket) — a changed value records immediately, an unchanged value
+        # re-records after SCRAPE_DEDUP_WINDOW so constant metrics still
+        # advance the observation log / early-stopping step counters
+        now = time.time()
+        fresh = []
+        for log in logs:
+            prev = last_scraped.get(log.metric_name)
+            if prev is not None and prev[0] == log.value and now - prev[1] < self.SCRAPE_DEDUP_WINDOW:
+                continue
+            last_scraped[log.metric_name] = (log.value, now)
+            fresh.append(log)
         prom_logs.extend(fresh)
         if monitor is not None:
             for log in fresh:
@@ -307,7 +314,7 @@ class SubprocessExecutor:
             and prom_logs is not None
         )
         last_scrape = 0.0
-        last_scraped: Dict[str, str] = {}  # per-trial change detection
+        last_scraped: Dict[str, Any] = {}  # metric -> (value, recorded_at)
         while True:
             if handle.kill_requested:
                 self._terminate(proc)
@@ -338,6 +345,13 @@ class SubprocessExecutor:
                                 self._terminate(proc)
                                 return ExecutionResult(TrialOutcome.EARLY_STOPPED)
             if rc is not None:
+                if scrape:
+                    # best-effort final scrape — values published within the
+                    # last SCRAPE_INTERVAL are otherwise lost when the trial's
+                    # endpoint dies with the process. (PROMETHEUS trials that
+                    # exit immediately after publishing should also Push — see
+                    # README metrics-collector notes.)
+                    self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
                 return None
             time.sleep(self.POLL_INTERVAL)
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import threading
 import time
 from typing import Any, List, Optional, Sequence
 
@@ -77,6 +78,7 @@ class ExperimentController:
         self.events = EventRecorder()
         self.metrics = MetricsRegistry()
         self._completed_seen: set = set()
+        self._closed = threading.Event()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
         self.scheduler = TrialScheduler(
             self.state,
@@ -266,6 +268,10 @@ class ExperimentController:
         deadline = None if timeout is None else time.time() + timeout
         exp = self.reconcile(name)
         while not exp.status.is_completed:
+            if self._closed.is_set():
+                # controller shut down (close()) — stop driving so no run
+                # thread keeps submitting trials / holding chips past intent
+                break
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError(f"experiment {name!r} did not complete in {timeout}s")
             try:
@@ -292,6 +298,7 @@ class ExperimentController:
         self.state.delete_experiment(name)
 
     def close(self) -> None:
+        self._closed.set()  # unhooks run() loops (incl. UI run-threads)
         self.scheduler.kill_all()
         self.scheduler.join(timeout=10)
         self.obs_store.close()

@@ -115,6 +115,7 @@ class TrialScheduler:
         self._waiting: List = []  # trials waiting for devices
         self._threads: List[threading.Thread] = []
         self._checkpoint_dirs: Dict[str, str] = {}
+        self._quarantined = 0  # devices held by abandoned zombie trials
         self._shutdown = threading.Event()
 
     # -- submission ----------------------------------------------------------
@@ -196,6 +197,7 @@ class TrialScheduler:
     def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
         restarted = False
         timer = None
+        abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         try:
             trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "Trial is running")
@@ -216,7 +218,9 @@ class TrialScheduler:
                 executor = self._subprocess
             else:
                 executor = self._in_process
-            result = self._execute_bounded(executor, exp, trial, ctx, handle, timed_out)
+            result, abandoned = self._execute_bounded(
+                executor, exp, trial, ctx, handle, timed_out
+            )
 
             if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
                 # deadline exceeded counts against maxFailedTrialCount
@@ -233,7 +237,13 @@ class TrialScheduler:
         finally:
             if timer is not None:
                 timer.cancel()
-            self.allocator.release(devices)
+            if abandoned is not None and abandoned.is_alive():
+                # An abandoned in-process trial may still be running JAX work
+                # on these chips — quarantine them (don't hand them to the
+                # next trial) until the zombie thread actually exits.
+                self._quarantine(trial.name, devices, abandoned)
+            else:
+                self.allocator.release(devices)
             self._handles.pop(trial.name, None)
             if not restarted:
                 self._checkpoint_dirs.pop(trial.name, None)
@@ -246,14 +256,14 @@ class TrialScheduler:
     def _execute_bounded(
         self, executor, exp: Experiment, trial: Trial, ctx, handle: TrialExecution,
         timed_out: threading.Event,
-    ) -> ExecutionResult:
+    ) -> "tuple[ExecutionResult, Optional[threading.Thread]]":
         """Run the executor on a worker thread so a kill/timeout cannot leak
         the gang allocation. Subprocess trials die on SIGTERM; in-process
         trials unwind cooperatively (TrialKilled raised at their next
         ctx.report()). A function that never reports and never returns is
         abandoned after a grace period — its daemon thread keeps running (a
-        Python thread can't be force-killed), but the devices and the
-        scheduler slot are reclaimed, mirroring the reference's pod kill."""
+        Python thread can't be force-killed) and is returned to the caller so
+        the devices it may still be using get quarantined, not reissued."""
         box: Dict[str, Any] = {}
 
         def _exec():
@@ -281,10 +291,42 @@ class TrialScheduler:
                     TrialOutcome.FAILED if timed_out.is_set() else TrialOutcome.KILLED,
                     f"{reason}; trial did not stop within "
                     f"{self.KILL_GRACE_SECONDS}s grace, abandoned",
-                )
+                ), worker
         if "error" in box:
-            return ExecutionResult(TrialOutcome.FAILED, box["error"])
-        return box["result"]
+            return ExecutionResult(TrialOutcome.FAILED, box["error"]), None
+        return box["result"], None
+
+    def _quarantine(
+        self, trial_name: str, devices: Sequence[Any], worker: threading.Thread
+    ) -> None:
+        """Hold the gang allocation of an abandoned (zombie) trial until its
+        worker thread actually exits, then release and re-dispatch."""
+        with self._lock:
+            self._quarantined += len(devices)
+        log.warning(
+            "quarantining %d device(s) of abandoned trial %s until its "
+            "worker thread exits", len(devices), trial_name,
+        )
+
+        def _reap():
+            worker.join()
+            with self._lock:
+                self._quarantined -= len(devices)
+            log.warning(
+                "abandoned trial %s finally exited; releasing %d quarantined "
+                "device(s)", trial_name, len(devices),
+            )
+            self.allocator.release(devices)
+            self._dispatch()
+
+        threading.Thread(
+            target=_reap, daemon=True, name=f"reap-{trial_name}"
+        ).start()
+
+    @property
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return self._quarantined
 
     def _maybe_restart(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> bool:
         """Retry failed trials up to KatibConfig max_trial_restarts times

@@ -26,8 +26,10 @@ class ExperimentStateStore:
         self._experiments: Dict[str, Experiment] = {}
         self._trials: Dict[str, Dict[str, Trial]] = {}
         self._suggestions: Dict[str, SuggestionState] = {}
+        self._templates: Dict[str, dict] = {}
         if root:
             os.makedirs(root, exist_ok=True)
+            self._load_templates()
 
     # -- experiments --------------------------------------------------------
 
@@ -109,6 +111,52 @@ class ExperimentStateStore:
         with self._lock:
             self._suggestions.pop(experiment_name, None)
             self._persist(experiment_name)
+
+    # -- trial templates ------------------------------------------------------
+    # Reference: the UI's trial-template configmap CRUD
+    # (pkg/ui/v1beta1/backend.go template endpoints); here templates are
+    # TrialTemplate JSON dicts persisted under <root>/templates/.
+
+    def put_template(self, name: str, template: dict) -> None:
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"invalid template name {name!r}")
+        with self._lock:
+            self._templates[name] = template
+            if self.root:
+                d = os.path.join(self.root, "templates")
+                os.makedirs(d, exist_ok=True)
+                tmp = os.path.join(d, name + ".json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(template, f, indent=2)
+                os.replace(tmp, os.path.join(d, name + ".json"))
+
+    def get_template(self, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._templates.get(name)
+
+    def list_templates(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._templates)
+
+    def delete_template(self, name: str) -> None:
+        with self._lock:
+            self._templates.pop(name, None)
+            if self.root:
+                p = os.path.join(self.root, "templates", name + ".json")
+                if os.path.exists(p):
+                    os.remove(p)
+
+    def _load_templates(self) -> None:
+        d = os.path.join(self.root, "templates")
+        if not os.path.isdir(d):
+            return
+        for fn in os.listdir(d):
+            if fn.endswith(".json"):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        self._templates[fn[:-5]] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
 
     # -- persistence ---------------------------------------------------------
 

@@ -65,8 +65,10 @@ def make_pipeline_lm_train_step(
     num_microbatches: Optional[int] = None,
     seed: int = 0,
 ):
-    """Returns (params, opt_state, step_fn) with
-    step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss).
+    """Returns (params, opt_state, step_fn, put_batch) with
+    step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss)
+    and put_batch(tokens, targets) placing host arrays with the step's
+    expected NamedSharding.
 
     tokens/targets: [B, T] int32, B sharded over 'data'. params is
     {'embed': [V, E], 'blocks': pytree with leading [n_stages, lps],
@@ -153,11 +155,20 @@ def make_pipeline_lm_train_step(
             body, (state0, out_buf0), jnp.arange(n_micro + n_stages - 1)
         )
 
-        # head on the last stage only; psum makes the scalar global
+        # Head + loss. SPMD means every stage executes this code (a
+        # device-varying lax.cond would lower to a select that still runs
+        # both branches), but scanning over microbatches keeps the logits
+        # buffer at [mb, T, V] instead of materializing [n_micro, mb, T, V]
+        # vocab logits on every device; only the last stage's value is kept.
         h = RMSNorm().apply({"params": {"scale": lnf}}, out_buf)
-        logits = jnp.einsum("mbte,ve->mbtv", h.astype(jnp.float32), embed_p)
-        local = optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
-        masked = jnp.where(stage == n_stages - 1, local, 0.0)
+
+        def ce_micro(acc, hm_tm):
+            hm, tm = hm_tm
+            logits = jnp.einsum("bte,ve->btv", hm.astype(jnp.float32), embed_p)
+            return acc + optax.softmax_cross_entropy_with_integer_labels(logits, tm).mean(), None
+
+        local, _ = jax.lax.scan(ce_micro, jnp.float32(0.0), (h, tgt))
+        masked = jnp.where(stage == n_stages - 1, local / n_micro, 0.0)
         return jax.lax.psum(masked, "pipe")
 
     def spmd_step(embed_p, blocks_local, lnf, tokens, targets):

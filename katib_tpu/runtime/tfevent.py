@@ -177,7 +177,11 @@ def collect_tfevent_metrics(
 # per the TFRecord spec so real TensorBoard accepts the files.
 
 _CRC32C_TABLE = None
-_WRITER_SEQ = 0
+# atomic per-process uniqueness for writer filenames (two in-process trial
+# threads writing in the same second must not collide and truncate each other)
+import itertools as _itertools
+
+_WRITER_SEQ = _itertools.count(1)
 
 
 def _crc32c(data: bytes) -> int:
@@ -249,10 +253,9 @@ def write_scalar_events(
     if filename is None:
         # time alone collides for calls in the same second (TF disambiguates
         # with hostname+pid; we also need uniqueness within a process)
-        global _WRITER_SEQ
-        _WRITER_SEQ += 1
         filename = (
-            f"events.out.tfevents.{int(_time.time())}.{os.getpid()}.{_WRITER_SEQ}.katib-tpu"
+            f"events.out.tfevents.{int(_time.time())}.{os.getpid()}."
+            f"{next(_WRITER_SEQ)}.katib-tpu"
         )
     path = os.path.join(directory, filename)
     base = _time.time()

@@ -1,32 +1,45 @@
 """Web UI — REST backend + embedded dashboard.
 
 reference cmd/ui/v1beta1/main.go:42-75 (REST endpoints fetch_experiments,
-fetch_experiment, fetch_hp_job_info, fetch_trial_logs, fetch_suggestion) +
-the Angular frontend (pkg/ui/v1beta1/frontend). The TPU-native replacement is
-a zero-dependency threaded http.server with the same information surface:
+fetch_experiment, fetch_hp_job_info, fetch_trial_logs, fetch_suggestion,
+trial-template CRUD) + the Angular frontend (pkg/ui/v1beta1/frontend). The
+TPU-native replacement is a zero-dependency threaded http.server with the
+same information surface:
 
-  GET /api/experiments                      list with status summary
-  GET /api/experiments/<name>               full spec+status
-  GET /api/experiments/<name>/trials        fetch_hp_job_info view
-  GET /api/experiments/<name>/events        event stream (K8s Events parity)
-  GET /api/experiments/<name>/suggestion    suggestion state
-  GET /api/trials/<name>/metrics            raw observation log (trial logs)
-  GET /api/algorithms                       registered algorithms
-  GET /api/experiments/<name>/nas           NAS architecture graph (nas.go:109)
-  GET /metrics                              Prometheus text exposition
-  GET /                                     single-page HTML dashboard
-  POST /api/experiments                     create + start (UI create_experiment)
-  DELETE /api/experiments/<name>            delete experiment
+  GET /api/experiments                          list with status summary
+  GET /api/experiments/<name>                   full spec+status
+  GET /api/experiments/<name>/trials            fetch_hp_job_info view
+  GET /api/experiments/<name>/trials/<t>/logs   trial stdout (fetch_trial_logs)
+  GET /api/experiments/<name>/events            event stream (K8s Events parity)
+  GET /api/experiments/<name>/suggestion        suggestion state
+  GET /api/trials/<name>/metrics                raw observation log
+  GET /api/algorithms                           registered algorithms
+  GET /api/experiments/<name>/nas               NAS architecture graph (nas.go:109)
+  GET /api/templates[/<name>]                   trial-template store
+  GET /metrics                                  Prometheus text exposition
+  GET /                                         single-page HTML dashboard
+  POST /api/experiments                         create + start   [auth]
+  POST /api/templates                           save template    [auth]
+  DELETE /api/experiments/<name>                delete           [auth]
+  DELETE /api/templates/<name>                  delete template  [auth]
 
-Serves from a live ExperimentController or from a persisted state root
-(``katib-tpu ui --root ...``). POSTed specs are JSON (command/entry_point
-trial templates only — functions aren't serializable) and are run on a
-background thread.
+Write endpoints execute user-supplied specs, so they are authenticated: a
+bearer token is generated at ``serve_ui`` startup (printed to the operator)
+and must arrive as ``Authorization: Bearer <token>`` or ``X-Katib-Token``.
+Cross-origin browser writes are additionally rejected by an Origin/Host
+check (a drive-by webpage can fire no-preflight POSTs at localhost; it
+cannot read the token).
+
+POSTed experiment specs are JSON (command/entry_point trial templates only —
+functions aren't serializable); ``"trial_template_ref": "<name>"`` resolves
+a stored template. Runs happen on background threads that stop when the
+controller is closed.
 """
 
 from __future__ import annotations
 
 import json
+import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -40,10 +53,17 @@ table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #
 th,td{text-align:left;padding:.4rem .7rem;border-bottom:1px solid #eee;font-size:.9rem}
 th{background:#f0f0f3} .Succeeded{color:#0a7d36}.Failed{color:#b3261e}
 .Running{color:#0b57d0}.EarlyStopped{color:#7b5ea7} code{font-size:.85em}
+svg.spark{vertical-align:middle}
+#logbox{background:#111;color:#ddd;padding:.8rem;font:0.78rem/1.3 monospace;
+ white-space:pre-wrap;max-height:24rem;overflow:auto;display:none}
+a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
+.muted{color:#888;font-size:.85em}
 </style></head><body>
 <h1>katib-tpu experiments</h1>
 <div id="exps">loading...</div>
 <h2 id="selname"></h2><div id="trials"></div>
+<pre id="logbox"></pre>
+<h2>trial templates</h2><div id="templates" class="muted">loading...</div>
 <script>
 async function j(u){return (await fetch(u)).json()}
 const esc=s=>String(s??'').replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
@@ -51,6 +71,11 @@ function table(rows, cols){if(!rows.length)return '<i>none</i>';
  let h='<table><tr>'+cols.map(c=>`<th>${esc(c)}</th>`).join('')+'</tr>';
  for(const r of rows)h+='<tr>'+cols.map(c=>`<td class="${esc(r[c+'_cls']??'')}">${r[c]??''}</td>`).join('')+'</tr>';
  return h+'</table>'}
+function spark(vals){if(!vals||vals.length<2)return'';
+ const w=120,h=24,mn=Math.min(...vals),mx=Math.max(...vals),rg=(mx-mn)||1;
+ const pts=vals.map((v,i)=>`${(i/(vals.length-1)*w).toFixed(1)},${(h-2-(v-mn)/rg*(h-4)).toFixed(1)}`).join(' ');
+ return `<svg class="spark" width="${w}" height="${h}"><polyline points="${pts}" fill="none" stroke="#0b57d0" stroke-width="1.5"/></svg>`}
+let CUR=null;
 async function load(){
  const es=await j('/api/experiments');
  document.getElementById('exps').innerHTML=table(es.map(e=>({
@@ -59,15 +84,34 @@ async function load(){
   succeeded:`${esc(e.trialsSucceeded)}/${esc(e.trials)}`,best:esc(e.bestTrialName)})),
   ['name','status','reason','algorithm','succeeded','best']);
  for(const a of document.querySelectorAll('.explink'))
-  a.onclick=(ev)=>{ev.preventDefault();sel(a.dataset.name)}}
+  a.onclick=(ev)=>{ev.preventDefault();sel(a.dataset.name)};
+ if(CUR)sel(CUR)}
 async function sel(n){
+ CUR=n;
  const ts=await j(`/api/experiments/${encodeURIComponent(n)}/trials`);
+ const curves=await Promise.all(ts.map(async t=>{
+  try{const m=await j(`/api/trials/${encodeURIComponent(t.name)}/metrics?limit=200`);
+   return m.filter(x=>!isNaN(parseFloat(x.value))).map(x=>parseFloat(x.value));}
+  catch(e){return []}}));
  document.getElementById('selname').textContent=`trials of ${n}`;
- document.getElementById('trials').innerHTML=table(ts.map(t=>({
+ document.getElementById('trials').innerHTML=table(ts.map((t,i)=>({
   trial:esc(t.name),status:esc(t.condition),status_cls:t.condition,
   assignments:`<code>${esc(JSON.stringify(t.assignments))}</code>`,
-  metric:esc(t.objective??'')})),['trial','status','assignments','metric'])}
-load();setInterval(load,3000);
+  metric:esc(t.objective??''),curve:spark(curves[i]),
+  logs:`<a href="#" class="loglink" data-exp="${esc(n)}" data-trial="${esc(t.name)}">logs</a>`})),
+  ['trial','status','assignments','metric','curve','logs']);
+ for(const a of document.querySelectorAll('.loglink'))
+  a.onclick=async(ev)=>{ev.preventDefault();
+   const r=await fetch(`/api/experiments/${encodeURIComponent(a.dataset.exp)}/trials/${encodeURIComponent(a.dataset.trial)}/logs`);
+   const b=document.getElementById('logbox');
+   b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}}
+async function loadTemplates(){
+ const t=await j('/api/templates');
+ const names=Object.keys(t);
+ document.getElementById('templates').innerHTML=
+  names.length?table(names.map(n=>({name:esc(n),
+   template:`<code>${esc(JSON.stringify(t[n]).slice(0,160))}</code>`})),['name','template']):'<i>none</i>'}
+load();loadTemplates();setInterval(load,3000);
 </script></body></html>"""
 
 
@@ -108,7 +152,8 @@ def nas_graph(exp, trials) -> Dict[str, Any]:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    controller = None  # injected by serve_ui
+    controller = None   # injected by serve_ui
+    auth_token = None   # injected by serve_ui; None disables write endpoints
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -124,6 +169,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    # -- write-endpoint protection ------------------------------------------
+
+    def _authorize_write(self) -> Optional[str]:
+        """Returns an error string for rejected writes, None when allowed."""
+        origin = self.headers.get("Origin")
+        if origin:
+            host = self.headers.get("Host", "")
+            o = urlparse(origin)
+            if o.netloc and o.netloc != host:
+                return f"cross-origin write from {origin!r} rejected"
+        if self.auth_token is None:
+            return "write endpoints are disabled (no auth token configured)"
+        supplied = self.headers.get("X-Katib-Token", "")
+        auth = self.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            supplied = auth[len("Bearer "):]
+        # compare as bytes: compare_digest raises on non-ASCII str (header
+        # values are latin-1 decoded, so attacker-controlled bytes reach here)
+        if not secrets.compare_digest(
+            supplied.encode("utf-8", "replace"), self.auth_token.encode()
+        ):
+            return "missing or invalid auth token"
+        return None
 
     def do_GET(self) -> None:  # noqa: N802
         ctrl = self.controller
@@ -143,6 +212,14 @@ class _Handler(BaseHTTPRequestHandler):
                         "earlyStopping": sorted(registered_early_stoppers()),
                     }
                 )
+            if path == "/api/templates":
+                return self._send(ctrl.state.list_templates())
+            if path.startswith("/api/templates/"):
+                name = path[len("/api/templates/"):]
+                tpl = ctrl.state.get_template(name)
+                if tpl is None:
+                    return self._send({"error": f"template {name!r} not found"}, code=404)
+                return self._send(tpl)
             if path == "/api/experiments":
                 out = []
                 for e in ctrl.state.list_experiments():
@@ -169,6 +246,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if len(parts) == 4:
                     return self._send(exp.to_dict())
                 sub = parts[4]
+                if sub == "trials" and len(parts) == 7 and parts[6] == "logs":
+                    return self._trial_logs(name, parts[5])
                 if sub == "trials":
                     out = []
                     for t in ctrl.state.list_trials(name):
@@ -195,7 +274,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if sub == "nas":
                     return self._send(nas_graph(exp, ctrl.state.list_trials(name)))
             if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
+                from urllib.parse import parse_qs
+
                 logs = ctrl.obs_store.get_observation_log(parts[3])
+                q = parse_qs(urlparse(self.path).query)
+                limit = q.get("limit", [None])[0]
+                if limit is not None and limit.isdigit():
+                    logs = logs[-int(limit):]  # tail: the recent records
                 return self._send(
                     [
                         {"timestamp": l.timestamp, "metric": l.metric_name, "value": l.value}
@@ -206,16 +291,65 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # pragma: no cover - defensive
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
 
+    def _trial_logs(self, exp_name: str, trial_name: str) -> None:
+        """Serve the trial workdir's stdout.log (reference fetch_trial_logs,
+        cmd/ui/v1beta1/main.go + pod-log fetch)."""
+        import os
+
+        root = getattr(self.controller.scheduler, "workdir_root", None)
+        if not root:
+            return self._send({"error": "no trial workdir root configured"}, code=404)
+        # trial names are controller-generated, but never trust path joins
+        if "/" in trial_name or "/" in exp_name or ".." in trial_name or ".." in exp_name:
+            return self._send({"error": "invalid name"}, code=400)
+        path = os.path.join(root, exp_name, trial_name, "stdout.log")
+        if not os.path.exists(path):
+            return self._send({"error": "no logs for this trial"}, code=404)
+        tail_limit = 1 << 20  # serve at most the last 1 MiB
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > tail_limit:
+                f.seek(size - tail_limit)
+            data = f.read(tail_limit)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_POST(self) -> None:  # noqa: N802
         ctrl = self.controller
         path = unquote(urlparse(self.path).path).rstrip("/")
+        denied = self._authorize_write()
+        if denied:
+            return self._send({"error": denied}, code=403)
         try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length).decode()
+            if path == "/api/templates":
+                payload = json.loads(body)
+                name = payload.get("name")
+                template = payload.get("template")
+                if not name or not isinstance(template, dict):
+                    return self._send(
+                        {"error": "body must be {'name': str, 'template': {...}}"},
+                        code=400,
+                    )
+                ctrl.state.put_template(name, template)
+                return self._send({"saved": name}, code=201)
             if path == "/api/experiments":
                 from ..api.spec import ExperimentSpec
 
-                length = int(self.headers.get("Content-Length", "0"))
-                body = self.rfile.read(length).decode()
-                spec = ExperimentSpec.from_json(body)
+                payload = json.loads(body)
+                ref = payload.pop("trial_template_ref", None)
+                if ref is not None:
+                    tpl = ctrl.state.get_template(ref)
+                    if tpl is None:
+                        return self._send(
+                            {"error": f"trial_template_ref {ref!r} not found"}, code=400
+                        )
+                    payload["trialTemplate"] = tpl
+                spec = ExperimentSpec.from_json(json.dumps(payload))
                 exp = ctrl.create_experiment(spec)
 
                 def _run_quiet(name=exp.name):
@@ -239,8 +373,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self) -> None:  # noqa: N802
         ctrl = self.controller
         path = unquote(urlparse(self.path).path).rstrip("/")
+        denied = self._authorize_write()
+        if denied:
+            return self._send({"error": denied}, code=403)
         try:
             parts = path.split("/")
+            if len(parts) == 4 and parts[1] == "api" and parts[2] == "templates":
+                if ctrl.state.get_template(parts[3]) is None:
+                    return self._send({"error": f"template {parts[3]!r} not found"}, code=404)
+                ctrl.state.delete_template(parts[3])
+                return self._send({"deleted": parts[3]})
             if len(parts) == 4 and parts[1] == "api" and parts[2] == "experiments":
                 name = parts[3]
                 if ctrl.state.get_experiment(name) is None:
@@ -252,10 +394,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
 
 
-def serve_ui(controller, host: str = "127.0.0.1", port: int = 8080, block: bool = False):
-    """Start the UI server; returns the ThreadingHTTPServer."""
-    handler = type("BoundHandler", (_Handler,), {"controller": controller})
+def serve_ui(
+    controller,
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    block: bool = False,
+    auth_token: Optional[str] = "auto",
+):
+    """Start the UI server; returns the ThreadingHTTPServer.
+
+    ``auth_token="auto"`` (default) generates a random bearer token for the
+    write endpoints and prints it once to the operator; pass an explicit
+    string to fix it, or ``None`` to disable write endpoints entirely.
+    The token is exposed as ``httpd.auth_token``.
+    """
+    if auth_token == "auto":
+        auth_token = secrets.token_urlsafe(24)
+        print(f"katib-tpu ui: write-endpoint token: {auth_token}")
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {"controller": controller, "auth_token": auth_token},
+    )
     httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.auth_token = auth_token
     if block:
         httpd.serve_forever()
     else:

@@ -173,12 +173,13 @@ def test_trial_timeout_kills_in_process_trial(tmp_path):
 
 
 def _hang_without_reporting(assignments, ctx):
-    time.sleep(60)
+    time.sleep(2.5)
 
 
 def test_trial_timeout_abandons_hung_in_process_trial(tmp_path):
-    """A function that never reports is abandoned after the grace period and
-    its slot/devices reclaimed."""
+    """A function that never reports is abandoned after the grace period; its
+    devices are QUARANTINED (the zombie thread may still be running JAX work
+    on them) and only released when the thread actually exits."""
     from katib_tpu.controller.scheduler import TrialScheduler
 
     cfg = KatibConfig(runtime=RuntimeConfig(trial_timeout_seconds=0.3))
@@ -192,6 +193,17 @@ def test_trial_timeout_abandons_hung_in_process_trial(tmp_path):
         trials = c.state.list_trials("cfg-timeout-hang")
         assert trials and trials[0].condition == TrialCondition.FAILED
         assert "abandoned" in trials[0].message
+        # while the zombie sleeps, its device must NOT be reissued
+        assert c.scheduler.quarantined_count == 1
+        assert (
+            c.scheduler.allocator.free_count
+            == c.scheduler.allocator.total - c.scheduler.quarantined_count
+        )
+        # once the zombie exits, the reaper returns the device
+        deadline = time.time() + 10
+        while time.time() < deadline and c.scheduler.quarantined_count:
+            time.sleep(0.1)
+        assert c.scheduler.quarantined_count == 0
         assert c.scheduler.allocator.free_count == c.scheduler.allocator.total
     finally:
         c.close()

@@ -40,7 +40,7 @@ def stack(tmp_path_factory):
     ctrl.run("ui-exp", timeout=60)
     httpd = serve_ui(ctrl, port=0)
     port = httpd.server_address[1]
-    yield f"http://127.0.0.1:{port}", ctrl
+    yield f"http://127.0.0.1:{port}", ctrl, httpd.auth_token
     httpd.shutdown()
     ctrl.close()
 
@@ -53,7 +53,7 @@ def get(url):
 
 class TestUIServer:
     def test_experiment_list(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         status, ctype, body = get(f"{base}/api/experiments")
         assert status == 200 and "json" in ctype
         exps = json.loads(body)
@@ -63,7 +63,7 @@ class TestUIServer:
         assert exps[0]["bestTrialName"]
 
     def test_experiment_detail_and_trials(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         _, _, body = get(f"{base}/api/experiments/ui-exp")
         detail = json.loads(body)
         assert detail["spec"]["algorithm"]["algorithmName"] == "random"
@@ -74,14 +74,14 @@ class TestUIServer:
         assert all("x" in t["assignments"] for t in trials)
 
     def test_trial_metrics(self, stack):
-        base, ctrl = stack
+        base, ctrl, token = stack
         trial = ctrl.state.list_trials("ui-exp")[0]
         _, _, body = get(f"{base}/api/trials/{trial.name}/metrics")
         logs = json.loads(body)
         assert logs and logs[0]["metric"] == "score"
 
     def test_events(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         _, _, body = get(f"{base}/api/experiments/ui-exp/events")
         events = json.loads(body)
         reasons = {e["reason"] for e in events}
@@ -90,7 +90,7 @@ class TestUIServer:
         assert any(e["kind"] == "Trial" and e["reason"] == "TrialSucceeded" for e in events)
 
     def test_prometheus_metrics(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         status, ctype, body = get(f"{base}/metrics")
         assert status == 200 and "text/plain" in ctype
         assert 'katib_experiment_created_total{experiment="ui-exp"} 1.0' in body
@@ -98,7 +98,7 @@ class TestUIServer:
         assert 'katib_experiment_succeeded_total{experiment="ui-exp"} 1.0' in body
 
     def test_dashboard_and_404(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         status, ctype, body = get(f"{base}/")
         assert status == 200 and "html" in ctype and "katib-tpu" in body
         import urllib.error
@@ -108,7 +108,7 @@ class TestUIServer:
         assert ei.value.code == 404
 
     def test_algorithms_endpoint(self, stack):
-        base, _ = stack
+        base, _, _ = stack
         _, _, body = get(f"{base}/api/algorithms")
         algos = json.loads(body)
         assert "tpe" in algos["suggestion"] and "medianstop" in algos["earlyStopping"]
@@ -144,7 +144,7 @@ class TestUIWriteEndpoints:
         then DELETE it."""
         import time
 
-        base, ctrl = stack
+        base, ctrl, token = stack
         spec_json = json.dumps({
             "name": "ui-posted",
             "parameters": [
@@ -163,7 +163,8 @@ class TestUIWriteEndpoints:
         })
         req = urllib.request.Request(
             f"{base}/api/experiments", data=spec_json.encode(), method="POST",
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {token}"},
         )
         with urllib.request.urlopen(req, timeout=10) as r:
             assert r.status == 201
@@ -178,7 +179,8 @@ class TestUIWriteEndpoints:
             raise AssertionError("posted experiment did not succeed in time")
 
         dreq = urllib.request.Request(
-            f"{base}/api/experiments/ui-posted", method="DELETE"
+            f"{base}/api/experiments/ui-posted", method="DELETE",
+            headers={"X-Katib-Token": token},
         )
         with urllib.request.urlopen(dreq, timeout=10) as r:
             assert json.loads(r.read())["deleted"] == "ui-posted"
@@ -186,9 +188,10 @@ class TestUIWriteEndpoints:
         assert status == 404
 
     def test_post_invalid_spec_rejected(self, stack):
-        base, ctrl = stack
+        base, ctrl, token = stack
         req = urllib.request.Request(
-            f"{base}/api/experiments", data=b'{"name": "bad"}', method="POST"
+            f"{base}/api/experiments", data=b'{"name": "bad"}', method="POST",
+            headers={"Authorization": f"Bearer {token}"},
         )
         try:
             urllib.request.urlopen(req, timeout=10)
@@ -197,7 +200,7 @@ class TestUIWriteEndpoints:
             assert e.code == 400
 
     def test_nas_graph_endpoint(self, stack):
-        base, ctrl = stack
+        base, ctrl, token = stack
         from katib_tpu.api.status import Trial
         from katib_tpu.api.spec import ParameterAssignment
 
@@ -228,3 +231,164 @@ def get_status(url):
             return r.status, r.headers.get("Content-Type", ""), r.read().decode()
     except urllib.error.HTTPError as e:
         return e.code, "", ""
+
+
+def request_status(url, method="POST", data=b"{}", headers=None):
+    import urllib.error
+
+    req = urllib.request.Request(url, data=data, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestUIWriteProtection:
+    """The write endpoints execute user-supplied commands — they must reject
+    unauthenticated and cross-origin requests (drive-by CSRF vector)."""
+
+    def test_post_without_token_rejected(self, stack):
+        base, _, _ = stack
+        code, body = request_status(f"{base}/api/experiments")
+        assert code == 403 and "token" in body
+
+    def test_delete_without_token_rejected(self, stack):
+        base, _, _ = stack
+        code, _ = request_status(f"{base}/api/experiments/ui-exp", method="DELETE", data=None)
+        assert code == 403
+
+    def test_wrong_token_rejected(self, stack):
+        base, _, _ = stack
+        code, _ = request_status(
+            f"{base}/api/experiments", headers={"Authorization": "Bearer wrong"}
+        )
+        assert code == 403
+
+    def test_cross_origin_write_rejected_even_with_token(self, stack):
+        base, _, token = stack
+        code, body = request_status(
+            f"{base}/api/experiments",
+            headers={"Authorization": f"Bearer {token}",
+                     "Origin": "http://evil.example"},
+        )
+        assert code == 403 and "cross-origin" in body
+
+    def test_same_origin_with_token_passes_authz(self, stack):
+        # reaches spec parsing (400 = past the auth gate)
+        base, _, token = stack
+        host = base[len("http://"):]
+        code, _ = request_status(
+            f"{base}/api/experiments",
+            data=b'{"name": "bad"}',
+            headers={"Authorization": f"Bearer {token}",
+                     "Origin": f"http://{host}"},
+        )
+        assert code == 400
+
+
+class TestTrialLogsAndTemplates:
+    def test_trial_logs_served_from_workdir(self, stack):
+        import time
+
+        base, ctrl, token = stack
+        spec_json = json.dumps({
+            "name": "ui-logs",
+            "parameters": [
+                {"name": "x", "parameterType": "double",
+                 "feasibleSpace": {"min": "0", "max": "1"}}
+            ],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "random"},
+            "trialTemplate": {
+                "command": ["python", "-c",
+                            "print('hello-from-trial'); print('score=${trialParameters.x}')"],
+                "trialParameters": [{"name": "x", "reference": "x"}],
+            },
+            "maxTrialCount": 1,
+            "parallelTrialCount": 1,
+        })
+        code, _ = request_status(
+            f"{base}/api/experiments", data=spec_json.encode(),
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        assert code == 201
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, _, body = get(f"{base}/api/experiments/ui-logs/trials")
+            trials = json.loads(body)
+            if trials and trials[0]["condition"] == "Succeeded":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("ui-logs experiment did not finish")
+        tname = trials[0]["name"]
+        status, ctype, body = get(f"{base}/api/experiments/ui-logs/trials/{tname}/logs")
+        assert status == 200 and "text/plain" in ctype
+        assert "hello-from-trial" in body
+        code, _, _ = get_status(f"{base}/api/experiments/ui-logs/trials/nonexistent/logs")
+        assert code == 404
+
+    def test_template_crud_and_ref_resolution(self, stack):
+        import time
+
+        base, ctrl, token = stack
+        headers = {"Authorization": f"Bearer {token}"}
+        template = {
+            "command": ["python", "-c", "print('score=${trialParameters.x}')"],
+            "trialParameters": [{"name": "x", "reference": "x"}],
+        }
+        code, body = request_status(
+            f"{base}/api/templates",
+            data=json.dumps({"name": "simple", "template": template}).encode(),
+            headers=headers,
+        )
+        assert code == 201 and json.loads(body)["saved"] == "simple"
+
+        _, _, body = get(f"{base}/api/templates")
+        assert "simple" in json.loads(body)
+        _, _, body = get(f"{base}/api/templates/simple")
+        assert json.loads(body)["command"][0] == "python"
+
+        # create an experiment by template reference
+        spec_json = json.dumps({
+            "name": "ui-tpl",
+            "parameters": [
+                {"name": "x", "parameterType": "double",
+                 "feasibleSpace": {"min": "0", "max": "1"}}
+            ],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "random"},
+            "trial_template_ref": "simple",
+            "maxTrialCount": 1,
+            "parallelTrialCount": 1,
+        })
+        code, _ = request_status(
+            f"{base}/api/experiments", data=spec_json.encode(), headers=headers
+        )
+        assert code == 201
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, _, body = get(f"{base}/api/experiments/ui-tpl")
+            if json.loads(body)["status"]["conditions"][-1]["type"] == "Succeeded":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("template-ref experiment did not succeed")
+
+        code, _ = request_status(
+            f"{base}/api/templates/simple", method="DELETE", data=None, headers=headers
+        )
+        assert code == 200
+        code, _, _ = get_status(f"{base}/api/templates/simple")
+        assert code == 404
+
+    def test_template_persistence_across_store_instances(self, stack, tmp_path):
+        from katib_tpu.db.state import ExperimentStateStore
+
+        store = ExperimentStateStore(str(tmp_path))
+        store.put_template("t1", {"command": ["echo", "hi"]})
+        again = ExperimentStateStore(str(tmp_path))
+        assert again.get_template("t1") == {"command": ["echo", "hi"]}
+        again.delete_template("t1")
+        assert ExperimentStateStore(str(tmp_path)).get_template("t1") is None
