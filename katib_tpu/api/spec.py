@@ -402,22 +402,37 @@ class SourceSpec:
 
 @dataclass
 class MetricsCollectorSpec:
-    """reference common_types.go:131-152."""
+    """reference common_types.go:131-152; ``custom_command`` carries the
+    Custom collector's user-supplied program (the reference's custom
+    container spec, common_types.go:205-227): it runs after the trial exits,
+    with KATIB_TRIAL_* env pointing at the trial workdir, and its stdout is
+    parsed like a File collector."""
 
     collector_kind: CollectorKind = CollectorKind.PUSH
     source: Optional[SourceSpec] = None
+    custom_command: Optional[List[str]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"collector": {"kind": self.collector_kind.value}}
+        if self.custom_command:
+            d["collector"]["customCollector"] = {"command": list(self.custom_command)}
         if self.source:
             d["source"] = self.source.to_dict()
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "MetricsCollectorSpec":
+        collector = d.get("collector", {})
+        custom = collector.get("customCollector") or {}
+        cmd = custom.get("command")
+        if cmd is not None and not isinstance(cmd, (list, tuple)):
+            raise ValueError(
+                f"customCollector.command must be a list of strings, got {type(cmd).__name__}"
+            )
         return cls(
-            collector_kind=CollectorKind(d.get("collector", {}).get("kind", "Push")),
+            collector_kind=CollectorKind(collector.get("kind", "Push")),
             source=SourceSpec.from_dict(d["source"]) if d.get("source") else None,
+            custom_command=list(cmd) if cmd else None,
         )
 
 

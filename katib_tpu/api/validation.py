@@ -255,6 +255,20 @@ def _validate_metrics_collector(spec: ExperimentSpec, errs: List[str]) -> None:
     if mc.collector_kind in (CollectorKind.FILE, CollectorKind.TF_EVENT):
         if mc.source is None or not mc.source.file_path:
             errs.append(f"metricsCollector kind {mc.collector_kind.value} requires source.filePath")
+    if mc.custom_command is not None:
+        if mc.collector_kind != CollectorKind.CUSTOM:
+            errs.append("customCollector.command requires collector kind Custom")
+        elif not (
+            isinstance(mc.custom_command, list)
+            and mc.custom_command
+            and all(isinstance(a, str) for a in mc.custom_command)
+        ):
+            errs.append("customCollector.command must be a non-empty list of strings")
+    elif mc.collector_kind == CollectorKind.CUSTOM:
+        # symmetric requirement (reference: a Custom collector must define its
+        # container, common_types.go:205-227) — otherwise the user's collector
+        # silently never runs and metrics come from the wrong source
+        errs.append("collector kind Custom requires customCollector.command")
     if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.filter:
         for f in mc.source.filter.metrics_format:
             try:

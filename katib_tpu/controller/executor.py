@@ -380,6 +380,55 @@ class SubprocessExecutor:
                 pass
             proc.wait(timeout=5)
 
+    CUSTOM_COLLECTOR_TIMEOUT = 60.0
+
+    def _run_custom_collector(
+        self,
+        trial: Trial,
+        stdout_path: str,
+        metrics_file: Optional[str],
+        spec: ExperimentSpec,
+    ) -> None:
+        mc = spec.metrics_collector_spec
+        workdir = os.path.dirname(stdout_path)
+        env = dict(os.environ)
+        env[ENV_TRIAL_NAME] = trial.name
+        env["KATIB_TRIAL_WORKDIR"] = workdir
+        env["KATIB_TRIAL_STDOUT"] = stdout_path
+        if metrics_file:
+            env[ENV_METRICS_FILE] = metrics_file
+        try:
+            proc = subprocess.run(
+                list(mc.custom_command),
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=workdir,
+                timeout=self.CUSTOM_COLLECTOR_TIMEOUT,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            return  # collector failure -> metrics unavailable classification
+        if proc.returncode != 0:
+            return
+        self._parse_and_report(trial, proc.stdout.splitlines(), spec)
+
+    def _parse_and_report(
+        self, trial: Trial, lines: List[str], spec: ExperimentSpec
+    ) -> None:
+        """Shared metric-line parsing tail for File/StdOut/Custom collection."""
+        mc = spec.metrics_collector_spec
+        names = spec.objective.all_metric_names()
+        filters = None
+        if mc.source and mc.source.filter:
+            filters = mc.source.filter.metrics_format
+        base = trial.start_time or time.time()
+        if mc.source and mc.source.file_format == "JSON":
+            logs = parse_json_lines(lines, names, base_time=base)
+        else:
+            logs = parse_text_lines(lines, names, filters, base_time=base)
+        if logs:
+            self.obs_store.report_observation_log(trial.name, logs)
+
     def _drain_pushed(self, trial: Trial) -> None:
         from ..db.store import SqliteObservationStore
 
@@ -410,6 +459,12 @@ class SubprocessExecutor:
         kind = mc.collector_kind
         if kind in (CollectorKind.NONE, CollectorKind.PUSH, CollectorKind.PROMETHEUS):
             return  # pushed directly, scraped during _wait, or reports nothing
+        if kind == CollectorKind.CUSTOM and mc.custom_command:
+            # user-supplied collector program (reference custom collector
+            # container, common_types.go:205-227): runs after trial exit with
+            # env pointing at the trial workdir; stdout parsed like File
+            self._run_custom_collector(trial, stdout_path, metrics_file, spec)
+            return
         if kind == CollectorKind.TF_EVENT:
             from ..runtime.tfevent import collect_tfevent_metrics
 
@@ -428,14 +483,4 @@ class SubprocessExecutor:
             return
         with open(path, "r", errors="replace") as f:
             lines = f.read().splitlines()
-        names = spec.objective.all_metric_names()
-        filters = None
-        if mc.source and mc.source.filter:
-            filters = mc.source.filter.metrics_format
-        base = trial.start_time or time.time()
-        if mc.source and mc.source.file_format == "JSON":
-            logs = parse_json_lines(lines, names, base_time=base)
-        else:
-            logs = parse_text_lines(lines, names, filters, base_time=base)
-        if logs:
-            self.obs_store.report_observation_log(trial.name, logs)
+        self._parse_and_report(trial, lines, spec)

@@ -39,8 +39,11 @@ def initialize_distributed(
     """
     import jax
 
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOTE: must not touch jax.process_count()/jax.devices() here — those
+    # initialize the XLA backend, after which jax.distributed.initialize()
+    # refuses to run. is_initialized() inspects only the distributed client.
+    if jax.distributed.is_initialized():
+        return
     addr = coordinator_address or os.environ.get("KATIB_TPU_COORDINATOR")
     nproc = num_processes or int(os.environ.get("KATIB_TPU_NUM_PROCESSES", "0"))
     pid = process_id if process_id is not None else int(os.environ.get("KATIB_TPU_PROCESS_ID", "0"))

@@ -139,11 +139,16 @@ def run_lm_trial(assignments: Dict[str, str], ctx=None) -> None:
 
     rng = np.random.default_rng(0)
     data = rng.integers(0, vocab, size=(batch, seq_len + 1), dtype=np.int32)
-    for i in range(steps):
-        tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
-        params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
-        if ctx is not None and (i + 1) % 5 == 0:
-            ctx.report(loss=float(loss))
+    profile = ctx is not None and assignments.get("profile", "0") == "1"
+    import contextlib
+
+    prof_cm = ctx.profile() if profile else contextlib.nullcontext()
+    with prof_cm:
+        for i in range(steps):
+            tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
+            if ctx is not None and (i + 1) % 5 == 0:
+                ctx.report(loss=float(loss))
     if ctx is not None:
         if steps % 5 != 0:  # final value not yet reported by the loop
             ctx.report(loss=float(loss))

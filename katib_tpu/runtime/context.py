@@ -32,6 +32,15 @@ class TrialContext:
         early-stopping rules have tripped."""
         self.reporter.report(**metrics)
 
+    def profile(self, enabled: bool = True):
+        """Context manager: capture a JAX profiler (xplane) trace of the
+        enclosed steps into ``<workdir>/profile`` — surfaced by the UI at
+        ``/api/experiments/<e>/trials/<t>/profile``. No-op without a workdir
+        so trial code can call it unconditionally (SURVEY.md §5)."""
+        from .profiling import profile_trace
+
+        return profile_trace(self.workdir, enabled=enabled)
+
     def jax_devices(self):
         """The trial's allocated devices that are real jax.Device objects.
 

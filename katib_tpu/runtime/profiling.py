@@ -1,0 +1,68 @@
+"""Per-trial JAX profiler capture — xplane traces into the trial workdir.
+
+SURVEY.md §5 designates profiler traces as the first-class TPU observability
+improvement over the reference's logs+Prometheus ceiling (the reference has
+no per-trial profiling at all). Trial code opts in via
+``ctx.profile():`` around its hot steps; the xplane protobufs land in
+``<workdir>/profile`` and are listed by the UI
+(``GET /api/experiments/<e>/trials/<t>/profile``). Any TensorBoard /
+xprof install can open the dump.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, List, Optional
+
+PROFILE_DIRNAME = "profile"
+ENV_PROFILE = "KATIB_TPU_PROFILE"  # "1" on trial subprocesses when requested
+
+
+@contextlib.contextmanager
+def profile_trace(workdir: Optional[str], enabled: bool = True) -> Iterator[Optional[str]]:
+    """Trace JAX execution into ``<workdir>/profile``; no-op without a
+    workdir or when disabled (so trial code can call it unconditionally).
+    Yields the trace directory (or None when inactive)."""
+    if not workdir or not enabled:
+        yield None
+        return
+    trace_dir = os.path.join(workdir, PROFILE_DIRNAME)
+    os.makedirs(trace_dir, exist_ok=True)
+    import jax
+
+    # Guard only trace start/stop, NEVER the body: wrapping the yield in a
+    # try/except would swallow EarlyStopped/TrialKilled raised inside the
+    # profiled block and misclassify the trial. (Trace start can fail e.g.
+    # when a second concurrent trace exists in the process.)
+    trace_cm = jax.profiler.trace(trace_dir)
+    try:
+        trace_cm.__enter__()
+    except Exception:
+        trace_cm = None
+    try:
+        yield trace_dir
+    finally:
+        if trace_cm is not None:
+            try:
+                trace_cm.__exit__(None, None, None)
+            except Exception:
+                pass
+
+
+def list_profile_artifacts(workdir: Optional[str]) -> List[dict]:
+    """Relative paths + sizes of captured trace files under the workdir."""
+    out: List[dict] = []
+    if not workdir:
+        return out
+    root = os.path.join(workdir, PROFILE_DIRNAME)
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            out.append(
+                {
+                    "path": os.path.relpath(p, root),
+                    "bytes": os.path.getsize(p),
+                }
+            )
+    return out

@@ -10,6 +10,7 @@ same information surface:
   GET /api/experiments/<name>                   full spec+status
   GET /api/experiments/<name>/trials            fetch_hp_job_info view
   GET /api/experiments/<name>/trials/<t>/logs   trial stdout (fetch_trial_logs)
+  GET /api/experiments/<name>/trials/<t>/profile  xplane profiler artifacts
   GET /api/experiments/<name>/events            event stream (K8s Events parity)
   GET /api/experiments/<name>/suggestion        suggestion state
   GET /api/trials/<name>/metrics                raw observation log
@@ -248,6 +249,8 @@ class _Handler(BaseHTTPRequestHandler):
                 sub = parts[4]
                 if sub == "trials" and len(parts) == 7 and parts[6] == "logs":
                     return self._trial_logs(name, parts[5])
+                if sub == "trials" and len(parts) == 7 and parts[6] == "profile":
+                    return self._trial_profile(name, parts[5])
                 if sub == "trials":
                     out = []
                     for t in ctrl.state.list_trials(name):
@@ -291,18 +294,31 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # pragma: no cover - defensive
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
 
+    def _trial_workdir(self, exp_name: str, trial_name: str):
+        """Validated trial workdir path, or an (payload, code) error tuple.
+        Names are controller-generated, but never trust path joins."""
+        import os
+
+        root = getattr(self.controller.scheduler, "workdir_root", None)
+        if not root:
+            return None, ({"error": "no trial workdir root configured"}, 404)
+        bad = any(
+            "/" in n or "\\" in n or "\x00" in n or ".." in n or not n
+            for n in (exp_name, trial_name)
+        )
+        if bad:
+            return None, ({"error": "invalid name"}, 400)
+        return os.path.join(root, exp_name, trial_name), None
+
     def _trial_logs(self, exp_name: str, trial_name: str) -> None:
         """Serve the trial workdir's stdout.log (reference fetch_trial_logs,
         cmd/ui/v1beta1/main.go + pod-log fetch)."""
         import os
 
-        root = getattr(self.controller.scheduler, "workdir_root", None)
-        if not root:
-            return self._send({"error": "no trial workdir root configured"}, code=404)
-        # trial names are controller-generated, but never trust path joins
-        if "/" in trial_name or "/" in exp_name or ".." in trial_name or ".." in exp_name:
-            return self._send({"error": "invalid name"}, code=400)
-        path = os.path.join(root, exp_name, trial_name, "stdout.log")
+        workdir, err = self._trial_workdir(exp_name, trial_name)
+        if err:
+            return self._send(err[0], code=err[1])
+        path = os.path.join(workdir, "stdout.log")
         if not os.path.exists(path):
             return self._send({"error": "no logs for this trial"}, code=404)
         tail_limit = 1 << 20  # serve at most the last 1 MiB
@@ -316,6 +332,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _trial_profile(self, exp_name: str, trial_name: str) -> None:
+        """List captured xplane profiler artifacts for a trial (SURVEY §5
+        profiling — no reference counterpart)."""
+        from ..runtime.profiling import list_profile_artifacts
+
+        workdir, err = self._trial_workdir(exp_name, trial_name)
+        if err:
+            return self._send(err[0], code=err[1])
+        return self._send(
+            {"trial": trial_name, "artifacts": list_profile_artifacts(workdir)}
+        )
 
     def do_POST(self) -> None:  # noqa: N802
         ctrl = self.controller

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Fetch CIFAR-10 into the npz format ``katib_tpu.utils.datasets`` reads.
+
+The DARTS north-star comparison (BASELINE.json) requires *best-trial
+val-accuracy parity on real CIFAR-10*; without this file the data loader
+silently falls back to synthetic sinusoids, which makes the accuracy half of
+the baseline unfalsifiable. Run this once on a machine with network access:
+
+    python scripts/fetch_cifar10.py [--out PATH]
+
+then point trials at it:
+
+    export KATIB_TPU_CIFAR10=~/.cache/katib_tpu/cifar10.npz
+
+Stdlib-only (urllib + tarfile + pickle of the official batches); also
+accepts a pre-downloaded ``cifar-10-python.tar.gz`` via --tar.
+"""
+
+import argparse
+import os
+import pickle
+import sys
+import tarfile
+import tempfile
+import urllib.request
+
+import numpy as np
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+DEFAULT_OUT = os.path.join(
+    os.path.expanduser("~"), ".cache", "katib_tpu", "cifar10.npz"
+)
+
+
+def _load_batch(tf: tarfile.TarFile, name: str):
+    member = tf.extractfile(f"cifar-10-batches-py/{name}")
+    assert member is not None, f"missing member {name}"
+    batch = pickle.load(member, encoding="bytes")
+    x = batch[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+    y = np.asarray(batch[b"labels"], dtype=np.int32)
+    return x, y
+
+
+def convert(tar_path: str, out_path: str) -> None:
+    with tarfile.open(tar_path, "r:gz") as tf:
+        xs, ys = [], []
+        for i in range(1, 6):
+            x, y = _load_batch(tf, f"data_batch_{i}")
+            xs.append(x)
+            ys.append(y)
+        x_train = np.concatenate(xs)
+        y_train = np.concatenate(ys)
+        x_test, y_test = _load_batch(tf, "test_batch")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    np.savez_compressed(
+        out_path,
+        x_train=x_train, y_train=y_train, x_test=x_test, y_test=y_test,
+    )
+    print(f"wrote {out_path}: train {x_train.shape}, test {x_test.shape}")
+    print(f"export KATIB_TPU_CIFAR10={out_path}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.environ.get("KATIB_TPU_CIFAR10", DEFAULT_OUT))
+    ap.add_argument("--tar", help="pre-downloaded cifar-10-python.tar.gz")
+    args = ap.parse_args()
+
+    if args.tar:
+        convert(args.tar, args.out)
+        return 0
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".tar.gz", delete=False) as tmp:
+            print(f"downloading {URL} ...")
+            with urllib.request.urlopen(URL, timeout=120) as resp:
+                while chunk := resp.read(1 << 20):
+                    tmp.write(chunk)
+            tar_path = tmp.name
+    except OSError as e:
+        print(
+            f"download failed ({e}); on an air-gapped machine, copy "
+            "cifar-10-python.tar.gz over and re-run with --tar",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        convert(tar_path, args.out)
+    finally:
+        os.unlink(tar_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

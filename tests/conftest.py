@@ -1,19 +1,28 @@
 """Test configuration: force JAX onto 8 virtual CPU devices so multi-chip
-sharding paths (Mesh/pjit/shard_map) are exercised without TPU hardware."""
+sharding paths (Mesh/pjit/shard_map) are exercised without TPU hardware.
+
+Set ``KATIB_TPU_TEST_TPU=1`` to skip the CPU forcing and run against the
+real accelerator instead — this opens the hardware-gated tests in
+``test_tpu_hardware.py`` (everything else still passes; meshes built from
+``jax.devices()`` just see the real topology).
+"""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# The axon sitecustomize registers a TPU backend at interpreter start and
-# forces jax_platforms to it; tests must run on the virtual CPU mesh for
-# determinism and an 8-device sharding topology, so force it back before any
-# backend initializes.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if os.environ.get("KATIB_TPU_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The axon sitecustomize registers a TPU backend at interpreter start and
+    # forces jax_platforms to it; tests must run on the virtual CPU mesh for
+    # determinism and an 8-device sharding topology, so force it back before
+    # any backend initializes.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import jax
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,227 @@
+"""Custom metrics-collector kind (reference common_types.go:205-227) and
+per-trial profiler capture (SURVEY.md §5) — VERDICT round-1 items 8 and 9."""
+
+import json
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    MetricsCollectorSpec,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.api.spec import CollectorKind
+from katib_tpu.api.status import TrialCondition
+from katib_tpu.controller.experiment import ExperimentController
+
+
+def _spec(name, collector, template):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=template,
+        metrics_collector_spec=collector,
+        max_trial_count=1,
+        parallel_trial_count=1,
+    )
+
+
+class TestCustomCollector:
+    def test_custom_command_collects_metrics(self, tmp_path):
+        """The trial writes a private artifact; the user-supplied collector
+        program turns it into metrics on ITS stdout after trial exit."""
+        collector = MetricsCollectorSpec(
+            collector_kind=CollectorKind.CUSTOM,
+            custom_command=[
+                "python", "-c",
+                "import os; print(open(os.path.join("
+                "os.environ['KATIB_TRIAL_WORKDIR'], 'result.txt')).read())",
+            ],
+        )
+        from katib_tpu.api import TrialParameterSpec
+
+        template = TrialTemplate(
+            command=[
+                "python", "-c",
+                "import os; open(os.path.join(os.getcwd(), 'result.txt'), 'w')"
+                ".write('score=${trialParameters.x}')",
+            ],
+            trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+        )
+        # the trial's cwd is its workdir (no working_dir override)
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            c.create_experiment(_spec("custom-col", collector, template))
+            exp = c.run("custom-col", timeout=60)
+            trials = c.state.list_trials("custom-col")
+            assert trials[0].condition == TrialCondition.SUCCEEDED
+            m = trials[0].observation.metric("score")
+            assert m is not None and float(m.latest) >= 0.0
+        finally:
+            c.close()
+
+    def test_custom_without_command_is_rejected(self, tmp_path):
+        """Kind Custom without a collector program would silently parse the
+        wrong source — it must fail validation (reference requires the
+        custom container to be defined, common_types.go:205-227)."""
+        from katib_tpu.api.validation import ValidationError
+
+        collector = MetricsCollectorSpec(collector_kind=CollectorKind.CUSTOM)
+        template = TrialTemplate(
+            command=["python", "-c", "print('score=0.5')"], trial_parameters=[]
+        )
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            with pytest.raises(ValidationError, match="customCollector.command"):
+                c.create_experiment(_spec("custom-fb", collector, template))
+        finally:
+            c.close()
+
+    def test_string_command_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="list of strings"):
+            MetricsCollectorSpec.from_dict(
+                {"collector": {"kind": "Custom",
+                               "customCollector": {"command": "collect.sh"}}}
+            )
+
+    def test_failing_collector_yields_metrics_unavailable(self, tmp_path):
+        collector = MetricsCollectorSpec(
+            collector_kind=CollectorKind.CUSTOM,
+            custom_command=["python", "-c", "raise SystemExit(3)"],
+        )
+        template = TrialTemplate(command=["python", "-c", "print('ok')"], trial_parameters=[])
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            c.create_experiment(_spec("custom-bad", collector, template))
+            c.run("custom-bad", timeout=60)
+            t = c.state.list_trials("custom-bad")[0]
+            assert t.condition == TrialCondition.METRICS_UNAVAILABLE
+        finally:
+            c.close()
+
+    def test_spec_roundtrip_and_validation(self):
+        mc = MetricsCollectorSpec(
+            collector_kind=CollectorKind.CUSTOM, custom_command=["echo", "hi"]
+        )
+        again = MetricsCollectorSpec.from_dict(mc.to_dict())
+        assert again.custom_command == ["echo", "hi"]
+        assert again.collector_kind == CollectorKind.CUSTOM
+
+        from katib_tpu.api.validation import ValidationError, validate_experiment
+
+        spec = _spec(
+            "bad-custom",
+            MetricsCollectorSpec(
+                collector_kind=CollectorKind.STDOUT, custom_command=["echo"]
+            ),
+            TrialTemplate(command=["true"], trial_parameters=[]),
+        )
+        with pytest.raises(ValidationError, match="kind Custom"):
+            validate_experiment(spec)
+
+
+class TestProfiler:
+    def test_in_process_trial_captures_xplane_trace(self, tmp_path):
+        import jax.numpy as jnp
+
+        def trial_fn(assignments, ctx):
+            with ctx.profile():
+                x = jnp.ones((8, 8))
+                (x @ x).block_until_ready()
+            ctx.report(score=1.0)
+
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            spec = _spec(
+                "prof", MetricsCollectorSpec(), TrialTemplate(function=trial_fn)
+            )
+            c.create_experiment(spec)
+            c.run("prof", timeout=60)
+            t = c.state.list_trials("prof")[0]
+            assert t.condition == TrialCondition.SUCCEEDED
+            workdir = os.path.join(str(tmp_path), "trials", "prof", t.name)
+            from katib_tpu.runtime.profiling import list_profile_artifacts
+
+            artifacts = list_profile_artifacts(workdir)
+            assert artifacts, "no profiler artifacts captured"
+            assert any(a["path"].endswith(".xplane.pb") for a in artifacts)
+        finally:
+            c.close()
+
+    def test_profile_noop_without_workdir(self):
+        from katib_tpu.runtime.profiling import profile_trace
+
+        with profile_trace(None) as d:
+            assert d is None
+
+    def test_exception_inside_profiled_block_propagates(self, tmp_path):
+        """EarlyStopped raised inside ctx.profile() must escape unchanged so
+        the executor classifies the trial EARLY_STOPPED, not FAILED."""
+        from katib_tpu.runtime.metrics import EarlyStopped
+        from katib_tpu.runtime.profiling import profile_trace
+
+        with pytest.raises(EarlyStopped):
+            with profile_trace(str(tmp_path)):
+                raise EarlyStopped("rule tripped")
+
+
+class TestCifarFetchScript:
+    def test_convert_from_local_tar(self, tmp_path):
+        """Offline conversion path: build a mini cifar-10-python.tar.gz with
+        the official member layout and check the npz comes out right."""
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+        try:
+            import fetch_cifar10
+        finally:
+            sys.path.pop(0)
+
+        rng = np.random.default_rng(0)
+        tar_path = tmp_path / "cifar-10-python.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + [
+                ("test_batch", 10)
+            ]:
+                payload = pickle.dumps(
+                    {
+                        b"data": rng.integers(0, 256, size=(n, 3072), dtype=np.uint8),
+                        b"labels": list(rng.integers(0, 10, size=n)),
+                    }
+                )
+                import io
+
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+
+        out = tmp_path / "cifar10.npz"
+        fetch_cifar10.convert(str(tar_path), str(out))
+        data = np.load(out)
+        assert data["x_train"].shape == (100, 32, 32, 3)
+        assert data["x_test"].shape == (10, 32, 32, 3)
+        assert data["y_train"].dtype == np.int32
+
+        # and the dataset loader accepts it
+        os.environ["KATIB_TPU_CIFAR10"] = str(out)
+        try:
+            from katib_tpu.utils.datasets import load_cifar10
+
+            x, y = load_cifar10("train", n=16)
+            assert x.shape == (16, 32, 32, 3) and x.dtype == np.float32
+        finally:
+            os.environ.pop("KATIB_TPU_CIFAR10", None)

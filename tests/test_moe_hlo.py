@@ -1,0 +1,55 @@
+"""Expert-parallelism must actually shard: the MoE dispatch path has to
+lower to an XLA all-to-all over the 'expert' mesh axis (VERDICT round-1
+item 4 — previously asserted via with_sharding_constraint but never
+verified against compiled HLO)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.models.transformer import TransformerConfig
+from katib_tpu.parallel.mesh import make_mesh
+from katib_tpu.parallel.train import make_lm_train_step
+
+
+def _compiled_text(expert: int, data: int, fsdp: int, num_experts: int) -> str:
+    mesh = make_mesh(jax.devices(), expert=expert, data=data, fsdp=fsdp)
+    config = TransformerConfig(
+        vocab_size=128, embed_dim=64, num_layers=1, num_heads=4,
+        max_seq_len=32, dtype=jnp.float32, num_experts=num_experts,
+    )
+    params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, 1e-3)
+    rng = np.random.default_rng(0)
+    d = rng.integers(0, 128, size=(8, 33), dtype=np.int32)
+    tokens, targets, positions = put_batch(d[:, :-1], d[:, 1:])
+    return step_fn.lower(params, opt_state, tokens, targets, positions).compile().as_text()
+
+
+class TestMoeAllToAll:
+    def test_expert_sharded_step_contains_all_to_all(self):
+        txt = _compiled_text(expert=2, data=2, fsdp=2, num_experts=4)
+        assert "all-to-all" in txt, "MoE dispatch did not lower to an all-to-all"
+        # the token shuffle must target the expert axis: at least one
+        # all-to-all with >1 replica groups over the 2-way expert dim
+        a2a_lines = [l for l in txt.splitlines() if "all-to-all" in l and "replica_groups" in l]
+        assert a2a_lines, "no all-to-all instructions with replica groups"
+
+    def test_dispatch_buffer_not_fully_replicated(self):
+        """The [B, X, C, E] dispatch einsum output must be partitioned:
+        a fully replicated dispatch would make EP a no-op memory blow-up."""
+        txt = _compiled_text(expert=2, data=2, fsdp=2, num_experts=4)
+        # B=8/4 per batch shard, X=4 experts /2, C=capacity 16, E=64: a fully
+        # replicated dispatch buffer would appear as f32[8,4,16,64] operands
+        # to the expert matmuls; the partitioned one is f32[2,2,16,64]
+        assert re.search(r"f32\[2,2,16,64\]", txt), (
+            "expected the expert-partitioned [B/dp, X/ep, C, E] dispatch "
+            "buffer shape in compiled HLO"
+        )
+        assert not re.search(r"f32\[8,4,16,64\]\S* (dot|fusion)", txt)
+
+    # NOTE: no "dense model has no all-to-all" negative test — XLA freely
+    # uses all-to-all for dp/fsdp reshards too, so absence isn't guaranteed;
+    # the positive evidence is the partitioned dispatch-buffer shape above.
