@@ -173,6 +173,85 @@ def _bench_lm(jax, np, on_tpu: bool):
     }
 
 
+def _bench_e2e_experiment(jax, np, on_tpu: bool):
+    """The north-star experiment THROUGH the framework: a DARTS NAS
+    experiment driven by ExperimentController.run() (suggestion protocol,
+    collectors, scheduler — not just the bare step), verified against the
+    reference's e2e invariants, wall-clock recorded. Bounded by the parent's
+    child deadline (BENCH_CHILD_DEADLINE) so an overrun degrades to an error
+    entry instead of killing the whole child and its primary metrics."""
+    import shutil
+    import tempfile
+
+    from katib_tpu.api import (
+        AlgorithmSpec, ExperimentSpec, GraphConfig, NasConfig, NasOperation,
+        ObjectiveSpec, ObjectiveType, TrialTemplate,
+    )
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.utils.e2e_verify import verify_experiment_results
+
+    run_timeout = 2400.0
+    deadline = os.environ.get("BENCH_CHILD_DEADLINE")
+    if deadline:
+        run_timeout = float(deadline) - time.time() - 30.0  # kill margin
+        if run_timeout < 60.0:
+            return {"skipped": f"only {run_timeout:.0f}s left in child budget"}
+
+    if on_tpu:
+        scale = dict(num_epochs=1, num_train_examples=4096, batch_size=128,
+                     init_channels=1, num_nodes=1, stem_multiplier=1)
+    else:
+        scale = dict(num_epochs=1, num_train_examples=128, batch_size=32,
+                     init_channels=1, num_nodes=1, stem_multiplier=1)
+
+    def darts_trial(assignments, ctx):
+        from katib_tpu.models.darts_trainer import run_darts_trial_scaled
+
+        run_darts_trial_scaled(assignments, ctx, **scale)
+
+    root = tempfile.mkdtemp(prefix="bench-e2e-")
+    ctrl = ExperimentController(root_dir=root)
+    try:
+        spec = ExperimentSpec(
+            name="bench-darts-e2e",
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="Validation-accuracy",
+            ),
+            algorithm=AlgorithmSpec("darts"),
+            nas_config=NasConfig(
+                graph_config=GraphConfig(
+                    num_layers=3 if on_tpu else 2,
+                    input_sizes=[32, 32, 3], output_sizes=[10],
+                ),
+                operations=[
+                    NasOperation("separable_convolution"),
+                    NasOperation("max_pooling"),
+                    NasOperation("skip_connection"),
+                ],
+            ),
+            trial_template=TrialTemplate(function=darts_trial),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        t0 = time.time()
+        exp = ctrl.run("bench-darts-e2e", timeout=run_timeout)
+        wallclock = time.time() - t0
+        verify_experiment_results(ctrl, exp)
+        acc = exp.status.current_optimal_trial.observation.metric(
+            "Validation-accuracy"
+        )
+        return {
+            "wallclock_s": round(wallclock, 2),
+            "verified": True,
+            "best_val_acc": float(acc.max),
+        }
+    finally:
+        ctrl.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_flash_vs_dense(jax, np):
     """TPU-only: fused Pallas flash kernel vs plain XLA dense attention."""
     import jax.numpy as jnp
@@ -226,6 +305,12 @@ def child_main(platform: str) -> None:
     darts = _bench_darts(jax, np, on_tpu)
     lm = _bench_lm(jax, np, on_tpu)
     flash = _bench_flash_vs_dense(jax, np) if on_tpu else None
+    e2e = None
+    if os.environ.get("BENCH_SKIP_E2E") != "1":
+        try:
+            e2e = _bench_e2e_experiment(jax, np, on_tpu)
+        except Exception as e:  # keep the primary metric even if e2e breaks
+            e2e = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     projected = darts["projected_s"]
     extras = {
@@ -238,6 +323,8 @@ def child_main(platform: str) -> None:
         "lm_config": f"params={lm['n_params']}, b={lm['batch']}, T={lm['seq_len']}",
         "mfu": lm["mfu"],
     }
+    if e2e is not None:
+        extras["e2e_experiment"] = e2e
     if flash is not None:
         extras["flash_attention"] = {
             "flash_ms": round(flash["flash_ms"], 3),
@@ -264,12 +351,15 @@ def child_main(platform: str) -> None:
 
 def _run_child(platform: str, timeout_s: float):
     """Returns (parsed_json | None, diagnostic_str | None)."""
+    env = dict(os.environ)
+    env["BENCH_CHILD_DEADLINE"] = str(time.time() + timeout_s)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
             capture_output=True,
             text=True,
             timeout=timeout_s,
+            env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:

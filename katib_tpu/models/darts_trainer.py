@@ -281,6 +281,17 @@ class DartsSearch:
         return genotype(params, self.primitives, self.num_nodes)
 
 
+def run_darts_trial_scaled(assignments: Dict[str, str], ctx=None, **overrides) -> None:
+    """run_darts_trial with algorithm-settings overrides merged in — the
+    single place that re-encodes the suggester's settings payload (used by
+    CI-scale tests and the bench e2e stage)."""
+    settings = json.loads(assignments["algorithm-settings"].replace("'", '"'))
+    settings.update(overrides)
+    assignments = dict(assignments)
+    assignments["algorithm-settings"] = json.dumps(settings)
+    run_darts_trial(assignments, ctx)
+
+
 def run_darts_trial(assignments: Dict[str, str], ctx=None) -> None:
     """Trial entry point — parses the DARTS suggestion assignments
     (run_trial.py main argument parsing) and runs the search, reporting

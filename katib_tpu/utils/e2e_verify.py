@@ -1,0 +1,89 @@
+"""End-to-end experiment result verification — the reference's e2e checker
+re-expressed against katib-tpu types.
+
+reference test/e2e/v1beta1/scripts/gh-actions/run-e2e-experiment.py:17-120:
+- the optimal trial must carry the objective metric;
+- Succeeded(MaxTrialsReached) => succeeded + early-stopped == maxTrialCount;
+- Succeeded(GoalReached) => the best metric actually satisfies the goal;
+- suggestion lifecycle honors the resume policy: LongRunning keeps the
+  algorithm instance alive for budget-raise restarts, Never/FromVolume tear
+  it down (the reference deletes the suggestion Deployment/Service; here the
+  in-memory suggester is dropped, FromVolume keeping its on-disk state).
+
+Used by tests AND by the bench harness's e2e stage, so the driver's bench
+run doubles as an invariant check on real hardware.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api.spec import ObjectiveType, ResumePolicy
+from ..api.status import Experiment, ExperimentReason
+
+
+class E2EVerificationError(AssertionError):
+    pass
+
+
+def verify_experiment_results(ctrl, exp: Experiment) -> None:
+    """Raise E2EVerificationError on any violated invariant."""
+    errs: List[str] = []
+    spec = exp.spec
+    status = exp.status
+
+    if not status.is_completed:
+        errs.append(f"experiment not completed: {status.condition}")
+
+    # 1. optimal trial must exist and carry the objective metric
+    optimal = status.current_optimal_trial
+    best_metric = None
+    if optimal is None or optimal.observation is None:
+        errs.append("no current_optimal_trial with an observation")
+    else:
+        best_metric = optimal.observation.metric(spec.objective.objective_metric_name)
+        if best_metric is None:
+            errs.append(
+                f"optimal trial lacks objective metric "
+                f"{spec.objective.objective_metric_name!r}"
+            )
+
+    # 2. MaxTrialsReached => all budgeted trials completed
+    if status.reason == ExperimentReason.MAX_TRIALS_REACHED:
+        completed = status.trials_succeeded + status.trials_early_stopped
+        if spec.max_trial_count is not None and completed != spec.max_trial_count:
+            errs.append(
+                f"MaxTrialsReached but completed {completed} != "
+                f"maxTrialCount {spec.max_trial_count}"
+            )
+
+    # 3. GoalReached => the metric must actually satisfy the goal
+    if (
+        status.reason == ExperimentReason.GOAL_REACHED
+        and spec.objective.goal is not None
+        and best_metric is not None
+    ):
+        goal = float(spec.objective.goal)
+        if spec.objective.type == ObjectiveType.MINIMIZE:
+            if float(best_metric.min) > goal:
+                errs.append(
+                    f"GoalReached but best min {best_metric.min} > goal {goal}"
+                )
+        elif float(best_metric.max) < goal:
+            errs.append(f"GoalReached but best max {best_metric.max} < goal {goal}")
+
+    # 4. suggestion lifecycle per resume policy
+    alive = exp.name in ctrl.suggestions._suggesters
+    if spec.resume_policy == ResumePolicy.LONG_RUNNING and not alive:
+        errs.append("LongRunning resume policy but suggester was torn down")
+    if spec.resume_policy in (ResumePolicy.NEVER, ResumePolicy.FROM_VOLUME) and alive:
+        errs.append(
+            f"{spec.resume_policy.value} resume policy but suggester still alive"
+        )
+    if spec.resume_policy == ResumePolicy.FROM_VOLUME:
+        # on-disk state must survive teardown for a later FromVolume restore
+        if ctrl.state.root and ctrl.state.get_suggestion(exp.name) is None:
+            errs.append("FromVolume: no persisted suggestion state after completion")
+
+    if errs:
+        raise E2EVerificationError("; ".join(errs))

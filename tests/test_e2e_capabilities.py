@@ -41,16 +41,13 @@ def controller(tmp_path):
 
 
 def _tiny_darts(assignments, ctx):
-    from katib_tpu.models.darts_trainer import run_darts_trial
+    from katib_tpu.models.darts_trainer import run_darts_trial_scaled
 
-    settings = json.loads(assignments["algorithm-settings"].replace("'", '"'))
-    settings.update(
+    run_darts_trial_scaled(
+        assignments, ctx,
         num_epochs=1, num_train_examples=64, batch_size=16, init_channels=2,
         num_nodes=2, stem_multiplier=1,
     )
-    assignments = dict(assignments)
-    assignments["algorithm-settings"] = json.dumps(settings)
-    run_darts_trial(assignments, ctx)
 
 
 def test_darts_e2e(controller):
@@ -78,6 +75,10 @@ def test_darts_e2e(controller):
     opt = exp.status.current_optimal_trial
     acc = float(opt.observation.metric("Validation-accuracy").max)
     assert 0.0 <= acc <= 1.0
+    # reference e2e invariants (run-e2e-experiment.py:17-120)
+    from katib_tpu.utils.e2e_verify import verify_experiment_results
+
+    verify_experiment_results(controller, exp)
 
 
 def _tiny_enas(assignments, ctx):
