@@ -37,6 +37,12 @@ NEG_INF = -1e30
 _LANES = 128  # TPU lane width; scratch vectors are padded to this
 
 
+def _dot_precision(dtype):
+    """f32 blocks need HIGHEST precision or the MXU's bf16 decomposition
+    drops ~3 decimal digits; bf16 blocks run at native MXU rate regardless."""
+    return jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None
+
+
 def _on_tpu() -> bool:
     try:
         d = jax.devices()[0]
@@ -68,12 +74,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)           # [bq, d]
-        k = k_ref[0].astype(jnp.float32)           # [bk, d]
-        v = v_ref[0].astype(jnp.float32)           # [bk, d]
+        # dots stay in the input dtype (MXU does bf16 x bf16 -> f32 natively;
+        # casting blocks to f32 first runs the MXU at the much slower f32
+        # rate) — only the softmax recurrence is f32. f32 inputs request
+        # HIGHEST precision so the MXU's bf16 decomposition keeps f32 fidelity.
+        q = q_ref[0]                                # [bq, d]
+        k = k_ref[0]                                # [bk, d]
+        v = v_ref[0]                                # [bk, d]
+        prec = _dot_precision(q.dtype)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale                                # [bq, bk]
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=prec,
+        ) * sm_scale                                # [bq, bk] f32
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -83,10 +95,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_prev = l_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                      # [bq, bk]
+        p = jnp.exp(s - m_new)                      # [bq, bk] f32
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -144,9 +157,15 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _recompute_p_ds(q, k, v, do, lse, delta, qi, ki, causal, sm_scale,
                     block_q, block_k):
-    """Shared bwd block math: p [bq,bk] and ds [bq,bk] (pre-scaled)."""
+    """Shared bwd block math: p [bq,bk] and ds [bq,bk] (pre-scaled, f32).
+
+    Dots take the blocks in their native dtype (bf16 MXU rate) and accumulate
+    f32; only the elementwise recurrence is f32.
+    """
+    prec = _dot_precision(q.dtype)
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=prec,
     ) * sm_scale
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -154,7 +173,8 @@ def _recompute_p_ds(q, k, v, do, lse, delta, qi, ki, causal, sm_scale,
         s = jnp.where(k_pos > q_pos, NEG_INF, s)
     p = jnp.exp(s - lse)                            # lse [bq, 1] broadcasts
     dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=prec,
     )                                               # [bq, bk]
     ds = p * (dp - delta) * sm_scale                # delta [bq, 1]
     return p, ds
@@ -175,16 +195,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         _, ds = _recompute_p_ds(
             q, k, v, do, lse_ref[0], delta_ref[0], qi, ki, causal, sm_scale,
             block_q, block_k,
         )
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_dot_precision(k.dtype),
         )
 
     @pl.when(ki == kv_steps - 1)
@@ -209,19 +230,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         p, ds = _recompute_p_ds(
             q, k, v, do, lse_ref[0], delta_ref[0], qi, ki, causal, sm_scale,
             block_q, block_k,
         )
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_dot_precision(do.dtype),
         )
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_dot_precision(q.dtype),
         )
 
     @pl.when(qi == q_steps - 1)
@@ -313,27 +336,39 @@ def _flash_bhtd_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
 
 
+def _auto_block(t: int, cap: int) -> Optional[int]:
+    """Largest multiple of 128 that divides t, capped — big blocks keep the
+    MXU busy (measured on v5e at T=2048 d=64: 1024-blocks are 5.6x faster
+    than 128-blocks and 2.3x faster than XLA dense attention). None when no
+    lane-aligned tiling exists (caller falls back to dense)."""
+    for b in range(min(cap, t) // 128 * 128, 127, -128):
+        if t % b == 0:
+            return b
+    return None
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention on [B, T, H, D] (same layout as ring/dense attention).
 
-    Differentiable (custom VJP, recompute-based backward). Sequences that the
-    tiling cannot cover (T < 2 MXU rows or not divisible by the block size)
-    fall back to dense attention — semantics are identical.
+    Differentiable (custom VJP, recompute-based backward). Block sizes
+    default to the largest dividing multiple of 128 (<=1024). Sequences the
+    tiling cannot cover (T < 2 MXU rows or not a multiple of 128) fall back
+    to dense attention — semantics are identical.
     """
     from .ring_attention import dense_attention
 
     b, t, h, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = min(block_q, t) if block_q else (_auto_block(t, 1024) or t + 1)
+    block_k = min(block_k, t) if block_k else (_auto_block(t, 1024) or t + 1)
 
     def dense_fallback():
         # dense_attention hard-codes 1/sqrt(d); fold a custom sm_scale into q

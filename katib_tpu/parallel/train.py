@@ -43,28 +43,40 @@ def make_lm_train_step(
     import flax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    model = TransformerLM(config, mesh=mesh)
+    # Single-device mesh: GSPMD partitioning buys nothing on one chip and the
+    # sharded-array dispatch path is dramatically slower on tunneled TPU
+    # backends (measured 160x on v5e via axon: 28ms/step plain jit vs 4.5s
+    # with a 1-device NamedSharding). Build the plain jit step instead —
+    # semantics are identical, collectives are no-ops on one device.
+    single_device = mesh is None or int(mesh.devices.size) == 1
+    target_device = None if mesh is None else mesh.devices.reshape(-1)[0]
+
+    model = TransformerLM(config, mesh=None if single_device else mesh)
     sample_tokens = jnp.zeros((2, 16), dtype=jnp.int32)
     with jax.default_device(jax.devices()[0]):
         params = model.init(jax.random.PRNGKey(seed), sample_tokens)["params"]
 
     tx = optax.adamw(learning_rate, weight_decay=0.01)
 
-    # shard params + opt state
-    flat_specs = {
-        k: param_sharding_rules(k)
-        for k in flax.traverse_util.flatten_dict(params)
-    }
-    param_specs = flax.traverse_util.unflatten_dict(flat_specs)
-    params = jax.tree.map(
-        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
-        params,
-        param_specs,
-        is_leaf=lambda x: not isinstance(x, dict),
-    )
+    if single_device:
+        if target_device is not None:
+            params = jax.device_put(params, target_device)
+        batch_sharding = target_device
+    else:
+        # shard params + opt state
+        flat_specs = {
+            k: param_sharding_rules(k)
+            for k in flax.traverse_util.flatten_dict(params)
+        }
+        param_specs = flax.traverse_util.unflatten_dict(flat_specs)
+        params = jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+            params,
+            param_specs,
+            is_leaf=lambda x: not isinstance(x, dict),
+        )
+        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
     opt_state = tx.init(params)
-
-    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
 
     def step(params, opt_state, tokens, targets, positions):
         def loss_fn(p):
@@ -97,6 +109,8 @@ def make_lm_train_step(
         if positions is None:
             b, t = tokens.shape
             positions = np.broadcast_to(np.arange(t, dtype="int32"), (b, t))
+        if batch_sharding is None:
+            return jnp.asarray(tokens), jnp.asarray(targets), jnp.asarray(positions)
         return (
             jax.device_put(tokens, batch_sharding),
             jax.device_put(targets, batch_sharding),

@@ -1,0 +1,44 @@
+"""Wall-clock measurement that is honest on tunneled TPU backends.
+
+On a proxied accelerator (e.g. a TPU reached through a network tunnel)
+``jax.block_until_ready`` can return before the remote execution has actually
+finished — a 28ms train step "completes" in 0.3ms — so any loop timed that
+way under-reports by orders of magnitude. A device->host read of one element
+cannot lie: the value isn't available until the producing computation (and,
+through data dependencies, everything it chains from) has run.
+
+The recipe used by bench.py and the hardware-gated perf tests:
+
+1. ``host_sync`` once before starting the clock (drains queued work);
+2. chain each iteration's output into the next iteration's input so the
+   loop cannot be reordered or deduplicated;
+3. ``host_sync`` the final output — one round-trip for the whole loop;
+4. subtract ``roundtrip_ms`` (the cost of step 3) and divide by N.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def host_sync(x) -> float:
+    """Force completion with a 1-element device->host read; returns it."""
+    import jax.numpy as jnp
+
+    return float(jnp.ravel(x)[0])
+
+
+def roundtrip_ms(repeats: int = 3) -> float:
+    """Per-call dispatch + host-read round-trip latency in milliseconds
+    (~90ms through the axon tunnel, microseconds on a local device)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8,))
+    host_sync(f(x))
+    t0 = time.time()
+    for _ in range(repeats):
+        x = f(x)
+        host_sync(x)
+    return (time.time() - t0) / repeats * 1e3

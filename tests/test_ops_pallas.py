@@ -78,3 +78,20 @@ def test_sharded_flash_attention_matches_dense():
     g = jax.grad(lambda q: sharded_flash_attention(q, k, v, mesh, causal=True).sum())(q)
     gr = jax.grad(lambda q: dense_attention(q, k, v, causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-4)
+
+
+def test_auto_block_lane_aligned():
+    """Auto-picked blocks must be 128-aligned divisors of T; shapes without
+    one fall back to dense (t % block != 0 at the call site)."""
+    from katib_tpu.ops.flash_attention import _auto_block
+
+    assert _auto_block(2048, 1024) == 1024
+    assert _auto_block(1536, 1024) == 768
+    assert _auto_block(384, 1024) == 384
+    assert _auto_block(128, 1024) == 128
+    assert _auto_block(192, 1024) is None  # 192 divides itself but isn't 128-aligned
+    assert _auto_block(960, 1024) is None
+    assert _auto_block(100, 1024) is None
+    for t in (256, 512, 1024, 4096, 8192):
+        b = _auto_block(t, 1024)
+        assert b is not None and b % 128 == 0 and t % b == 0

@@ -48,10 +48,13 @@ def _rand(b, t, h, d, dtype, seed=0):
 def test_flash_forward_matches_dense_compiled(t, causal, dtype):
     q, k, v = _rand(2, t, 4, 64, dtype)
     out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))(q, k, v)
-    ref = dense_attention(
-        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
-        causal=causal,
-    )
+    # reference at HIGHEST precision: TPU f32 matmuls default to a bf16
+    # decomposition (~1e-3 error), which would dominate the comparison
+    with jax.default_matmul_precision("highest"):
+        ref = dense_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            causal=causal,
+        )
     tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
     np.testing.assert_allclose(
         np.asarray(out, dtype=np.float32), np.asarray(ref), atol=tol, rtol=tol
@@ -70,7 +73,8 @@ def test_flash_backward_matches_dense_compiled(causal):
         return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
 
     gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
-    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    with jax.default_matmul_precision("highest"):
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3, rtol=2e-3)
 
@@ -86,13 +90,20 @@ def test_flash_not_slower_than_dense_at_long_seq():
     flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
     dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
 
-    def timeit(fn):
-        jax.block_until_ready(fn(q, k, v))
+    # tunneled backends: chain outputs into inputs and end with one host
+    # read — block_until_ready can return early (katib_tpu.utils.timing)
+    from katib_tpu.utils.timing import host_sync, roundtrip_ms
+
+    rt_s = roundtrip_ms() / 1e3
+
+    def timeit(fn, n=50):
+        host_sync(fn(q, k, v))
         t0 = time.time()
-        for _ in range(10):
-            out = fn(q, k, v)
-        jax.block_until_ready(out)
-        return (time.time() - t0) / 10
+        out = q
+        for _ in range(n):
+            out = fn(out, k, v)
+        host_sync(out)
+        return max((time.time() - t0 - rt_s) / n, 1e-9)
 
     flash_s, dense_s = timeit(flash), timeit(dense)
     print(f"flash {flash_s*1e3:.3f}ms dense {dense_s*1e3:.3f}ms "
