@@ -147,8 +147,10 @@ class DartsSearch:
     # ------------------------------------------------------------------
 
     def build(self, sample_shape: Tuple[int, ...], total_steps: int) -> None:
+        from ..utils.modelinit import jitted_init
+
         key = jax.random.PRNGKey(self.seed)
-        params = self.model.init(key, jnp.zeros((2,) + tuple(sample_shape)))["params"]
+        params = jitted_init(self.model, key, jnp.zeros((2,) + tuple(sample_shape)))
         self.weights, self.alphas = split_params(params)
 
         # weights: SGD momentum + cosine decay + clip (run_trial.py w_optim)

@@ -142,10 +142,12 @@ def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
     split = int(len(x) * 0.9)
     x_t, y_t, x_v, y_v = x[:split], y[:split], x[split:], y[split:]
 
+    from ..utils.modelinit import jitted_init
+
     key = jax.random.PRNGKey(0)
-    params = model.init({"params": key, "dropout": key}, jnp.zeros((2,) + x.shape[1:]))[
-        "params"
-    ]
+    params = jitted_init(
+        model, {"params": key, "dropout": key}, jnp.zeros((2,) + x.shape[1:])
+    )
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 

@@ -43,7 +43,9 @@ def run_mnist_trial(assignments: Dict[str, str], ctx=None) -> None:
     x_test, y_test = load_mnist("test", n=(n_train // 5 if n_train else None))
 
     model = MnistCNN()
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2,) + x.shape[1:]))["params"]
+    from ..utils.modelinit import jitted_init
+
+    params = jitted_init(model, jax.random.PRNGKey(0), jnp.zeros((2,) + x.shape[1:]))
     tx = optax.sgd(lr, momentum=momentum)
     opt_state = tx.init(params)
 

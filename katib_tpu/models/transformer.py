@@ -235,8 +235,12 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = Block(cfg, self.mesh, name=f"block{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)
-        # tied output head
-        logits = jnp.einsum("bte,ve->btv", x.astype(jnp.float32), emb)
+        # tied output head — the largest matmul in the model: bf16 operands
+        # at native MXU rate, f32 accumulation for the softmax/loss
+        logits = jnp.einsum(
+            "bte,ve->btv", x.astype(cfg.dtype), emb.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
         return logits
 
 

@@ -1,0 +1,26 @@
+"""Model parameter initialization that is cheap on high-latency backends.
+
+Eager ``flax`` ``Module.init`` issues one device dispatch per parameter —
+measured ~80s for a small DARTS supernet through a tunneled TPU (~90ms per
+round trip) vs ~9s as a single jitted computation. Every trial entry point
+should initialize through this helper rather than calling ``model.init``
+eagerly.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def jitted_init(model, rngs, *args, device=None):
+    """``model.init`` as one jitted computation; returns the ``params``
+    collection. ``device`` (optional) places the result on a specific device
+    via ``jax.default_device`` — arrays stay *uncommitted*, which matters on
+    tunneled backends where committed inputs take a ~45x slower dispatch
+    path (see katib_tpu.parallel.train.make_lm_train_step).
+    """
+    import contextlib
+
+    ctx = jax.default_device(device) if device is not None else contextlib.nullcontext()
+    with ctx:
+        return jax.jit(model.init)(rngs, *args)["params"]

@@ -60,7 +60,7 @@ def test_hyperband_multi_bracket_completion(controller):
         parallel_trial_count=4,   # >= ceil(eta^s_max) (validated minimum)
     )
     controller.create_experiment(spec)
-    exp = controller.run("hb-e2e", timeout=120)
+    exp = controller.run("hb-e2e", timeout=300)
 
     assert exp.status.is_completed, exp.status.message
     trials = controller.state.list_trials("hb-e2e")
@@ -119,7 +119,7 @@ def test_hyperband_budget_cap_shrinks_gracefully(controller):
         parallel_trial_count=4,
     )
     controller.create_experiment(spec)
-    exp = controller.run("hb-cap", timeout=120)
+    exp = controller.run("hb-cap", timeout=300)
     assert exp.status.is_completed, exp.status.message
     trials = controller.state.list_trials("hb-cap")
     assert len(trials) == 9

@@ -125,6 +125,32 @@ class TestShardedTrainStep:
         params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
         assert np.isfinite(float(loss))
 
+    def test_single_device_mesh_nondefault_chip_placement(self, devices):
+        """A 1-device mesh on chip k != 0 must still place params/batches and
+        run the step on that chip (via jax.default_device, not committed
+        device_put — see the tunneled-backend note in make_lm_train_step)."""
+        from katib_tpu.models.transformer import TransformerConfig
+        from katib_tpu.parallel.train import make_lm_train_step
+
+        target = devices[3]
+        mesh = make_mesh([target])
+        config = TransformerConfig(
+            vocab_size=64, embed_dim=32, num_layers=1, num_heads=2,
+            max_seq_len=16, dtype=jnp.float32,
+        )
+        params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, 1e-2)
+        import flax
+
+        leaf = next(iter(flax.traverse_util.flatten_dict(params).values()))
+        assert leaf.devices() == {target}
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 64, size=(2, 17), dtype=np.int32)
+        tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
+        assert tokens.devices() == {target}
+        params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
+        assert loss.devices() == {target}
+        assert np.isfinite(float(loss))
+
     def test_run_lm_trial_entry(self, devices):
         from katib_tpu.parallel.train import run_lm_trial
 
