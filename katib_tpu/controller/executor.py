@@ -306,8 +306,6 @@ class SubprocessExecutor:
         sidecar's watchMetricsFile loop); scrape the trial's Prometheus
         endpoint when the collector kind asks for it."""
         watch_path = metrics_file or stdout_path
-        offset = 0
-        buffered = ""
         scrape = (
             spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
             and spec.metrics_collector_spec.source is not None
@@ -315,55 +313,58 @@ class SubprocessExecutor:
         )
         last_scrape = 0.0
         last_scraped: Dict[str, Any] = {}  # metric -> (value, recorded_at)
-        while True:
-            if handle.kill_requested:
-                self._terminate(proc)
-                return ExecutionResult(TrialOutcome.KILLED, "kill requested")
-            rc = proc.poll()
-            if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
-                last_scrape = time.time()
-                stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
-                if stopped is not None:
-                    self._terminate(proc)
-                    return stopped
-            if monitor is not None and os.path.exists(watch_path):
-                with open(watch_path, "r", errors="replace") as f:
-                    f.seek(offset)
-                    chunk = f.read()
-                    offset = f.tell()
-                if chunk:
-                    buffered += chunk
-                    lines = buffered.split("\n")
-                    buffered = lines.pop()  # keep partial line
-                    for line in lines:
-                        for log in self._parse_line(line, spec):
-                            try:
-                                value = float(log.value)
-                            except ValueError:
-                                continue  # skip unparseable values like fold_observation
-                            if monitor.observe(log.metric_name, value):
-                                self._terminate(proc)
-                                return ExecutionResult(TrialOutcome.EARLY_STOPPED)
-            if rc is not None:
-                if scrape:
-                    # best-effort final scrape — values published within the
-                    # last SCRAPE_INTERVAL are otherwise lost when the trial's
-                    # endpoint dies with the process. (PROMETHEUS trials that
-                    # exit immediately after publishing should also Push — see
-                    # README metrics-collector notes.)
-                    self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
-                return None
-            time.sleep(self.POLL_INTERVAL)
+        tailer = None
+        if monitor is not None:
+            # native C++ tailer for the default TEXT filter, Python fallback
+            # for custom filters / JSON (katib_tpu.native.tailer)
+            from ..native.tailer import make_tailer
 
-    def _parse_line(self, line: str, spec: ExperimentSpec) -> List[MetricLog]:
-        names = spec.objective.all_metric_names()
-        mc = spec.metrics_collector_spec
-        filters = None
-        if mc.source and mc.source.filter:
-            filters = mc.source.filter.metrics_format
-        if mc.source and mc.source.file_format == "JSON":
-            return parse_json_lines([line], names)
-        return parse_text_lines([line], names, filters)
+            mc = spec.metrics_collector_spec
+            filters = (
+                mc.source.filter.metrics_format
+                if mc.source and mc.source.filter
+                else None
+            )
+            tailer = make_tailer(
+                watch_path,
+                spec.objective.all_metric_names(),
+                filters=filters,
+                json_format=bool(mc.source and mc.source.file_format == "JSON"),
+            )
+        try:
+            while True:
+                if handle.kill_requested:
+                    self._terminate(proc)
+                    return ExecutionResult(TrialOutcome.KILLED, "kill requested")
+                rc = proc.poll()
+                if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
+                    last_scrape = time.time()
+                    stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    if stopped is not None:
+                        self._terminate(proc)
+                        return stopped
+                if tailer is not None:
+                    for name, raw, _idx in tailer.poll():
+                        try:
+                            value = float(raw)
+                        except ValueError:
+                            continue  # skip unparseable values like fold_observation
+                        if monitor.observe(name, value):
+                            self._terminate(proc)
+                            return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+                if rc is not None:
+                    if scrape:
+                        # best-effort final scrape — values published within the
+                        # last SCRAPE_INTERVAL are otherwise lost when the trial's
+                        # endpoint dies with the process. (PROMETHEUS trials that
+                        # exit immediately after publishing should also Push — see
+                        # README metrics-collector notes.)
+                        self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    return None
+                time.sleep(self.POLL_INTERVAL)
+        finally:
+            if tailer is not None:
+                tailer.close()
 
     @staticmethod
     def _terminate(proc: subprocess.Popen) -> None:

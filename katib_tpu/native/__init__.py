@@ -12,7 +12,12 @@ import os
 
 NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 OBSLOG_SO = os.path.join(NATIVE_DIR, "libobslog.so")
+METRICS_TAILER_SO = os.path.join(NATIVE_DIR, "libmetricstailer.so")
 
 
 def obslog_available() -> bool:
     return os.path.exists(OBSLOG_SO)
+
+
+def tailer_available() -> bool:
+    return os.path.exists(METRICS_TAILER_SO)

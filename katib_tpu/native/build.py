@@ -7,28 +7,39 @@ import shutil
 import subprocess
 import sys
 
-from . import NATIVE_DIR, OBSLOG_SO
+from . import METRICS_TAILER_SO, NATIVE_DIR, OBSLOG_SO
+
+_TARGETS = (
+    ("obslog.cc", OBSLOG_SO),
+    ("metrics_tailer.cc", METRICS_TAILER_SO),
+)
 
 
-def build(force: bool = False) -> bool:
-    src = os.path.join(NATIVE_DIR, "obslog.cc")
-    if os.path.exists(OBSLOG_SO) and not force:
-        if os.path.getmtime(OBSLOG_SO) >= os.path.getmtime(src):
+def _build_one(gxx: str, src: str, out: str, force: bool) -> bool:
+    if os.path.exists(out) and not force:
+        if os.path.getmtime(out) >= os.path.getmtime(src):
             return True
-    gxx = shutil.which("g++") or shutil.which("c++")
-    if gxx is None:
-        print("no C++ compiler found; native obslog store unavailable", file=sys.stderr)
-        return False
-    cmd = [gxx, "-O2", "-fPIC", "-shared", "-std=c++17", "-o", OBSLOG_SO, src]
+    cmd = [gxx, "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
-        print(f"native build failed:\n{e.stderr}", file=sys.stderr)
+        print(f"native build failed for {src}:\n{e.stderr}", file=sys.stderr)
         return False
     return True
 
 
+def build(force: bool = False) -> bool:
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        print("no C++ compiler found; native components unavailable", file=sys.stderr)
+        return False
+    ok = True
+    for src_name, out in _TARGETS:
+        ok = _build_one(gxx, os.path.join(NATIVE_DIR, src_name), out, force) and ok
+    return ok
+
+
 if __name__ == "__main__":
     ok = build(force="--force" in sys.argv)
-    print("built" if ok else "build failed:", OBSLOG_SO)
+    print("built" if ok else "build failed:", ", ".join(out for _, out in _TARGETS))
     sys.exit(0 if ok else 1)

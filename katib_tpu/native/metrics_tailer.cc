@@ -1,0 +1,170 @@
+// Native metrics tailer: incremental file tailing + TEXT metric-line parsing
+// for the trial executor's watch loop.
+//
+// The reference's equivalent surface is the Go file-metrics-collector sidecar
+// (cmd/metricscollector/v1beta1/file-metricscollector/main.go:336-386): a
+// fsnotify watch over the metrics file applying the TEXT filter per line to
+// enforce early-stopping rules while the trial runs. In this framework the
+// orchestrator process tails every running trial's output itself (often 64+
+// concurrent trials on one host core), so the per-poll work — read new
+// bytes, split lines, regex-scan for `name = value` pairs — is a hot path
+// worth doing in native code.
+//
+// Semantics mirror katib_tpu.runtime.metrics.DEFAULT_FILTER:
+//     ([\w|-]+)\s*=\s*([+-]?\d*(\.\d+)?([Ee][+-]?\d+)?)
+// applied with finditer over each complete line, keeping only wanted metric
+// names whose value parses as a float. Partial trailing lines are buffered
+// across polls exactly like the Python loop in SubprocessExecutor._wait.
+//
+// C ABI (ctypes): mt_open(path, names) -> handle; mt_poll(handle) -> malloc'd
+// "name\x1Fvalue\x1Fline_index\n"* (NULL when no new matches); mt_free;
+// mt_close. Line indices increase monotonically across polls so the Python
+// side can synthesize report-order timestamps.
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+
+namespace {
+
+struct Tailer {
+  std::string path;
+  long offset = 0;
+  std::string partial;
+  std::unordered_set<std::string> wanted;
+  long line_index = 0;
+};
+
+inline bool name_char(char c) {
+  return std::isalnum(static_cast<unsigned char>(c)) || c == '_' || c == '|' ||
+         c == '-';
+}
+
+// Parse the value part of `name = value` starting at s[i]; on success returns
+// true and sets [begin,end) of the numeric text and advances i past it.
+bool parse_value(const std::string& s, size_t& i, size_t& begin, size_t& end) {
+  size_t j = i;
+  if (j < s.size() && (s[j] == '+' || s[j] == '-')) ++j;
+  size_t digits_start = j;
+  while (j < s.size() && std::isdigit(static_cast<unsigned char>(s[j]))) ++j;
+  bool has_int = j > digits_start;
+  bool has_frac = false;
+  if (j < s.size() && s[j] == '.') {
+    size_t k = j + 1;
+    while (k < s.size() && std::isdigit(static_cast<unsigned char>(s[k]))) ++k;
+    if (k > j + 1) {  // regex requires \.\d+ — at least one digit
+      has_frac = true;
+      j = k;
+    }
+  }
+  if (!has_int && !has_frac) return false;
+  if (j < s.size() && (s[j] == 'e' || s[j] == 'E')) {
+    size_t k = j + 1;
+    if (k < s.size() && (s[k] == '+' || s[k] == '-')) ++k;
+    size_t exp_start = k;
+    while (k < s.size() && std::isdigit(static_cast<unsigned char>(s[k]))) ++k;
+    if (k > exp_start) j = k;  // exponent only counts with >= 1 digit
+  }
+  begin = i;
+  end = j;
+  i = j;
+  return true;
+}
+
+// finditer(DEFAULT_FILTER, line): append matches to out.
+void scan_line(const Tailer& t, const std::string& line, long index,
+               std::string& out) {
+  size_t i = 0;
+  const size_t n = line.size();
+  while (i < n) {
+    if (!name_char(line[i])) {
+      ++i;
+      continue;
+    }
+    size_t name_start = i;
+    while (i < n && name_char(line[i])) ++i;
+    size_t name_end = i;
+    size_t j = i;
+    while (j < n && (line[j] == ' ' || line[j] == '\t')) ++j;
+    if (j >= n || line[j] != '=') continue;  // resume after the name run
+    ++j;
+    while (j < n && (line[j] == ' ' || line[j] == '\t')) ++j;
+    size_t vb = 0, ve = 0;
+    if (!parse_value(line, j, vb, ve)) continue;
+    i = j;  // continue scanning after the value (finditer semantics)
+    std::string name = line.substr(name_start, name_end - name_start);
+    if (!t.wanted.count(name)) continue;
+    out += name;
+    out += '\x1F';
+    out.append(line, vb, ve - vb);
+    out += '\x1F';
+    out += std::to_string(index);
+    out += '\n';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mt_open(const char* path, const char* names) {
+  Tailer* t = new Tailer();
+  t->path = path;
+  const char* start = names;
+  for (const char* p = names;; ++p) {
+    if (*p == '\x1F' || *p == '\0') {
+      if (p > start) t->wanted.emplace(start, static_cast<size_t>(p - start));
+      if (*p == '\0') break;
+      start = p + 1;
+    }
+  }
+  return t;
+}
+
+char* mt_poll(void* handle) {
+  Tailer* t = static_cast<Tailer*>(handle);
+  FILE* f = std::fopen(t->path.c_str(), "rb");
+  if (f == nullptr) return nullptr;
+  if (std::fseek(f, t->offset, SEEK_SET) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::string data;
+  char buf[65536];
+  size_t got;
+  while ((got = std::fread(buf, 1, sizeof(buf), f)) > 0) {
+    data.append(buf, got);
+    t->offset += static_cast<long>(got);
+  }
+  std::fclose(f);
+  if (data.empty()) return nullptr;
+
+  std::string out;
+  size_t pos = 0;
+  t->partial.append(data);
+  while (true) {
+    size_t nl = t->partial.find('\n', pos);
+    if (nl == std::string::npos) break;
+    // Bytes may be non-UTF8; the parser is byte-oriented like errors=replace.
+    std::string line = t->partial.substr(pos, nl - pos);
+    scan_line(*t, line, t->line_index++, out);
+    pos = nl + 1;
+  }
+  t->partial.erase(0, pos);
+
+  if (out.empty()) return nullptr;
+  char* res = static_cast<char*>(std::malloc(out.size() + 1));
+  if (res == nullptr) return nullptr;
+  std::memcpy(res, out.data(), out.size());
+  res[out.size()] = '\0';
+  return res;
+}
+
+void mt_free(char* buf) { std::free(buf); }
+
+void mt_close(void* handle) { delete static_cast<Tailer*>(handle); }
+
+}  // extern "C"

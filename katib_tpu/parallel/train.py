@@ -84,7 +84,23 @@ def make_lm_train_step(
             is_leaf=lambda x: not isinstance(x, dict),
         )
         batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
-    opt_state = tx.init(params)
+
+    # Non-default target chip: uncommitted execution follows the *default*
+    # device, so pin creation and every step with jax.default_device —
+    # placement without the committed-array dispatch penalty.
+    pin_device = (
+        target_device
+        if single_device
+        and target_device is not None
+        and target_device != jax.devices()[0]
+        else None
+    )
+
+    if pin_device is None:
+        opt_state = tx.init(params)
+    else:
+        with jax.default_device(pin_device):
+            opt_state = tx.init(params)
 
     def step(params, opt_state, tokens, targets, positions):
         def loss_fn(p):
@@ -110,17 +126,6 @@ def make_lm_train_step(
         return params, opt_state, loss
 
     jitted_step = jax.jit(step, donate_argnums=(0, 1))
-
-    # Non-default target chip: uncommitted execution follows the *default*
-    # device, so pin it per call with jax.default_device — placement without
-    # the committed-array dispatch penalty.
-    pin_device = (
-        target_device
-        if single_device
-        and target_device is not None
-        and target_device != jax.devices()[0]
-        else None
-    )
 
     if pin_device is None:
         step_fn = jitted_step

@@ -189,9 +189,12 @@ def test_simple_pbt_e2e(controller):
 
 def _plateau_trial(assignments, ctx):
     lr = float(assignments["lr"])
-    # lr >= 0.5: improving learner; lr < 0.5: plateaus at a bad value
+    # lr >= 0.5: improving learner; lr < 0.5: plateaus at a bad value that
+    # declines with lr, so each later bad trial sits strictly below the mean
+    # of earlier ones (the rule comparison is strict LESS — identical
+    # plateaus would only trip via float rounding of the mean)
     for step in range(10):
-        value = (0.1 + 0.08 * step) if lr >= 0.5 else 0.05
+        value = (0.1 + 0.08 * step) if lr >= 0.5 else (0.05 - lr / 100)
         ctx.report(**{"accuracy": value})
 
 

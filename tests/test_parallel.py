@@ -143,6 +143,10 @@ class TestShardedTrainStep:
 
         leaf = next(iter(flax.traverse_util.flatten_dict(params).values()))
         assert leaf.devices() == {target}
+        opt_leaf = next(
+            x for x in jax.tree_util.tree_leaves(opt_state) if hasattr(x, "devices")
+        )
+        assert opt_leaf.devices() == {target}
         rng = np.random.default_rng(0)
         data = rng.integers(0, 64, size=(2, 17), dtype=np.int32)
         tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
