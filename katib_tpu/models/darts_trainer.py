@@ -179,7 +179,10 @@ class DartsSearch:
 
     def _shard_batch(self, batch):
         if self.mesh is None:
-            return batch
+            # stage on device eagerly (uncommitted): passing raw numpy into
+            # the jitted step transfers synchronously inside each dispatch,
+            # which costs tens of ms per step through a tunneled TPU backend
+            return tuple(jnp.asarray(b) for b in batch)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sharding = NamedSharding(self.mesh, P("data"))

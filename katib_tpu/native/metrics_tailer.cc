@@ -39,8 +39,13 @@ struct Tailer {
 };
 
 inline bool name_char(char c) {
-  return std::isalnum(static_cast<unsigned char>(c)) || c == '_' || c == '|' ||
-         c == '-';
+  // Python's \w is Unicode-aware; treating every UTF-8 continuation/lead
+  // byte (>= 0x80) as a name character keeps multi-byte words a single
+  // token (e.g. "µacc" never splits into a spurious "acc" match). The
+  // Python binding routes experiments with non-ASCII *wanted* names to the
+  // Python tailer, so native only needs to not mis-tokenize such lines.
+  unsigned char u = static_cast<unsigned char>(c);
+  return u >= 0x80 || std::isalnum(u) || c == '_' || c == '|' || c == '-';
 }
 
 // Parse the value part of `name = value` starting at s[i]; on success returns

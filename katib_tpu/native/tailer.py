@@ -118,6 +118,13 @@ class PyTailer:
             else:
                 logs = parse_text_lines([line], self._names, self._filters)
             for log in logs:
+                # tailer contract: values are float-parseable (the regex's
+                # value group can match a bare sign; the native tailer
+                # rejects those in-kernel, and consumers would skip them)
+                try:
+                    float(log.value)
+                except (TypeError, ValueError):
+                    continue
                 out.append((log.metric_name, log.value, idx))
         return out
 
@@ -131,8 +138,11 @@ def make_tailer(
     filters: Optional[Sequence[str]] = None,
     json_format: bool = False,
 ):
-    """Native tailer for the default-TEXT-filter case; Python otherwise."""
-    if not json_format and not filters and tailer_available():
+    """Native tailer for the default-TEXT-filter, ASCII-names case; Python
+    otherwise (custom filters, JSON lines, or Unicode metric names — the
+    C++ matcher is byte-oriented while Python's \\w is Unicode-aware)."""
+    ascii_names = all(n.isascii() for n in metric_names)
+    if not json_format and not filters and ascii_names and tailer_available():
         try:
             return NativeTailer(path, metric_names)
         except OSError:

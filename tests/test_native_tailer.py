@@ -12,10 +12,12 @@ from katib_tpu.native.tailer import PyTailer
 
 @pytest.fixture(scope="module")
 def native_cls():
+    from katib_tpu.native import tailer_available
     from katib_tpu.native.build import build
 
-    if not build():
-        pytest.skip("no C++ toolchain")
+    build()  # per-target availability decides the skip, not the AND of all
+    if not tailer_available():
+        pytest.skip("no C++ toolchain / tailer build failed")
     from katib_tpu.native.tailer import NativeTailer
 
     return NativeTailer
@@ -32,6 +34,8 @@ TRICKY = [
     "x" * 500 + " loss=1",        # long line
     '{"json": "looking", "loss": 9}',  # TEXT mode: no = pair, ignored
     "loss=1.5e acc=2.",           # dangling exponent/dot: value stops early
+    "loss=+ acc=0.3",             # bare sign: dropped by both tailers
+    "µacc=0.9 loss=0.7",          # multi-byte word stays one (unwanted) token
 ]
 
 
@@ -76,6 +80,8 @@ class TestParity:
         assert isinstance(make_tailer(p, ["m"]), native_cls)
         assert isinstance(make_tailer(p, ["m"], filters=[r"(\w+):(\d+)"]), PyTailer)
         assert isinstance(make_tailer(p, ["m"], json_format=True), PyTailer)
+        # Unicode metric names need Python's Unicode-aware \w
+        assert isinstance(make_tailer(p, ["précision"]), PyTailer)
 
 
 class TestExecutorIntegration:
