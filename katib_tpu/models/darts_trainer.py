@@ -177,16 +177,22 @@ class DartsSearch:
         self._eval_step = self._compile_eval()
         self._built = True
 
-    def _shard_batch(self, batch):
-        if self.mesh is None:
-            # stage on device eagerly (uncommitted): passing raw numpy into
-            # the jitted step transfers synchronously inside each dispatch,
-            # which costs tens of ms per step through a tunneled TPU backend
-            return tuple(jnp.asarray(b) for b in batch)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _epoch_iter(self, x, y, rng):
+        """Epoch iterator with batches staged on device ahead of use
+        (double buffering — katib_tpu.utils.prefetch). Meshed runs stage with
+        the data-parallel sharding; single-device runs stay uncommitted
+        (committed arrays dispatch slowly on tunneled backends)."""
+        from ..utils.prefetch import prefetch_to_device
 
-        sharding = NamedSharding(self.mesh, P("data"))
-        return tuple(jax.device_put(b, sharding) for b in batch)
+        base = [(x, y)] if len(x) < self.batch_size else batches(
+            x, y, self.batch_size, rng
+        )
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = NamedSharding(self.mesh, P("data"))
+        return prefetch_to_device(base, sharding=sharding)
 
     def _compile_step(self):
         model = self.model
@@ -242,20 +248,13 @@ class DartsSearch:
         x_t, y_t = train_data
         x_v, y_v = valid_data
         losses = []
-        if len(x_t) < self.batch_size:  # split smaller than one batch
-            train_iter = [(x_t, y_t)]
-            valid_iter = iter([(x_v, y_v)])
-        else:
-            train_iter = batches(x_t, y_t, self.batch_size, rng)
-            valid_iter = batches(x_v, y_v, self.batch_size, rng)
+        train_iter = self._epoch_iter(x_t, y_t, rng)
+        valid_iter = self._epoch_iter(x_v, y_v, rng)
         for train_batch in train_iter:
             try:
                 valid_batch = next(valid_iter)
             except StopIteration:
-                if len(x_v) < self.batch_size:
-                    valid_iter = iter([(x_v, y_v)])
-                else:
-                    valid_iter = batches(x_v, y_v, self.batch_size, rng)
+                valid_iter = self._epoch_iter(x_v, y_v, rng)
                 valid_batch = next(valid_iter)
             (self.weights, self.alphas, self.w_opt_state, self.a_opt_state, loss) = (
                 self._search_step(
@@ -264,8 +263,8 @@ class DartsSearch:
                     self.w_opt_state,
                     self.a_opt_state,
                     self.step_idx,
-                    self._shard_batch(train_batch),
-                    self._shard_batch(valid_batch),
+                    train_batch,
+                    valid_batch,
                 )
             )
             self.step_idx += 1
@@ -275,10 +274,10 @@ class DartsSearch:
     def validate(self, valid_data, rng: np.random.Generator, max_batches: int = 50) -> float:
         x_v, y_v = valid_data
         accs = []
-        for i, batch in enumerate(batches(x_v, y_v, self.batch_size, rng)):
+        for i, batch in enumerate(self._epoch_iter(x_v, y_v, rng)):
             if i >= max_batches:
                 break
-            accs.append(self._eval_step(self.weights, self.alphas, self._shard_batch(batch)))
+            accs.append(self._eval_step(self.weights, self.alphas, batch))
         return float(jnp.stack(accs).mean()) if accs else 0.0
 
     def genotype(self) -> Dict[str, Any]:

@@ -166,16 +166,21 @@ def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
         logits = model.apply({"params": params}, bx, train=False)
         return (jnp.argmax(logits, -1) == by).mean()
 
+    from ..utils.prefetch import prefetch_to_device
+
     rng = np.random.default_rng(0)
     loss = jnp.array(float("nan"))
     for epoch in range(num_epochs):
-        train_iter = (
+        train_iter = prefetch_to_device(
             [(x_t, y_t)] if len(x_t) < batch_size else batches(x_t, y_t, batch_size, rng)
         )
         for bx, by in train_iter:
             key, sub = jax.random.split(key)
             params, opt_state, loss = train_step(params, opt_state, sub, bx, by)
-        accs = [eval_step(params, bx, by) for bx, by in batches(x_v, y_v, batch_size, rng)]
+        accs = [
+            eval_step(params, bx, by)
+            for bx, by in prefetch_to_device(batches(x_v, y_v, batch_size, rng))
+        ]
         if not accs and len(x_v):  # val split smaller than one batch
             accs = [eval_step(params, x_v, y_v)]
         acc = float(jnp.stack(accs).mean()) if accs else 0.0

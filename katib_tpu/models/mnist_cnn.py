@@ -64,13 +64,18 @@ def run_mnist_trial(assignments: Dict[str, str], ctx=None) -> None:
         logits = model.apply({"params": params}, bx, train=False)
         return (jnp.argmax(logits, -1) == by).mean()
 
+    from ..utils.prefetch import prefetch_to_device
+
     rng = np.random.default_rng(0)
     for epoch in range(num_epochs):
         losses = []
-        for bx, by in batches(x, y, batch_size, rng):
+        for bx, by in prefetch_to_device(batches(x, y, batch_size, rng)):
             params, opt_state, loss = train_step(params, opt_state, bx, by)
             losses.append(loss)
-        accs = [eval_step(params, bx, by) for bx, by in batches(x_test, y_test, batch_size, rng)]
+        accs = [
+            eval_step(params, bx, by)
+            for bx, by in prefetch_to_device(batches(x_test, y_test, batch_size, rng))
+        ]
         if not accs and len(x_test):  # test split smaller than one batch
             accs = [eval_step(params, x_test, y_test)]
         metrics = {

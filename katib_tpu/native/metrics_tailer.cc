@@ -39,13 +39,14 @@ struct Tailer {
 };
 
 inline bool name_char(char c) {
-  // Python's \w is Unicode-aware; treating every UTF-8 continuation/lead
-  // byte (>= 0x80) as a name character keeps multi-byte words a single
-  // token (e.g. "µacc" never splits into a spurious "acc" match). The
-  // Python binding routes experiments with non-ASCII *wanted* names to the
-  // Python tailer, so native only needs to not mis-tokenize such lines.
   unsigned char u = static_cast<unsigned char>(c);
-  return u >= 0x80 || std::isalnum(u) || c == '_' || c == '|' || c == '-';
+  return std::isalnum(u) || c == '_' || c == '|' || c == '-';
+}
+
+inline bool pure_ascii(const std::string& s) {
+  for (char c : s)
+    if (static_cast<unsigned char>(c) >= 0x80) return false;
+  return true;
 }
 
 // Parse the value part of `name = value` starting at s[i]; on success returns
@@ -153,9 +154,20 @@ char* mt_poll(void* handle) {
   while (true) {
     size_t nl = t->partial.find('\n', pos);
     if (nl == std::string::npos) break;
-    // Bytes may be non-UTF8; the parser is byte-oriented like errors=replace.
     std::string line = t->partial.substr(pos, nl - pos);
-    scan_line(*t, line, t->line_index++, out);
+    if (pure_ascii(line)) {
+      scan_line(*t, line, t->line_index++, out);
+    } else {
+      // Non-ASCII line: Python's \w is Unicode-aware and a byte-oriented
+      // matcher cannot reproduce its word boundaries, so hand the raw line
+      // back for the binding to parse with the real regex ('\x02' record:
+      // index \x1F line).
+      out += '\x02';
+      out += std::to_string(t->line_index++);
+      out += '\x1F';
+      out += line;
+      out += '\n';
+    }
     pos = nl + 1;
   }
   t->partial.erase(0, pos);

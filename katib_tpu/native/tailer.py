@@ -46,6 +46,7 @@ def _load_lib():
 class NativeTailer:
     def __init__(self, path: str, metric_names: Sequence[str]):
         self._lib = _load_lib()
+        self._names = list(metric_names)
         names = "\x1f".join(metric_names).encode()
         self._handle = self._lib.mt_open(path.encode(), names)
 
@@ -59,6 +60,19 @@ class NativeTailer:
             self._lib.mt_free(buf)
         out: List[Parsed] = []
         for entry in raw.splitlines():
+            if entry.startswith("\x02"):
+                # non-ASCII line deferred by the kernel: parse with the real
+                # Unicode-aware regex (same path as PyTailer)
+                from ..runtime.metrics import parse_text_lines
+
+                idx_str, _, line = entry[1:].partition("\x1f")
+                for log in parse_text_lines([line], self._names):
+                    try:
+                        float(log.value)
+                    except (TypeError, ValueError):
+                        continue
+                    out.append((log.metric_name, log.value, int(idx_str)))
+                continue
             parts = entry.split("\x1f")
             if len(parts) == 3:
                 out.append((parts[0], parts[1], int(parts[2])))
@@ -138,11 +152,11 @@ def make_tailer(
     filters: Optional[Sequence[str]] = None,
     json_format: bool = False,
 ):
-    """Native tailer for the default-TEXT-filter, ASCII-names case; Python
-    otherwise (custom filters, JSON lines, or Unicode metric names — the
-    C++ matcher is byte-oriented while Python's \\w is Unicode-aware)."""
-    ascii_names = all(n.isascii() for n in metric_names)
-    if not json_format and not filters and ascii_names and tailer_available():
+    """Native tailer for the default-TEXT-filter case; Python otherwise
+    (custom filters or JSON lines). Non-ASCII lines are deferred by the
+    kernel back to the Unicode-aware Python regex, so Unicode metric names
+    and log content parse identically on both paths."""
+    if not json_format and not filters and tailer_available():
         try:
             return NativeTailer(path, metric_names)
         except OSError:

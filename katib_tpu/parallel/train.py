@@ -195,9 +195,10 @@ def run_lm_trial(assignments: Dict[str, str], ctx=None) -> None:
     import contextlib
 
     prof_cm = ctx.profile() if profile else contextlib.nullcontext()
+    # the synthetic batch is constant across steps: stage it once
+    tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
     with prof_cm:
         for i in range(steps):
-            tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
             params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
             if ctx is not None and (i + 1) % 5 == 0:
                 ctx.report(loss=float(loss))

@@ -157,6 +157,37 @@ class HyperBand(Suggester):
         param = HyperBandParam.from_settings(self.settings(request.experiment))
         if param.current_s < 0:
             return SuggestionReply(search_ended=True)
+
+        # Rung sizes follow the request number (reference service.py sets
+        # n = current_request_number), so a transiently under-sized request —
+        # the controller reconciling while a finishing trial is not yet
+        # finalized — would silently shrink the rung. Wait for the full
+        # requestable width: parallelism, or what the trial budget still
+        # allows (a budget-capped request is legitimate and shrinks the
+        # bracket gracefully). Early-stopped trials without an objective
+        # observation permanently reduce the controller's request total
+        # (experiment.py requests math), so they reduce the expected width
+        # too — otherwise this guard would deadlock waiting for a request
+        # size that can never arrive.
+        from ..db.store import objective_value
+
+        obj = request.experiment.objective
+        incomplete_es = sum(
+            1
+            for t in request.trials
+            if t.condition == TrialCondition.EARLY_STOPPED
+            and objective_value(t.observation, obj) is None
+        )
+        parallel = request.experiment.parallel_trial_count or 1
+        max_t = request.experiment.max_trial_count
+        budget_left = (max_t - len(request.trials)) if max_t else parallel
+        full_width = max(1, min(parallel, budget_left) - incomplete_es)
+        if request.current_request_number < full_width:
+            raise TrialsNotCompleted(
+                f"hyperband request for {request.current_request_number} < "
+                f"{full_width} requestable slots; waiting for the full width "
+                "so rung sizes stay deterministic"
+            )
         param.n = max(request.current_request_number, 1)
 
         space = self.search_space(request.experiment)

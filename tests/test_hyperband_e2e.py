@@ -125,3 +125,55 @@ def test_hyperband_budget_cap_shrinks_gracefully(controller):
     assert len(trials) == 9
     assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
     assert exp.status.current_optimal_trial is not None
+
+
+def test_full_width_guard_accounts_for_incomplete_early_stopped():
+    """The guard that waits for full-width requests must subtract
+    early-stopped trials lacking an objective observation — the controller
+    permanently excludes them from its request total (experiment.py), so
+    waiting for the unreduced width would deadlock the experiment."""
+    from katib_tpu.suggest.base import SuggestionRequest, create
+    from katib_tpu.api.status import Trial
+
+    spec = ExperimentSpec(
+        name="hb-guard",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ParameterSpec("budget", ParameterType.INT, FeasibleSpace(min="1", max="4")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec(
+            "hyperband",
+            algorithm_settings=[
+                AlgorithmSetting("eta", "2"),
+                AlgorithmSetting("r_l", "4"),
+                AlgorithmSetting("resource_name", "budget"),
+            ],
+        ),
+        trial_template=TrialTemplate(function=_trial),
+        max_trial_count=40,
+        parallel_trial_count=4,
+    )
+    suggester = create("hyperband")
+
+    es_trial = Trial(name="hb-guard-es", experiment_name="hb-guard")
+    es_trial.condition = TrialCondition.EARLY_STOPPED  # no observation
+
+    # width 4 reduced by 1 incomplete-ES trial -> a request of 3 proceeds
+    reply = suggester.get_suggestions(
+        SuggestionRequest(
+            experiment=spec, trials=[es_trial], current_request_number=3
+        )
+    )
+    assert len(reply.assignments) == 3
+
+    # but a transiently short request (2 < 3) still waits
+    from katib_tpu.suggest.hyperband import TrialsNotCompleted
+
+    suggester2 = create("hyperband")
+    with pytest.raises(TrialsNotCompleted):
+        suggester2.get_suggestions(
+            SuggestionRequest(
+                experiment=spec, trials=[es_trial], current_request_number=2
+            )
+        )

@@ -35,7 +35,8 @@ TRICKY = [
     '{"json": "looking", "loss": 9}',  # TEXT mode: no = pair, ignored
     "loss=1.5e acc=2.",           # dangling exponent/dot: value stops early
     "loss=+ acc=0.3",             # bare sign: dropped by both tailers
-    "µacc=0.9 loss=0.7",          # multi-byte word stays one (unwanted) token
+    "µacc=0.9 loss=0.7",          # non-ASCII line: deferred to the py regex
+    "…loss=0.6",                  # unicode punctuation boundary before name
 ]
 
 
@@ -80,8 +81,19 @@ class TestParity:
         assert isinstance(make_tailer(p, ["m"]), native_cls)
         assert isinstance(make_tailer(p, ["m"], filters=[r"(\w+):(\d+)"]), PyTailer)
         assert isinstance(make_tailer(p, ["m"], json_format=True), PyTailer)
-        # Unicode metric names need Python's Unicode-aware \w
-        assert isinstance(make_tailer(p, ["précision"]), PyTailer)
+
+    def test_unicode_metric_name_parity(self, native_cls, tmp_path):
+        """Non-ASCII lines are deferred to the Unicode-aware Python regex,
+        so Unicode metric names parse identically on both tailers."""
+        p = str(tmp_path / "u.log")
+        _write(p, ["précision=0.75 loss=0.1", "loss=0.2"])
+        nat = native_cls(p, ["précision", "loss"])
+        py = PyTailer(p, ["précision", "loss"])
+        got_n, got_p = nat.poll(), py.poll()
+        nat.close()
+        assert got_n == got_p
+        assert ("précision", "0.75", 0) in got_n
+        assert ("loss", "0.2", 1) in got_n
 
 
 class TestExecutorIntegration:

@@ -269,3 +269,39 @@ class TestPipelineParallel:
         mesh2 = make_mesh(devices, pipe=2, model=2)
         with pytest.raises(ValueError):
             make_pipeline_lm_train_step(cfg, mesh2)
+
+
+class TestPrefetch:
+    """Device-prefetching input pipeline (katib_tpu.utils.prefetch)."""
+
+    def test_prefetch_stages_and_preserves_order(self, devices):
+        import numpy as onp
+
+        from katib_tpu.utils.prefetch import prefetch_to_device
+
+        src = [(onp.full((2, 2), i, dtype="float32"), onp.array([i])) for i in range(7)]
+        out = list(prefetch_to_device(iter(src), size=3))
+        assert len(out) == 7
+        for i, (bx, by) in enumerate(out):
+            assert isinstance(bx, jnp.ndarray)
+            assert float(bx[0, 0]) == i and int(by[0]) == i
+
+    def test_prefetch_with_sharding(self, devices):
+        import numpy as onp
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from katib_tpu.utils.prefetch import prefetch_to_device
+
+        mesh = make_mesh(devices)
+        sharding = NamedSharding(mesh, P("data"))
+        src = [onp.ones((8, 4), dtype="float32") for _ in range(3)]
+        out = list(prefetch_to_device(iter(src), sharding=sharding))
+        assert len(out) == 3
+        assert out[0].sharding == sharding
+
+    def test_prefetch_empty_and_short(self, devices):
+        from katib_tpu.utils.prefetch import prefetch_to_device
+
+        assert list(prefetch_to_device(iter([]))) == []
+        assert len(list(prefetch_to_device(iter([jnp.ones(2)]), size=4))) == 1
