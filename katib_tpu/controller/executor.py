@@ -40,7 +40,6 @@ from ..runtime.metrics import (
     ENV_TRIAL_NAME,
     EarlyStopped,
     EarlyStoppingMonitor,
-    MetricsReporter,
     TrialKilled,
     parse_json_lines,
     parse_text_lines,

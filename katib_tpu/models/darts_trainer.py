@@ -28,8 +28,7 @@ from __future__ import annotations
 
 import json
 import time
-from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

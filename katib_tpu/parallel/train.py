@@ -10,7 +10,7 @@ sequence parallelism ('seq').
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +19,6 @@ import optax
 from ..models.transformer import (
     TransformerConfig,
     TransformerLM,
-    param_spec_tree,
     param_sharding_rules,
 )
 

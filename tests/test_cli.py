@@ -1,0 +1,90 @@
+"""CLI lifecycle: run a spec end-to-end from the terminal surface, then
+inspect it with every read subcommand (reference UI REST surface,
+cmd/ui/v1beta1/main.go:42-75, terminal-first)."""
+
+import json
+import sys
+
+import pytest
+
+from katib_tpu.cli import main
+
+
+@pytest.fixture
+def spec_path(tmp_path):
+    # subprocess trial: prints its own lr as the loss (fast + deterministic)
+    spec = {
+        "name": "cli-e2e",
+        "parameters": [
+            {
+                "name": "lr",
+                "parameterType": "double",
+                "feasibleSpace": {"min": "0.1", "max": "0.9"},
+            }
+        ],
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "random", "algorithmSettings": []},
+        "trialTemplate": {
+            "command": [
+                sys.executable,
+                "-c",
+                "print('loss=${trialParameters.lr}')",
+            ],
+            "trialParameters": [{"name": "lr", "reference": "lr"}],
+        },
+        "maxTrialCount": 3,
+        "parallelTrialCount": 2,
+    }
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    return str(p)
+
+
+def test_cli_full_lifecycle(spec_path, tmp_path, capsys):
+    root = str(tmp_path / "root")
+
+    rc = main(["--root", root, "run", spec_path, "--timeout", "120"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "cli-e2e" in out and "3 succeeded" in out
+    assert "best:" in out
+
+    assert main(["--root", root, "list"]) == 0
+    out = capsys.readouterr().out
+    assert "cli-e2e" in out and "Succeeded" in out
+
+    assert main(["--root", root, "status", "cli-e2e"]) == 0
+    out = capsys.readouterr().out
+    assert "MaxTrialsReached" in out
+
+    assert main(["--root", root, "trials", "cli-e2e"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("cli-e2e-") == 3  # one row per trial
+    assert "loss=" in out
+
+    # raw observation log for the best trial (first trial row)
+    trial_name = next(
+        line.split()[0] for line in out.splitlines() if line.startswith("cli-e2e-")
+    )
+    assert main(["--root", root, "metrics", trial_name]) == 0
+    out = capsys.readouterr().out
+    assert "loss" in out
+
+    assert main(["--root", root, "algorithms"]) == 0
+    out = capsys.readouterr().out
+    assert "hyperband" in out and "medianstop" in out
+
+
+def test_cli_rejects_invalid_spec(tmp_path, capsys):
+    bad = {"name": "bad", "algorithm": {"algorithmName": "nope"}}
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    rc = main(["--root", str(tmp_path / "root"), "run", str(p)])
+    assert rc == 2
+    assert "invalid experiment spec" in capsys.readouterr().err
+
+
+def test_cli_status_unknown_experiment(tmp_path, capsys):
+    rc = main(["--root", str(tmp_path / "root"), "status", "ghost"])
+    assert rc == 1
+    assert "not found" in capsys.readouterr().err
