@@ -397,6 +397,152 @@ def flash_attention(
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Like flash_attention but also returns the per-row logsumexp
+    ([B, T, H], f32) so partial attentions over different K/V blocks can be
+    merged exactly — the primitive ring attention builds on (each ring step
+    attends the local Q against one rotating K/V block, then folds the
+    normalized block output into the running result via the lse weights).
+
+    Differentiation note: the merge path re-derives gradients through the
+    *fallback* expression; the Pallas fast path is forward-only here, so
+    callers that need gradients under jit on TPU go through the dense
+    fallback math (ring attention's callers differentiate the merged
+    expression, which XLA fuses per block anyway).
+    """
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    if causal and tk != t:
+        raise ValueError(
+            f"causal flash_attention_with_lse needs equal q/k lengths, got {t} vs {tk}"
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    use_kernel = False
+    bq = min(block_q, t) if block_q else _auto_block(t, 1024)
+    bk = min(block_k, tk) if block_k else _auto_block(tk, 1024)
+    if (
+        tk == t  # the kernel grid assumes equal q/kv lengths
+        and bq and bk and t % bq == 0 and tk % bk == 0 and t >= 16
+    ):
+        use_kernel = interpret is True or (interpret is not False and _on_tpu())
+
+    if use_kernel:
+        def to_bhtd(x):
+            return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+        o, lse = _fwd(
+            to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, float(sm_scale),
+            bq, bk, bool(interpret or False),
+        )
+        o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        lse = lse.reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
+        return o, lse
+
+    # dense fallback with explicit lse (differentiable everywhere); f32 dots
+    # request HIGHEST so the TPU MXU decomposition keeps f32 fidelity
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / l).astype(q.dtype), v,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    lse = (m + jnp.log(l))[..., 0].transpose(0, 2, 1)  # [B, T, H]
+    return o, lse
+
+
+def flash_block_grads(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    o: jnp.ndarray,
+    lse: jnp.ndarray,
+    do: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-block gradients against a GLOBAL logsumexp: with p = exp(s - lse)
+    every K/V block's (dq, dk, dv) contribution is independent, so ring
+    attention's backward can call this once per rotation. Layout
+    [B, T, H, D]; lse [B, T, H] f32. Uses the Pallas _bwd kernels on TPU
+    (scores never materialize), dense f32 math elsewhere."""
+    b, t, h, d = q.shape
+    if k.shape[1] != t:
+        raise ValueError("flash_block_grads needs equal q/k block lengths")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    bq = _auto_block(t, 1024)
+    if bq and t % bq == 0 and t >= 16 and _on_tpu():
+        def to_bhtd(x):
+            return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
+
+        lse_bhtd = lse.transpose(0, 2, 1).reshape(b * h, t, 1)
+        dq, dk, dv = _bwd(
+            to_bhtd(q), to_bhtd(k), to_bhtd(v), to_bhtd(o), lse_bhtd,
+            to_bhtd(do), causal, float(sm_scale), bq, bq, False,
+        )
+        back = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        return back(dq), back(dk), back(dv)
+
+    prec = jax.lax.Precision.HIGHEST
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf, precision=prec) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jnp.exp(s - lse.transpose(0, 2, 1)[..., None])
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)          # [B, T, H]
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf, precision=prec)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf, precision=prec)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf, precision=prec)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof, precision=prec)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def merge_attention_blocks(
+    o1: jnp.ndarray, lse1: jnp.ndarray, o2: jnp.ndarray, lse2: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold two normalized partial attentions (over disjoint K/V blocks) into
+    one: o = softmax-weighted combination, lse = log(e^lse1 + e^lse2).
+    o: [B, T, H, D]; lse: [B, T, H] f32. Fully-masked partials carry
+    lse = -inf and drop out exactly."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isinf(m) & (m < 0), 0.0, m)  # both -inf: avoid nan
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    o = (
+        o1.astype(jnp.float32) * (w1 / denom)[..., None]
+        + o2.astype(jnp.float32) * (w2 / denom)[..., None]
+    ).astype(o1.dtype)
+    lse = m_safe + jnp.log(denom)
+    lse = jnp.where(jnp.isinf(m) & (m < 0), NEG_INF, lse)
+    return o, lse
+
+
 def sharded_flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

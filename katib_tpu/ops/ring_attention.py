@@ -32,58 +32,126 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _block_attn_update(q, k_blk, v_blk, o, m, l, q_offset, k_offset, causal, scale):
-    """One ring step: accumulate attention of local q against one K/V block.
+def _ring_fwd_loop(q, k, v, axis_name: str, causal: bool):
+    """Forward ring: per step, flash-attend local Q against the held K/V
+    block (Pallas kernel on TPU, dense+lse fallback elsewhere) and fold the
+    normalized block output into the running result by logsumexp weights.
+    Returns (o [B,T,H,D], lse [B,T,H])."""
+    from .flash_attention import flash_attention_with_lse, merge_attention_blocks
 
-    q: [B, Tq, H, D]; k_blk/v_blk: [B, Tk, H, D]; o: [B, Tq, H, D];
-    m, l: [B, H, Tq].
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # [B, H, Tq, Tk]
-    if causal:
-        tq, tk = q.shape[1], k_blk.shape[1]
-        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where((k_pos > q_pos)[None, None], NEG_INF, s)
-    m_new = jnp.maximum(m, s.max(axis=-1))          # [B, H, Tq]
-    # guard fully-masked rows (m_new == NEG_INF): exp underflows to 0 safely
-    p = jnp.exp(s - m_new[..., None])
-    correction = jnp.exp(m - m_new)
-    l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
-    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
-    return o_new, m_new, l_new
+    p_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def masked_block():
+        return (
+            jnp.zeros_like(q),
+            jnp.full_like(q[..., 0], NEG_INF).astype(jnp.float32),
+        )
+
+    def body(i, carry):
+        k_blk, v_blk, o, lse = carry
+        src = (my_idx - i) % p_size  # block index currently held
+        if causal:
+            o_b, lse_b = jax.lax.cond(
+                src == my_idx,
+                lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=True),
+                lambda: jax.lax.cond(
+                    src < my_idx,
+                    lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=False),
+                    masked_block,  # strictly-future block: contributes nothing
+                ),
+            )
+        else:
+            o_b, lse_b = flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+        o, lse = merge_attention_blocks(o, lse, o_b, lse_b)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, o, lse
+
+    # accumulators derived from q so they inherit its varying-manual-axes
+    # type under shard_map (fresh constants would mismatch the loop carry)
+    o0, lse0 = masked_block()
+    _, _, o, lse = jax.lax.fori_loop(0, p_size, body, (k, v, o0, lse0))
+    return o, lse
+
+
+def _ring_bwd_loop(q, k, v, o, lse, do, axis_name: str, causal: bool):
+    """Backward ring (standard flash/ring backward): with the global
+    logsumexp, every block's gradient contribution is independent
+    (p = exp(s - lse); ds = p * (dp - delta)), computed per rotation by
+    flash_block_grads — Pallas _bwd kernels on TPU, dense f32 math at
+    HIGHEST precision elsewhere. dq accumulates locally; per-block dk/dv
+    accumulators rotate with their block and arrive home after a full
+    rotation. Strictly-future blocks are skipped in the causal case (their
+    p is identically zero)."""
+    from .flash_attention import flash_block_grads
+
+    p_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def body(i, carry):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        src = (my_idx - i) % p_size
+
+        def block(blk_causal):
+            return lambda: flash_block_grads(
+                q, k_blk, v_blk, o, lse, do, causal=blk_causal
+            )
+
+        if causal:
+            dq_c, dk_c, dv_c = jax.lax.cond(
+                src == my_idx,
+                block(True),
+                lambda: jax.lax.cond(
+                    src < my_idx,
+                    block(False),
+                    # strictly-future block: p == 0 everywhere, skip compute
+                    lambda: (jnp.zeros_like(q), jnp.zeros_like(k_blk),
+                             jnp.zeros_like(v_blk)),
+                ),
+            )
+        else:
+            dq_c, dk_c, dv_c = block(False)()
+        dq = dq + dq_c.astype(dq.dtype)
+        dk_blk = dk_blk + dk_c.astype(dk_blk.dtype)
+        dv_blk = dv_blk + dv_c.astype(dv_blk.dtype)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return k_blk, v_blk, dk_blk, dv_blk, dq
+
+    zeros = jnp.zeros_like(q.astype(jnp.float32))
+    _, _, dk, dv, dq = jax.lax.fori_loop(
+        0, p_size, body, (k, v, zeros, zeros, zeros)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
     """Body to run INSIDE shard_map over ``axis_name``: local blocks of
-    q/k/v shaped [B, T_local, H, D]."""
-    p_size = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
-    b, t_local, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    q_offset = my_idx * t_local
+    q/k/v shaped [B, T_local, H, D]. Forward uses the Pallas flash kernel
+    per block on TPU; the custom VJP runs the ring backward from the saved
+    global logsumexp, so the O(T^2) score matrix never materializes across
+    the whole sequence in either direction."""
 
-    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    @jax.custom_vjp
+    def ring(q, k, v):
+        o, _ = _ring_fwd_loop(q, k, v, axis_name, causal)
+        return o
 
-    def body(i, carry):
-        k_blk, v_blk, o, m, l = carry
-        src = (my_idx - i) % p_size            # block index currently held
-        o, m, l = _block_attn_update(
-            q, k_blk, v_blk, o, m, l, q_offset, src * t_local, causal, scale
-        )
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, o, m, l
+    def ring_fwd(q, k, v):
+        o, lse = _ring_fwd_loop(q, k, v, axis_name, causal)
+        return o, (q, k, v, o, lse)
 
-    o0 = jnp.zeros_like(q)
-    # Derive the accumulators from q so they inherit its varying-manual-axes
-    # type (fresh constants would mismatch the loop carry under shard_map).
-    base = q[:, :, :, 0].transpose(0, 2, 1)  # [B, H, Tq], varying like q
-    m0 = jnp.full_like(base, NEG_INF)
-    l0 = jnp.zeros_like(base)
-    _, _, o, m, l = jax.lax.fori_loop(0, p_size, body, (k, v, o0, m0, l0))
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return o / denom
+    def ring_bwd(res, do):
+        q, k, v, o, lse = res
+        return _ring_bwd_loop(q, k, v, o, lse, do, axis_name, causal)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(q, k, v)
 
 
 def dense_attention(q, k, v, causal: bool = False):
@@ -125,5 +193,6 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=False,  # pallas_call outputs carry no vma annotation
     )
     return fn(q, k, v)

@@ -18,12 +18,19 @@ _initialized = False
 
 
 def enable_compilation_cache(directory: Optional[str] = None) -> str:
-    """Idempotently enable the persistent cache; returns the cache dir."""
+    """Idempotently enable the persistent cache; returns the cache dir.
+
+    Accelerator backends only: XLA:CPU persists AOT results keyed loosely
+    enough that entries written on a host with different CPU features load
+    with a SIGILL warning — and CPU compiles are cheap anyway."""
     global _initialized
     import jax
 
     cache_dir = directory or os.environ.get("KATIB_TPU_XLA_CACHE", _DEFAULT_DIR)
     if _initialized:
+        return cache_dir
+    if jax.default_backend() == "cpu":
+        _initialized = True
         return cache_dir
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)

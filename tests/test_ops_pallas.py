@@ -95,3 +95,50 @@ def test_auto_block_lane_aligned():
     for t in (256, 512, 1024, 4096, 8192):
         b = _auto_block(t, 1024)
         assert b is not None and b % 128 == 0 and t % b == 0
+
+
+def test_with_lse_merge_equals_full_attention():
+    """Splitting K/V into blocks, attending each with flash_attention_with_lse
+    and folding via merge_attention_blocks must equal attention over the full
+    sequence — the invariant ring attention is built on."""
+    from katib_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        merge_attention_blocks,
+    )
+
+    rng = np.random.default_rng(3)
+    b, t, h, d = 2, 64, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+
+    full = dense_attention(q, k, v, causal=False)
+
+    o1, l1 = flash_attention_with_lse(q, k[:, : t // 2], v[:, : t // 2])
+    o2, l2 = flash_attention_with_lse(q, k[:, t // 2 :], v[:, t // 2 :])
+    merged, lse = merge_attention_blocks(o1, l1, o2, l2)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full), atol=2e-5, rtol=2e-5)
+
+    # merging with a fully-masked partial is the identity
+    masked_o = jnp.zeros_like(o1)
+    masked_l = jnp.full_like(l1, -1e30)
+    same, same_l = merge_attention_blocks(merged, lse, masked_o, masked_l)
+    np.testing.assert_allclose(np.asarray(same), np.asarray(merged), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(same_l), np.asarray(lse), atol=1e-6)
+
+
+def test_with_lse_kernel_matches_fallback_interpret():
+    """The Pallas path of flash_attention_with_lse (interpret mode off-TPU)
+    must produce the same (o, lse) as the dense fallback."""
+    from katib_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.default_rng(4)
+    b, t, h, d = 1, 128, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+    for causal in (False, True):
+        o_ref, l_ref = flash_attention_with_lse(q, k, v, causal=causal, interpret=False)
+        o_k, l_k = flash_attention_with_lse(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_ref), atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_ref), atol=2e-5, rtol=2e-5)

@@ -305,3 +305,34 @@ class TestPrefetch:
 
         assert list(prefetch_to_device(iter([]))) == []
         assert len(list(prefetch_to_device(iter([jnp.ones(2)]), size=4))) == 1
+
+
+class TestRingFlashKernelPath:
+    """Force the Pallas kernel (interpret mode) inside the ring loop on the
+    CPU mesh — the TPU-path plumbing (flash_attention_with_lse +
+    merge_attention_blocks + flash_block_grads under shard_map/fori_loop/
+    cond) that off-TPU defaults would otherwise never exercise."""
+
+    def test_ring_with_kernel_blocks_matches_dense(self, devices, monkeypatch):
+        import functools as ft
+
+        from katib_tpu.ops import flash_attention as fa
+
+        orig_lse = fa.flash_attention_with_lse
+        monkeypatch.setattr(
+            fa, "flash_attention_with_lse", ft.partial(orig_lse, interpret=True)
+        )
+
+        mesh = make_mesh(devices, seq=2)  # data=4, seq=2
+        rng = np.random.default_rng(7)
+        b, t, h, d = 4, 256, 2, 8  # t_local=128: kernel-eligible block
+        q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype=jnp.float32)
+
+        for causal in (False, True):
+            expected = dense_attention(q, k, v, causal=causal)
+            got = ring_attention(q, k, v, mesh, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(expected), atol=2e-5, rtol=2e-5
+            )
