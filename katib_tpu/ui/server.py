@@ -64,6 +64,8 @@ a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
 <div id="exps">loading...</div>
 <h2 id="selname"></h2><div id="trials"></div>
 <pre id="logbox"></pre>
+<div id="nasbox" style="display:none"><h2>architectures (NAS)</h2><div id="nas"></div></div>
+<div id="evbox" style="display:none"><h2>events</h2><div id="events"></div></div>
 <h2>trial templates</h2><div id="templates" class="muted">loading...</div>
 <script>
 async function j(u){return (await fetch(u)).json()}
@@ -105,7 +107,42 @@ async function sel(n){
   a.onclick=async(ev)=>{ev.preventDefault();
    const r=await fetch(`/api/experiments/${encodeURIComponent(a.dataset.exp)}/trials/${encodeURIComponent(a.dataset.trial)}/logs`);
    const b=document.getElementById('logbox');
-   b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}}
+   b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}
+ loadNas(n);loadEvents(n)}
+function archSvg(g){
+ const n=g.nodes.length,w=Math.max(n*90,90),h=86;
+ let s=`<svg width="${w}" height="${h}">`;
+ for(const e of g.edges){
+  const x1=e.from*90+35,x2=e.to*90+35;
+  if(e.skip){const mx=(x1+x2)/2;
+   s+=`<path d="M ${x1} 38 Q ${mx} ${8+4*((e.to-e.from)%3)} ${x2} 38" fill="none" stroke="#7b5ea7" stroke-dasharray="3,2"/>`;}
+  else s+=`<line x1="${x1+30}" y1="50" x2="${x2-30}" y2="50" stroke="#999"/>`;}
+ g.nodes.forEach((nd,i)=>{const x=i*90+35;
+  s+=`<rect x="${x-30}" y="40" width="60" height="22" rx="5" fill="#eef2fb" stroke="#0b57d0"/>`+
+     `<text x="${x}" y="55" text-anchor="middle" font-size="9">${esc(String(nd.label).slice(0,12))}</text>`;});
+ return s+'</svg>'}
+async function loadNas(n){
+ const box=document.getElementById('nasbox');
+ try{
+  const g=await j(`/api/experiments/${encodeURIComponent(n)}/nas`);
+  if(CUR!==n)return; // a newer selection won the race
+  if(!g.architectures||!g.architectures.length){box.style.display='none';return}
+  box.style.display='block';
+  document.getElementById('nas').innerHTML=g.architectures.map(a=>
+   `<div><span class="muted">${esc(a.trial)} — objective ${esc(a.objective??'n/a')}</span><br>${archSvg(a)}</div>`).join('');
+ }catch(e){box.style.display='none'}}
+async function loadEvents(n){
+ const box=document.getElementById('evbox');
+ try{
+  const es=await j(`/api/experiments/${encodeURIComponent(n)}/events?limit=15`);
+  if(CUR!==n)return;
+  if(!es.length){box.style.display='none';return}
+  box.style.display='block';
+  document.getElementById('events').innerHTML=table(es.reverse().map(e=>({
+   time:new Date(e.timestamp*1000).toLocaleTimeString(),type:esc(e.type),
+   reason:esc(e.reason),object:esc(`${e.kind||''}/${e.name||''}`),message:esc(e.message)})),
+   ['time','type','reason','object','message']);
+ }catch(e){box.style.display='none'}}
 async function loadTemplates(){
  const t=await j('/api/templates');
  const names=Object.keys(t);
@@ -270,7 +307,13 @@ class _Handler(BaseHTTPRequestHandler):
                         )
                     return self._send(out)
                 if sub == "events":
-                    return self._send([e.to_dict() for e in ctrl.events.list(name)])
+                    from urllib.parse import parse_qs
+
+                    events = [e.to_dict() for e in ctrl.events.list(name)]
+                    limit = parse_qs(urlparse(self.path).query).get("limit", [None])[0]
+                    if limit is not None and limit.isdigit():
+                        events = events[-int(limit):]  # tail: the recent records
+                    return self._send(events)
                 if sub == "suggestion":
                     s = ctrl.state.get_suggestion(name)
                     return self._send(s.to_dict() if s else None)

@@ -101,6 +101,9 @@ class TestUIServer:
         base, _, _ = stack
         status, ctype, body = get(f"{base}/")
         assert status == 200 and "html" in ctype and "katib-tpu" in body
+        # detail panels: metric sparklines, NAS architecture SVGs, events
+        for fn in ("function spark", "function archSvg", "loadNas", "loadEvents"):
+            assert fn in body, f"dashboard missing {fn}"
         import urllib.error
 
         with pytest.raises(urllib.error.HTTPError) as ei:
