@@ -305,10 +305,16 @@ def _bench_flash_vs_dense(jax, np):
 
     flash_s = timeit(flash)
     dense_s = timeit(dense)
+    # numerics evidence on the same compiled kernels (bf16 tolerance)
+    max_err = float(
+        jnp.max(jnp.abs(flash(q, k, v).astype(jnp.float32)
+                        - dense(q, k, v).astype(jnp.float32)))
+    )
     return {
         "flash_ms": flash_s * 1e3,
         "dense_ms": dense_s * 1e3,
         "speedup": dense_s / flash_s,
+        "max_err_vs_dense": round(max_err, 4),
         "shape": f"b{b} t{t} h{h} d{d} bf16 causal",
     }
 
@@ -357,6 +363,7 @@ def child_main(platform: str) -> None:
             "flash_ms": round(flash["flash_ms"], 3),
             "dense_ms": round(flash["dense_ms"], 3),
             "speedup": round(flash["speedup"], 2),
+            "max_err_vs_dense": flash["max_err_vs_dense"],
             "shape": flash["shape"],
         }
     print(json.dumps({
