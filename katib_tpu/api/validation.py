@@ -229,6 +229,36 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
                 f"trialParameters[{tp.name}]: reference {tp.reference!r} not found in search space"
             )
 
+    # success/failure condition expressions must parse and reference only the
+    # trial terminal-state names (controller/conditions.py; the reference
+    # validates its GJSON success/failure conditions in validator.go)
+    from ..controller.conditions import ConditionError, parse_condition
+
+    for cond_field, expr in (
+        ("successCondition", t.success_condition),
+        ("failureCondition", t.failure_condition),
+    ):
+        if expr:
+            try:
+                tree = parse_condition(expr)
+            except ConditionError as e:
+                errs.append(f"trialTemplate.{cond_field}: {e}")
+                continue
+            if t.command is None:
+                # in-process trials capture no stdout — a stdout-based
+                # condition would silently never match
+                import ast as _ast
+
+                if any(
+                    isinstance(n, _ast.Name) and n.id == "stdout"
+                    for n in _ast.walk(tree)
+                ):
+                    errs.append(
+                        f"trialTemplate.{cond_field}: 'stdout' is only "
+                        "available for command templates (in-process trials "
+                        "capture no stdout)"
+                    )
+
     if t.command is not None:
         text = "\n".join(t.command)
         used = set(TRIAL_PARAM_RE.findall(text))

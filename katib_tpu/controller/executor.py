@@ -61,6 +61,10 @@ class TrialOutcome(str, Enum):
 class ExecutionResult:
     outcome: TrialOutcome
     message: str = ""
+    # terminal state exposed to trial success/failure condition expressions
+    # (controller/conditions.py; reference job_util.go:59-120)
+    exit_code: Optional[int] = None
+    stdout_path: Optional[str] = None
 
 
 def render_command(template: TrialTemplate, trial: Trial) -> List[str]:
@@ -162,13 +166,15 @@ class InProcessExecutor:
                 return ExecutionResult(TrialOutcome.EARLY_STOPPED)
             if handle.kill_requested:
                 return ExecutionResult(TrialOutcome.KILLED, "kill requested")
-            return ExecutionResult(TrialOutcome.COMPLETED)
+            return ExecutionResult(TrialOutcome.COMPLETED, exit_code=0)
         except EarlyStopped:
             return ExecutionResult(TrialOutcome.EARLY_STOPPED)
         except TrialKilled:
             return ExecutionResult(TrialOutcome.KILLED, "kill requested")
         except Exception:
-            return ExecutionResult(TrialOutcome.FAILED, traceback.format_exc(limit=10))
+            return ExecutionResult(
+                TrialOutcome.FAILED, traceback.format_exc(limit=10), exit_code=1
+            )
         finally:
             from ..runtime import metrics as _m
 
@@ -236,11 +242,18 @@ class SubprocessExecutor:
         self._drain_pushed(trial)
 
         if outcome is not None:
+            outcome.exit_code = proc.returncode
+            outcome.stdout_path = stdout_path
             return outcome
         if proc.returncode == 0:
-            return ExecutionResult(TrialOutcome.COMPLETED)
+            return ExecutionResult(
+                TrialOutcome.COMPLETED, exit_code=0, stdout_path=stdout_path
+            )
         return ExecutionResult(
-            TrialOutcome.FAILED, f"process exited with code {proc.returncode}"
+            TrialOutcome.FAILED,
+            f"process exited with code {proc.returncode}",
+            exit_code=proc.returncode,
+            stdout_path=stdout_path,
         )
 
     SCRAPE_INTERVAL = 1.0  # seconds between Prometheus scrapes

@@ -381,6 +381,81 @@ class TrialScheduler:
             labels=dict(trial.labels),
         )
 
+    CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions
+
+    def _apply_conditions(
+        self, exp: Experiment, result: ExecutionResult, observation
+    ) -> ExecutionResult:
+        """Trial-defined success/failure predicates over terminal state
+        (controller/conditions.py; reference job_util.go:59-120 — failure
+        checked first, then success, else the default classification)."""
+        template = exp.spec.trial_template
+        if not (template.success_condition or template.failure_condition):
+            return result
+        if result.outcome not in (TrialOutcome.COMPLETED, TrialOutcome.FAILED):
+            return result  # killed / early-stopped are controller-initiated
+        from .conditions import ConditionError, evaluate_condition
+
+        metrics: Dict[str, float] = {}
+        for m in observation.metrics:
+            if m.latest != UNAVAILABLE_METRIC_VALUE:
+                try:
+                    metrics[m.name] = float(m.latest)
+                except ValueError:
+                    pass
+        stdout = ""
+        if result.stdout_path:
+            try:
+                with open(result.stdout_path, "rb") as f:
+                    f.seek(0, 2)
+                    f.seek(max(0, f.tell() - self.CONDITION_STDOUT_TAIL))
+                    stdout = f.read().decode(errors="replace")
+            except OSError:
+                pass
+        state = dict(
+            exit_code=result.exit_code,
+            outcome=result.outcome.value,
+            metrics=metrics,
+            stdout=stdout,
+        )
+        if template.failure_condition:
+            try:
+                if evaluate_condition(template.failure_condition, **state):
+                    return ExecutionResult(
+                        TrialOutcome.FAILED,
+                        f"failure condition met: {template.failure_condition}",
+                        exit_code=result.exit_code,
+                        stdout_path=result.stdout_path,
+                    )
+            except ConditionError as e:
+                log.warning("trial failure condition error: %s", e)
+        if template.success_condition:
+            try:
+                met = evaluate_condition(template.success_condition, **state)
+            except ConditionError as e:
+                met = False
+                log.warning("trial success condition error: %s", e)
+            if met:
+                return ExecutionResult(
+                    TrialOutcome.COMPLETED,
+                    f"success condition met: {template.success_condition}",
+                    exit_code=result.exit_code,
+                    stdout_path=result.stdout_path,
+                )
+            # a finished process produces no further state, so an unmet
+            # success condition is terminal failure (job_util.go would keep
+            # a job Running awaiting more conditions; see conditions.py)
+            msg = f"success condition not met: {template.success_condition}"
+            if result.message:
+                msg += f" ({result.message})"
+            return ExecutionResult(
+                TrialOutcome.FAILED,
+                msg,
+                exit_code=result.exit_code,
+                stdout_path=result.stdout_path,
+            )
+        return result
+
     def _finalize(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> None:
         """Classification mirroring trial_controller_util.go:42-122 +
         observation fold (:124-217)."""
@@ -388,6 +463,7 @@ class TrialScheduler:
         logs = self.obs_store.get_observation_log(trial.name)
         observation = fold_observation(logs, spec.objective.all_metric_names())
         trial.observation = observation
+        result = self._apply_conditions(exp, result, observation)
 
         obj_metric = observation.metric(spec.objective.objective_metric_name)
         metrics_available = (

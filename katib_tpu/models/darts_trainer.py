@@ -17,7 +17,11 @@ JAX re-design:
   mesh ('data'); batch-sharded inputs make XLA insert psum for the gradient
   all-reduce over ICI (multi-chip DARTS, SURVEY.md §7 hard part 1);
 - bfloat16 matmuls via jax.default_matmul_precision can be toggled by the
-  caller; parameters stay f32.
+  caller; parameters stay f32;
+- optimizer hyperparameters (w_lr, alpha_lr, momentum, weight decays) are
+  TRACED arguments of the jitted step, not baked-in constants: every trial of
+  an HPO sweep over them reuses ONE compiled XLA program — no per-trial
+  recompile (the reference pays a fresh CUDA-graph warmup per trial process).
 
 Entry point ``run_darts_trial(assignments, ctx)`` consumes the suggestion's
 ``algorithm-settings`` / ``search-space`` / ``num-layers`` JSON assignments
@@ -145,6 +149,23 @@ class DartsSearch:
 
     # ------------------------------------------------------------------
 
+    def _make_w_tx(self, weight_decay, momentum, lr):
+        """SGD momentum + weight decay + clip (run_trial.py w_optim). Pure
+        construction — safe to rebuild inside the traced step with traced
+        hyperparameter values (state structure is value-independent)."""
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.clip_by_global_norm(self.w_grad_clip),
+            optax.sgd(lr, momentum=momentum),
+        )
+
+    def _make_a_tx(self, weight_decay, lr):
+        """Adam(0.5, 0.999) + weight decay (run_trial.py alpha_optim)."""
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.adam(lr, b1=0.5, b2=0.999),
+        )
+
     def build(self, sample_shape: Tuple[int, ...], total_steps: int) -> None:
         from ..utils.modelinit import jitted_init
 
@@ -152,25 +173,24 @@ class DartsSearch:
         params = jitted_init(self.model, key, jnp.zeros((2,) + tuple(sample_shape)))
         self.weights, self.alphas = split_params(params)
 
-        # weights: SGD momentum + cosine decay + clip (run_trial.py w_optim)
-        schedule = optax.cosine_decay_schedule(
-            self.w_lr, max(total_steps, 1), alpha=self.w_lr_min / self.w_lr
-        )
-        self.w_tx = optax.chain(
-            optax.add_decayed_weights(self.w_weight_decay),
-            optax.clip_by_global_norm(self.w_grad_clip),
-            optax.sgd(schedule, momentum=self.w_momentum),
-        )
-        self.w_opt_state = self.w_tx.init(self.weights)
-        self._schedule = schedule
-
-        # alphas: Adam(0.5, 0.999) + weight decay (run_trial.py alpha_optim)
-        self.a_tx = optax.chain(
-            optax.add_decayed_weights(self.alpha_weight_decay),
-            optax.adam(self.alpha_lr, b1=0.5, b2=0.999),
-        )
-        self.a_opt_state = self.a_tx.init(self.alphas)
+        self.total_steps = max(total_steps, 1)
+        self.w_opt_state = self._make_w_tx(
+            self.w_weight_decay, self.w_momentum, self.w_lr
+        ).init(self.weights)
+        self.a_opt_state = self._make_a_tx(
+            self.alpha_weight_decay, self.alpha_lr
+        ).init(self.alphas)
         self.step_idx = 0
+
+        # Traced hyperparameters: HPO trials over these share one compiled
+        # program (the values are runtime scalars, not HLO constants).
+        self.hyper = {
+            "w_lr": jnp.float32(self.w_lr),
+            "w_momentum": jnp.float32(self.w_momentum),
+            "w_weight_decay": jnp.float32(self.w_weight_decay),
+            "alpha_lr": jnp.float32(self.alpha_lr),
+            "alpha_weight_decay": jnp.float32(self.alpha_weight_decay),
+        }
 
         self._search_step = self._compile_step()
         self._eval_step = self._compile_eval()
@@ -195,15 +215,21 @@ class DartsSearch:
 
     def _compile_step(self):
         model = self.model
-        w_momentum, w_weight_decay = self.w_momentum, self.w_weight_decay
-        schedule, w_tx, a_tx = self._schedule, self.w_tx, self.a_tx
+        total_steps = self.total_steps
+        w_lr_min = self.w_lr_min
 
         def momentum_of(opt_state):
             # trace of optax.sgd momentum buffer inside the chain
             return opt_state[2][0].trace
 
-        def step(weights, alphas, w_opt_state, a_opt_state, step_idx, train_batch, valid_batch):
-            xi = schedule(step_idx)
+        def step(weights, alphas, w_opt_state, a_opt_state, step_idx, hyper, train_batch, valid_batch):
+            # cosine decay from the traced base lr (run_trial.py lr_scheduler):
+            # lr(t) = w_lr_min + (w_lr - w_lr_min) * 0.5 * (1 + cos(pi t/T))
+            frac = jnp.clip(step_idx / total_steps, 0.0, 1.0)
+            xi = w_lr_min + (hyper["w_lr"] - w_lr_min) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+            w_tx = self._make_w_tx(hyper["w_weight_decay"], hyper["w_momentum"], xi)
+            a_tx = self._make_a_tx(hyper["alpha_weight_decay"], hyper["alpha_lr"])
+
             # 1) alpha update from the unrolled objective
             dalpha = architect_alpha_grad(
                 model,
@@ -213,8 +239,8 @@ class DartsSearch:
                 train_batch,
                 valid_batch,
                 xi,
-                w_momentum,
-                w_weight_decay,
+                hyper["w_momentum"],
+                hyper["w_weight_decay"],
             )
             a_updates, a_opt_state = a_tx.update(dalpha, a_opt_state, alphas)
             alphas = optax.apply_updates(alphas, a_updates)
@@ -262,6 +288,7 @@ class DartsSearch:
                     self.w_opt_state,
                     self.a_opt_state,
                     self.step_idx,
+                    self.hyper,
                     train_batch,
                     valid_batch,
                 )
@@ -282,6 +309,59 @@ class DartsSearch:
     def genotype(self) -> Dict[str, Any]:
         params = merge_params(self.weights, self.alphas)
         return genotype(params, self.primitives, self.num_nodes)
+
+
+def _search_and_report(search: DartsSearch, train_data, valid_data, ctx) -> float:
+    """Shared epoch loop: alternate bilevel updates, validate, report
+    per-epoch metrics (run_trial.py train loop + print format)."""
+    rng = np.random.default_rng(0)
+    best_acc = 0.0
+    for _epoch in range(search.num_epochs):
+        loss = search.train_epoch(train_data, valid_data, rng)
+        acc = search.validate(valid_data, rng)
+        best_acc = max(best_acc, acc)
+        if ctx is not None:
+            ctx.report(**{"Validation-accuracy": acc, "Train-loss": loss})
+        else:
+            print(f"Validation-accuracy={acc}")
+            print(f"Train-loss={loss}")
+    return best_acc
+
+
+DARTS_HPO_DEFAULT_PRIMITIVES = (
+    "separable_convolution_3x3",
+    "max_pooling_3x3",
+    "skip_connection",
+)
+
+
+def run_darts_hpo_trial(assignments: Dict[str, str], ctx=None, **overrides) -> None:
+    """HPO entry point: assignments are individual DartsSearch settings
+    (w_lr, alpha_lr, w_momentum, ...) from an HPO suggester (tpe/random/...),
+    not the darts suggester's config payload. This is the reference's
+    pytorch-mnist-style HPO matrix applied to the DARTS workload — and
+    because optimizer hyperparameters are traced (see DartsSearch), every
+    trial of the sweep reuses one compiled search step."""
+    settings: Dict[str, Any] = dict(assignments)
+    settings.update(overrides)
+    num_layers = int(settings.pop("num_layers", 3))
+    primitives = settings.pop("primitives", list(DARTS_HPO_DEFAULT_PRIMITIVES))
+    n_train = int(settings.pop("num_train_examples", 0) or 0) or None
+    mesh = None
+    if ctx is not None and len(ctx.jax_devices()) > 1:
+        mesh = ctx.mesh(axis_names=("data",))
+
+    x, y = load_cifar10("train", n=n_train)
+    half = len(x) // 2
+    train_data, valid_data = (x[:half], y[:half]), (x[half:], y[half:])
+
+    search = DartsSearch(
+        primitives=primitives, num_layers=num_layers, settings=settings, mesh=mesh
+    )
+    steps_per_epoch = max(half // search.batch_size, 1)
+    search.build(x.shape[1:], steps_per_epoch * search.num_epochs)
+    best_acc = _search_and_report(search, train_data, valid_data, ctx)
+    print(f"Best-accuracy={best_acc}")
 
 
 def run_darts_trial_scaled(assignments: Dict[str, str], ctx=None, **overrides) -> None:
@@ -321,18 +401,7 @@ def run_darts_trial(assignments: Dict[str, str], ctx=None) -> None:
     )
     steps_per_epoch = max(half // search.batch_size, 1)
     search.build(x.shape[1:], steps_per_epoch * search.num_epochs)
-
-    rng = np.random.default_rng(0)
-    best_acc = 0.0
-    for epoch in range(search.num_epochs):
-        loss = search.train_epoch(train_data, valid_data, rng)
-        acc = search.validate(valid_data, rng)
-        best_acc = max(best_acc, acc)
-        if ctx is not None:
-            ctx.report(**{"Validation-accuracy": acc, "Train-loss": loss})
-        else:
-            print(f"Validation-accuracy={acc}")
-            print(f"Train-loss={loss}")
+    best_acc = _search_and_report(search, train_data, valid_data, ctx)
     gene = search.genotype()
     # reference run_trial.py prints the best accuracy + genotype at the end
     print(f"Best-accuracy={best_acc}")

@@ -1,0 +1,234 @@
+"""Trial success/failure condition semantics (controller/conditions.py),
+the TPU-native counterpart of the reference's GJSON job conditions
+(pkg/controller.v1beta1/trial/util/job_util.go:59-120): failure checked
+first, then success, else the default exit-code classification."""
+
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialParameterSpec,
+    TrialTemplate,
+    ValidationError,
+)
+from katib_tpu.api.status import TrialCondition
+from katib_tpu.controller.conditions import (
+    ConditionError,
+    evaluate_condition,
+    parse_condition,
+)
+from katib_tpu.controller.experiment import ExperimentController
+
+
+class TestConditionExpressions:
+    def _eval(self, expr, **over):
+        state = dict(
+            exit_code=0,
+            outcome="completed",
+            metrics={"accuracy": 0.92, "loss": 0.08},
+            stdout="epoch 3 done\naccuracy=0.92\n",
+        )
+        state.update(over)
+        return evaluate_condition(expr, **state)
+
+    def test_exit_code_and_metrics(self):
+        assert self._eval("exit_code == 0 and metrics['accuracy'] >= 0.9")
+        assert not self._eval("metrics['accuracy'] >= 0.95")
+        assert self._eval("metrics['loss'] < 0.1 or exit_code != 0")
+
+    def test_stdout_contains(self):
+        assert self._eval("'epoch 3 done' in stdout")
+        assert self._eval("'OOM' not in stdout")
+
+    def test_outcome_and_chained_compare(self):
+        assert self._eval("outcome == 'completed'")
+        assert self._eval("0.0 < metrics['accuracy'] < 1.0")
+
+    def test_arithmetic(self):
+        assert self._eval("metrics['accuracy'] - metrics['loss'] > 0.8")
+
+    def test_missing_metric_raises(self):
+        with pytest.raises(ConditionError):
+            self._eval("metrics['nope'] > 0")
+
+    def test_rejects_calls_attributes_imports(self):
+        for bad in (
+            "__import__('os').system('true')",
+            "metrics.clear()",
+            "open('/etc/passwd')",
+            "[x for x in metrics]",
+            "lambda: 1",
+            "unknown_name == 1",
+        ):
+            with pytest.raises(ConditionError):
+                parse_condition(bad)
+
+    def test_syntax_error(self):
+        with pytest.raises(ConditionError):
+            parse_condition("exit_code ==")
+
+
+@pytest.fixture()
+def controller(tmp_path):
+    c = ExperimentController(root_dir=str(tmp_path))
+    yield c
+    c.close()
+
+
+def _subproc_spec(name, body, success="", failure="", metric="score"):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="1.0")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name=metric),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(
+            command=["python", "-c", "x=float('${trialParameters.x}'); " + body],
+            trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+            success_condition=success,
+            failure_condition=failure,
+        ),
+        max_trial_count=1,
+        parallel_trial_count=1,
+    )
+
+
+class TestConditionsEndToEnd:
+    def test_failure_condition_fails_rc0_trial(self, controller):
+        """An rc=0 trial that prints a failure marker must be classified
+        Failed — the round-2 dead-field regression case."""
+        spec = _subproc_spec(
+            "fail-cond",
+            "print('score=0.5'); print('NaN loss detected')",
+            failure="'NaN loss detected' in stdout",
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("fail-cond", timeout=120)
+        trials = controller.state.list_trials("fail-cond")
+        assert trials[0].condition == TrialCondition.FAILED
+        assert "failure condition met" in trials[0].message
+        assert exp.status.trials_failed == 1
+
+    def test_success_condition_overrides_nonzero_exit(self, controller):
+        """job conditions define success: rc=1 with the success predicate met
+        is Succeeded (job_util.go precedence)."""
+        spec = _subproc_spec(
+            "succ-cond",
+            "import sys; print('score=0.9'); sys.exit(1)",
+            success="metrics['score'] >= 0.5",
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("succ-cond", timeout=120)
+        trials = controller.state.list_trials("succ-cond")
+        assert trials[0].condition == TrialCondition.SUCCEEDED, trials[0].message
+        assert exp.status.trials_succeeded == 1
+
+    def test_unmet_success_condition_fails_rc0_trial(self, controller):
+        spec = _subproc_spec(
+            "unmet-cond",
+            "print('score=0.2')",
+            success="metrics['score'] >= 0.5",
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("unmet-cond", timeout=120)
+        trials = controller.state.list_trials("unmet-cond")
+        assert trials[0].condition == TrialCondition.FAILED
+        assert "success condition not met" in trials[0].message
+
+    def test_failure_checked_before_success(self, controller):
+        spec = _subproc_spec(
+            "order-cond",
+            "print('score=0.9'); print('FATAL')",
+            success="metrics['score'] >= 0.5",
+            failure="'FATAL' in stdout",
+        )
+        controller.create_experiment(spec)
+        controller.run("order-cond", timeout=120)
+        trials = controller.state.list_trials("order-cond")
+        assert trials[0].condition == TrialCondition.FAILED
+
+    def test_in_process_trial_conditions(self, controller):
+        """Conditions also cover in-process trials (metrics + exit_code)."""
+        spec = ExperimentSpec(
+            name="inproc-cond",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=lambda a, c: c.report(score=0.3),
+                success_condition="metrics['score'] >= 0.5",
+            ),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        controller.create_experiment(spec)
+        controller.run("inproc-cond", timeout=60)
+        trials = controller.state.list_trials("inproc-cond")
+        assert trials[0].condition == TrialCondition.FAILED
+        assert "success condition not met" in trials[0].message
+
+    def test_admission_rejects_invalid_condition(self, controller):
+        spec = _subproc_spec(
+            "bad-cond",
+            "print('score=1')",
+            success="__import__('os').system('true')",
+        )
+        with pytest.raises(ValidationError) as exc:
+            controller.create_experiment(spec)
+        assert "successCondition" in str(exc.value)
+
+    def test_admission_rejects_stdout_condition_for_in_process(self, controller):
+        """In-process trials capture no stdout; a stdout condition would
+        silently never match — reject at admission."""
+        spec = ExperimentSpec(
+            name="stdout-inproc",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=lambda a, c: c.report(score=1.0),
+                success_condition="'done' in stdout",
+            ),
+            max_trial_count=1,
+        )
+        with pytest.raises(ValidationError) as exc:
+            controller.create_experiment(spec)
+        assert "stdout" in str(exc.value)
+
+    def test_string_arithmetic_rejected_at_eval(self):
+        """String Mult/Add could allocate unbounded memory in the controller
+        process — arithmetic is numeric-only."""
+        with pytest.raises(ConditionError):
+            evaluate_condition(
+                "stdout * 999999999 > ''",
+                exit_code=0, outcome="completed", metrics={}, stdout="x" * 1024,
+            )
+
+    def test_unmet_success_condition_preserves_original_failure(self, controller):
+        """The original crash cause must stay diagnosable when a success
+        condition replaces the classification."""
+        spec = _subproc_spec(
+            "keep-msg",
+            "import sys; print('score=0.1'); sys.exit(7)",
+            success="metrics['score'] >= 0.5",
+        )
+        controller.create_experiment(spec)
+        controller.run("keep-msg", timeout=120)
+        t = controller.state.list_trials("keep-msg")[0]
+        assert "success condition not met" in t.message
+        assert "exited with code 7" in t.message

@@ -89,6 +89,60 @@ def _tiny_enas(assignments, ctx):
     )
 
 
+def _tiny_darts_hpo(assignments, ctx):
+    from katib_tpu.models.darts_trainer import run_darts_hpo_trial
+
+    run_darts_hpo_trial(
+        assignments, ctx,
+        num_epochs=1, num_train_examples=64, batch_size=16, init_channels=2,
+        num_nodes=1, stem_multiplier=1, num_layers=2,
+    )
+
+
+def test_darts_hpo_multitrial_e2e(controller):
+    """The north-star shape: an HPO algorithm (tpe) searching the DARTS
+    bilevel trainer's optimizer hyperparameters across multiple trials
+    (bench.py _bench_e2e_experiment runs this at learning scale on TPU)."""
+    from katib_tpu.api import Distribution
+
+    spec = ExperimentSpec(
+        name="darts-hpo-e2e",
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE,
+            objective_metric_name="Validation-accuracy",
+            additional_metric_names=["Train-loss"],
+        ),
+        algorithm=AlgorithmSpec("tpe"),
+        parameters=[
+            ParameterSpec(
+                "w_lr", ParameterType.DOUBLE,
+                FeasibleSpace(min="0.005", max="0.2", distribution=Distribution.LOG_UNIFORM),
+            ),
+            ParameterSpec(
+                "alpha_lr", ParameterType.DOUBLE,
+                FeasibleSpace(min="0.0001", max="0.01", distribution=Distribution.LOG_UNIFORM),
+            ),
+            ParameterSpec(
+                "w_momentum", ParameterType.DOUBLE, FeasibleSpace(min="0.5", max="0.99"),
+            ),
+        ],
+        trial_template=TrialTemplate(function=_tiny_darts_hpo),
+        max_trial_count=2,
+        parallel_trial_count=1,
+    )
+    controller.create_experiment(spec)
+    exp = controller.run("darts-hpo-e2e", timeout=420)
+    assert exp.status.is_succeeded, exp.status.message
+    trials = controller.state.list_trials("darts-hpo-e2e")
+    assert len(trials) == 2
+    # every trial got distinct hyperparameter assignments and reported
+    assignments = {tuple(sorted(t.assignments_dict().items())) for t in trials}
+    assert len(assignments) == 2
+    from katib_tpu.utils.e2e_verify import verify_experiment_results
+
+    verify_experiment_results(controller, exp)
+
+
 def test_enas_e2e(controller):
     """e2e-test-enas-cifar10 equivalent: REINFORCE controller suggests
     architectures, child networks train and report accuracy."""
