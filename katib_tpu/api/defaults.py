@@ -73,7 +73,14 @@ def set_defaults(spec: ExperimentSpec, default_parallel: int = None) -> Experime
         mc.source = SourceSpec()
     if mc.collector_kind in (CollectorKind.FILE, CollectorKind.TF_EVENT) and mc.source is None:
         mc.source = SourceSpec(file_path=DEFAULT_METRICS_FILE)
-    if spec.trial_template.command is not None and mc.collector_kind == CollectorKind.PUSH:
+    # Subprocess trials (command templates, and multi-host gangs whose
+    # workers are separate processes reporting via stdout) default to STDOUT
+    # scraping for parity with arbitrary training scripts.
+    is_subprocess_trial = (
+        spec.trial_template.command is not None
+        or spec.trial_template.resources.num_hosts > 1
+    )
+    if is_subprocess_trial and mc.collector_kind == CollectorKind.PUSH:
         mc.collector_kind = CollectorKind.STDOUT
 
     return spec

@@ -229,6 +229,15 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
                 f"trialParameters[{tp.name}]: reference {tp.reference!r} not found in search space"
             )
 
+    if t.resources.num_hosts < 1:
+        errs.append("trialTemplate.resources.numHosts must be >= 1")
+    elif t.resources.num_hosts > 1 and t.function is not None:
+        errs.append(
+            "trialTemplate.resources.numHosts > 1 requires a command or "
+            "entryPoint template (an in-memory function cannot be "
+            "distributed across worker processes)"
+        )
+
     # success/failure condition expressions must parse and reference only the
     # trial terminal-state names (controller/conditions.py; the reference
     # validates its GJSON success/failure conditions in validator.go)

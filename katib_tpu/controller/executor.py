@@ -181,6 +181,33 @@ class InProcessExecutor:
             _m._current_reporter.reset(token)
 
 
+_port_lock = threading.Lock()
+_recent_ports: Dict[int, float] = {}  # port -> issued-at (avoid concurrent reuse)
+
+
+def _free_port() -> int:
+    """Free localhost port for a gang coordinator. The probe socket must close
+    before a worker can bind the port, so cross-process TOCTOU is inherent —
+    but the common collision (two concurrent gang trials in THIS controller
+    getting the same port) is prevented by tracking recently-issued ports."""
+    import socket
+
+    with _port_lock:
+        now = time.time()
+        for p in [p for p, t in _recent_ports.items() if now - t > 60.0]:
+            del _recent_ports[p]
+        for _ in range(16):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            if port not in _recent_ports:
+                _recent_ports[port] = now
+                return port
+        _recent_ports[port] = now  # every probe collided: accept the last
+        return port
+
+
 class SubprocessExecutor:
     POLL_INTERVAL = 0.1
 
@@ -325,24 +352,7 @@ class SubprocessExecutor:
         )
         last_scrape = 0.0
         last_scraped: Dict[str, Any] = {}  # metric -> (value, recorded_at)
-        tailer = None
-        if monitor is not None:
-            # native C++ tailer for the default TEXT filter, Python fallback
-            # for custom filters / JSON (katib_tpu.native.tailer)
-            from ..native.tailer import make_tailer
-
-            mc = spec.metrics_collector_spec
-            filters = (
-                mc.source.filter.metrics_format
-                if mc.source and mc.source.filter
-                else None
-            )
-            tailer = make_tailer(
-                watch_path,
-                spec.objective.all_metric_names(),
-                filters=filters,
-                json_format=bool(mc.source and mc.source.file_format == "JSON"),
-            )
+        tailer = self._make_stop_tailer(spec, watch_path) if monitor else None
         try:
             while True:
                 if handle.kill_requested:
@@ -379,6 +389,25 @@ class SubprocessExecutor:
                 tailer.close()
 
     @staticmethod
+    def _make_stop_tailer(spec: ExperimentSpec, watch_path: str):
+        """Early-stopping tailer over the watched metrics stream: native C++
+        tailer for the default TEXT filter, Python fallback for custom
+        filters / JSON (katib_tpu.native.tailer). Shared by the single-process
+        and gang wait loops so their semantics can't drift."""
+        from ..native.tailer import make_tailer
+
+        mc = spec.metrics_collector_spec
+        filters = (
+            mc.source.filter.metrics_format if mc.source and mc.source.filter else None
+        )
+        return make_tailer(
+            watch_path,
+            spec.objective.all_metric_names(),
+            filters=filters,
+            json_format=bool(mc.source and mc.source.file_format == "JSON"),
+        )
+
+    @staticmethod
     def _terminate(proc: subprocess.Popen) -> None:
         try:
             os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
@@ -392,6 +421,25 @@ class SubprocessExecutor:
             except (ProcessLookupError, PermissionError):
                 pass
             proc.wait(timeout=5)
+
+    @staticmethod
+    def _terminate_gang(procs: Sequence[subprocess.Popen]) -> None:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + 10
+        for proc in procs:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait(timeout=5)
 
     CUSTOM_COLLECTOR_TIMEOUT = 60.0
 
@@ -497,3 +545,196 @@ class SubprocessExecutor:
         with open(path, "r", errors="replace") as f:
             lines = f.read().splitlines()
         self._parse_and_report(trial, lines, spec)
+
+
+class MultiHostExecutor(SubprocessExecutor):
+    """Gang executor: ``resources.num_hosts`` worker processes forming one
+    jax.distributed system (SURVEY.md §7 layer 4 / hard part 5 — a worker
+    death must fail the whole trial deterministically).
+
+    TPU-native replacement for the reference's delegation to gang-scheduled
+    training-operator CRDs (MPIJob/PyTorchJob,
+    examples/v1beta1/kubeflow-training-operator/mpijob-horovod.yaml): the
+    executor launches every worker itself, wiring the jax.distributed env
+    (``KATIB_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID``, read by
+    ``parallel.mesh.initialize_distributed``). Command templates run the
+    rendered argv per worker (the command calls ``initialize_distributed``
+    like a PyTorchJob image calls ``init_process_group``); entryPoint
+    templates run ``python -m katib_tpu.runtime.host_worker``.
+
+    Process 0 is the primary (reference PrimaryPodLabels): metrics collection,
+    the early-stopping tail, and the push env binding apply to its stdout.
+    Any worker exiting non-zero kills the remaining gang and fails the trial
+    with the worker id + exit code. Workers default to one machine (TPU-VM
+    host emulation); a cluster launcher overrides ``KATIB_TPU_COORDINATOR``
+    via template env when workers span machines.
+    """
+
+    def execute(
+        self, exp: Experiment, trial: Trial, ctx: TrialContext, handle: TrialExecution
+    ) -> ExecutionResult:
+        import json as _json
+        import sys as _sys
+
+        spec = exp.spec
+        template = spec.trial_template
+        n_hosts = max(template.resources.num_hosts, 1)
+        workdir = ctx.workdir or os.getcwd()
+        os.makedirs(workdir, exist_ok=True)
+
+        if template.command is not None:
+            cmd = render_command(template, trial)
+        else:
+            cmd = [_sys.executable, "-m", "katib_tpu.runtime.host_worker"]
+
+        base_env = dict(os.environ)
+        base_env.update(template.env)
+        # workers must import katib_tpu regardless of their cwd
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        base_env["PYTHONPATH"] = (
+            repo_root + os.pathsep + base_env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        base_env[ENV_TRIAL_NAME] = trial.name
+        base_env["KATIB_TPU_EXPERIMENT"] = trial.experiment_name
+        base_env.setdefault("KATIB_TPU_COORDINATOR", f"127.0.0.1:{_free_port()}")
+        base_env["KATIB_TPU_NUM_PROCESSES"] = str(n_hosts)
+        if template.entry_point is not None:
+            base_env["KATIB_TPU_ENTRY_POINT"] = template.entry_point
+            base_env["KATIB_TPU_ASSIGNMENTS"] = _json.dumps(trial.assignments_dict())
+        if ctx.checkpoint_dir:
+            base_env["KATIB_TPU_CHECKPOINT_DIR"] = ctx.checkpoint_dir
+
+        metrics_file = None
+        mc = spec.metrics_collector_spec
+        if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.file_path:
+            metrics_file = mc.source.file_path
+            if not os.path.isabs(metrics_file):
+                metrics_file = os.path.join(workdir, metrics_file)
+
+        monitor = None
+        if trial.early_stopping_rules:
+            monitor = EarlyStoppingMonitor(
+                trial.early_stopping_rules,
+                spec.objective.objective_metric_name,
+                spec.objective.type,
+            )
+
+        procs: List[subprocess.Popen] = []
+        outs = []
+        stdout0 = os.path.join(workdir, "host-0", "stdout.log")
+        prom_logs: List[MetricLog] = []
+        try:
+            for i in range(n_hosts):
+                hostdir = os.path.join(workdir, f"host-{i}")
+                os.makedirs(hostdir, exist_ok=True)
+                env_i = dict(base_env)
+                env_i["KATIB_TPU_PROCESS_ID"] = str(i)
+                env_i["KATIB_TPU_WORKDIR"] = hostdir
+                if i == 0:
+                    # primary: push binding + metrics file land here only,
+                    # so N workers never produce N duplicate observations
+                    if self.db_path:
+                        env_i[ENV_DB_PATH] = self.db_path
+                    if metrics_file:
+                        env_i[ENV_METRICS_FILE] = metrics_file
+                out = open(os.path.join(hostdir, "stdout.log"), "wb")
+                outs.append(out)
+                procs.append(
+                    subprocess.Popen(
+                        cmd,
+                        stdout=out,
+                        stderr=subprocess.STDOUT,
+                        env=env_i,
+                        cwd=template.working_dir or hostdir,
+                        start_new_session=True,
+                    )
+                )
+            outcome = self._wait_gang(
+                procs, stdout0, metrics_file, monitor, spec, handle, prom_logs
+            )
+        except BaseException:
+            # spawn or wait blew up: never orphan already-started workers
+            # (they would block in jax.distributed.initialize forever)
+            self._terminate_gang(procs)
+            raise
+        finally:
+            for out in outs:
+                out.close()
+
+        if prom_logs:
+            self.obs_store.report_observation_log(trial.name, prom_logs)
+        self._collect(trial, stdout0, metrics_file, spec)
+        self._drain_pushed(trial)
+
+        rc0 = procs[0].returncode if procs else None
+        if outcome is not None:
+            if outcome.exit_code is None:
+                # keep the failing worker's code (set by _wait_gang) — the
+                # SIGTERM'd primary's -15 would shadow it for conditions
+                outcome.exit_code = rc0
+            outcome.stdout_path = stdout0
+            return outcome
+        return ExecutionResult(
+            TrialOutcome.COMPLETED, exit_code=rc0, stdout_path=stdout0
+        )
+
+    def _wait_gang(
+        self,
+        procs: List[subprocess.Popen],
+        stdout_path: str,
+        metrics_file: Optional[str],
+        monitor: Optional[EarlyStoppingMonitor],
+        spec: ExperimentSpec,
+        handle: TrialExecution,
+        prom_logs: List[MetricLog],
+    ) -> Optional[ExecutionResult]:
+        """Poll the gang; returns None only when EVERY worker exited 0."""
+        watch_path = metrics_file or stdout_path
+        scrape = (
+            spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
+            and spec.metrics_collector_spec.source is not None
+        )
+        last_scrape = 0.0
+        last_scraped: Dict[str, Any] = {}
+        tailer = self._make_stop_tailer(spec, watch_path) if monitor else None
+        try:
+            while True:
+                if handle.kill_requested:
+                    self._terminate_gang(procs)
+                    return ExecutionResult(TrialOutcome.KILLED, "kill requested")
+                rcs = [p.poll() for p in procs]
+                # deterministic gang failure: first worker death kills the rest
+                for i, rc in enumerate(rcs):
+                    if rc is not None and rc != 0:
+                        self._terminate_gang(procs)
+                        return ExecutionResult(
+                            TrialOutcome.FAILED,
+                            f"worker {i}/{len(procs)} exited with code {rc}; "
+                            "gang killed",
+                            exit_code=rc,  # the FAILING worker's code
+                        )
+                if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
+                    last_scrape = time.time()
+                    stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    if stopped is not None:
+                        self._terminate_gang(procs)
+                        return stopped
+                if tailer is not None:
+                    for name, raw, _idx in tailer.poll():
+                        try:
+                            value = float(raw)
+                        except ValueError:
+                            continue
+                        if monitor.observe(name, value):
+                            self._terminate_gang(procs)
+                            return ExecutionResult(TrialOutcome.EARLY_STOPPED)
+                if all(rc == 0 for rc in rcs):
+                    if scrape:
+                        self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    return None
+                time.sleep(self.POLL_INTERVAL)
+        finally:
+            if tailer is not None:
+                tailer.close()

@@ -36,6 +36,7 @@ from ..runtime.metrics import EarlyStoppingMonitor, MetricsReporter
 from .executor import (
     ExecutionResult,
     InProcessExecutor,
+    MultiHostExecutor,
     SubprocessExecutor,
     TrialExecution,
     TrialOutcome,
@@ -107,8 +108,10 @@ class TrialScheduler:
         self._restarts: Dict[str, int] = {}
         self._in_process = InProcessExecutor(obs_store)
         self._subprocess = SubprocessExecutor(obs_store, db_path=db_path)
+        self._multihost = MultiHostExecutor(obs_store, db_path=db_path)
         if poll_interval:
             self._subprocess.POLL_INTERVAL = poll_interval
+            self._multihost.POLL_INTERVAL = poll_interval
         self._handles: Dict[str, TrialExecution] = {}
         self._pending: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -214,7 +217,13 @@ class TrialScheduler:
 
             ctx = self._build_context(exp, trial, devices, handle)
             spec = exp.spec
-            if spec.trial_template.command is not None:
+            if (
+                spec.trial_template.resources.num_hosts > 1
+                and spec.trial_template.function is None
+            ):
+                # gang of worker processes forming one jax.distributed system
+                executor = self._multihost
+            elif spec.trial_template.command is not None:
                 executor = self._subprocess
             else:
                 executor = self._in_process

@@ -49,15 +49,19 @@ def make_lm_train_step(
     # semantics are identical, collectives are no-ops on one device.
     single_device = mesh is None or int(mesh.devices.size) == 1
     target_device = None if mesh is None else mesh.devices.reshape(-1)[0]
+    multiprocess = not single_device and jax.process_count() > 1
 
     model = TransformerLM(config, mesh=None if single_device else mesh)
     sample_tokens = jnp.zeros((2, 16), dtype=jnp.int32)
     from ..utils.modelinit import jitted_init
 
-    params = jitted_init(
-        model, jax.random.PRNGKey(seed), sample_tokens,
-        device=target_device if single_device else jax.devices()[0],
-    )
+    if not multiprocess:
+        # (multi-process ranks can't pin another process's device — and their
+        # params are created globally sharded below, not materialized here)
+        params = jitted_init(
+            model, jax.random.PRNGKey(seed), sample_tokens,
+            device=target_device if single_device else jax.devices()[0],
+        )
 
     tx = optax.adamw(learning_rate, weight_decay=0.01)
 
@@ -69,6 +73,25 @@ def make_lm_train_step(
         # of a multi-chip host) is preserved by running creation and every
         # step under jax.default_device(target) instead of committing.
         batch_sharding = None
+    elif multiprocess:
+        # Multi-host gang (MultiHostExecutor workers): params must be born
+        # globally sharded — device_put can't target another process's
+        # devices. jit with out_shardings materializes each process's
+        # addressable shards directly from one traced init.
+        shapes = jax.eval_shape(
+            lambda k: model.init(k, sample_tokens)["params"], jax.random.PRNGKey(seed)
+        )
+        flat_specs = {
+            k: NamedSharding(mesh, param_sharding_rules(k))
+            for k in flax.traverse_util.flatten_dict(shapes)
+        }
+        sharding_tree = flax.traverse_util.unflatten_dict(flat_specs)
+        init_fn = jax.jit(
+            lambda k: model.init(k, sample_tokens)["params"],
+            out_shardings=sharding_tree,
+        )
+        params = init_fn(jax.random.PRNGKey(seed))
+        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
     else:
         # shard params + opt state
         flat_specs = {

@@ -1,0 +1,26 @@
+"""Trial entry points for multi-host executor tests.
+
+Imported by gang worker processes (katib_tpu.runtime.host_worker) through the
+PYTHONPATH the test passes via the trial template env — not collected by
+pytest.
+"""
+
+import os
+import time
+
+
+def crash_if_worker1(assignments, ctx):
+    """Worker 1 dies with a distinctive exit code mid-trial; worker 0 keeps
+    training. The gang executor must detect the death and kill worker 0
+    (deterministic gang failure, SURVEY.md §7 hard part 5)."""
+    if ctx.process_id == 1:
+        os._exit(17)
+    for i in range(200):
+        ctx.report(loss=1.0 / (i + 1))
+        time.sleep(0.1)
+
+
+def report_and_exit(assignments, ctx):
+    """Minimal healthy gang worker: every worker reports (only process 0's
+    stdout is collected), then exits 0."""
+    ctx.report(score=float(assignments.get("x", "0.5")) + ctx.process_id)
