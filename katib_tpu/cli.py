@@ -53,6 +53,24 @@ def cmd_run(args) -> int:
     return 0 if exp.status.is_succeeded else 1
 
 
+def cmd_resume(args) -> int:
+    """Resume a persisted (FromVolume-style) experiment in a fresh process:
+    restore state, requeue in-flight trials, drive to completion."""
+    ctrl = _controller(args.root, args.devices)
+    try:
+        try:
+            ctrl.load_experiment(args.name)
+        except KeyError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(f"experiment {args.name} restored; resuming...")
+        exp = ctrl.run(args.name, timeout=args.timeout)
+        _print_status(exp)
+        return 0 if exp.status.is_succeeded else 1
+    finally:
+        ctrl.close()
+
+
 def cmd_list(args) -> int:
     ctrl = _controller(args.root)
     _load_all(ctrl, args.root)
@@ -192,6 +210,14 @@ def main(argv=None) -> int:
     run_p.add_argument("--timeout", type=float, default=None)
     run_p.add_argument("--devices", type=int, default=None, help="abstract device slots (default: 8 slots; in-process JAX trials see the real devices regardless)")
     run_p.set_defaults(fn=cmd_run)
+
+    res_p = sub.add_parser(
+        "resume", help="resume a persisted experiment after a controller restart"
+    )
+    res_p.add_argument("name")
+    res_p.add_argument("--timeout", type=float, default=None)
+    res_p.add_argument("--devices", type=int, default=None)
+    res_p.set_defaults(fn=cmd_resume)
 
     sub.add_parser("list", help="list experiments").set_defaults(fn=cmd_list)
 

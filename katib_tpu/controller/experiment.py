@@ -286,6 +286,72 @@ class ExperimentController:
                 self.scheduler.kill(t.name)
         return exp
 
+    def load_experiment(self, name: str) -> Experiment:
+        """Cross-process resume — the FromVolume PVC semantics
+        (composer.go:296+, suggestion_controller.go:132-143): restore the
+        experiment, its trials, and the suggestion state (incl. the
+        algorithm-settings round-trip hyperband depends on) from the state
+        dir, then requeue trials that were in flight when the previous
+        controller process died. Stateful suggesters resume from their own
+        on-disk state (ENAS controller pickle, PBT queue snapshot) when the
+        fresh instance is created lazily on the next suggestion sync.
+
+        Trials of in-memory ``function`` templates cannot be re-executed in a
+        new process (the callable does not serialize — the reference's
+        equivalent constraint is that runSpecs are declarative YAML); such
+        in-flight trials are marked Killed instead of requeued.
+        """
+        exp = self.state.load(name)
+        if exp is None:
+            raise KeyError(f"no persisted state for experiment {name!r}")
+        self._completed_seen.discard(name)
+        if exp.status.is_completed:
+            self._completed_seen.add(name)
+            return exp
+        resumable = exp.spec.trial_template.function is None
+        for trial in self.state.list_trials(name):
+            # look up the Killed condition entry by TYPE — _update_conditions
+            # replaces same-type entries in place, so conditions[-1] can be a
+            # stale earlier state after a kill/requeue/fail history
+            killed_cond = next(
+                (
+                    c
+                    for c in trial.conditions
+                    if c.type == TrialCondition.KILLED.value
+                ),
+                None,
+            )
+            shutdown_killed = (
+                trial.condition == TrialCondition.KILLED
+                and killed_cond is not None
+                and killed_cond.reason == "SchedulerShutdown"
+            )
+            if trial.is_terminal and not shutdown_killed:
+                continue
+            if resumable:
+                checkpoint_dir = None
+                try:
+                    self.suggestions.suggester_for(exp)
+                    checkpoint_dir = self._checkpoint_dir_for(exp, trial)
+                except Exception:
+                    pass  # suggester re-creation fails loudly on next sync
+                # the re-run starts clean: drop the interrupted run's metrics
+                # so the observation fold can't mix two executions
+                self.obs_store.delete_observation_log(trial.name)
+                self.events.event(
+                    exp.name, "Trial", trial.name, "TrialResubmitted",
+                    "controller restarted; in-flight trial re-queued",
+                )
+                self.scheduler.submit(exp, trial, checkpoint_dir=checkpoint_dir)
+            else:
+                trial.set_condition(
+                    TrialCondition.KILLED,
+                    "TrialLost",
+                    "in-memory trial function lost on controller restart",
+                )
+                self.state.update_trial(trial)
+        return exp
+
     def delete_experiment(self, name: str) -> None:
         """Delete an experiment and all its state (kubectl delete experiment)."""
         for t in self.state.list_trials(name):

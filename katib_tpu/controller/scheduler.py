@@ -120,6 +120,7 @@ class TrialScheduler:
         self._checkpoint_dirs: Dict[str, str] = {}
         self._quarantined = 0  # devices held by abandoned zombie trials
         self._shutdown = threading.Event()
+        self._intentional_kills: set = set()  # kill() targets, vs shutdown kills
 
     # -- submission ----------------------------------------------------------
 
@@ -137,8 +138,11 @@ class TrialScheduler:
         self._dispatch()
 
     def kill(self, trial_name: str) -> None:
-        """Early-stop / parallel-shrink kill (reference deleteTrials)."""
+        """Early-stop / parallel-shrink kill (reference deleteTrials) — a
+        deliberate decision, recorded so a later shutdown can't relabel the
+        trial SchedulerShutdown and get it wrongly requeued on resume."""
         with self._lock:
+            self._intentional_kills.add(trial_name)
             for i, (exp, t) in enumerate(self._waiting):
                 if t.name == trial_name:
                     self._waiting.pop(i)
@@ -152,11 +156,16 @@ class TrialScheduler:
             h.kill()
 
     def kill_all(self) -> None:
+        """Controller shutdown: kill everything, marking trials with the
+        SchedulerShutdown reason so a cross-process resume
+        (ExperimentController.load_experiment) can requeue them — shutdown is
+        an artifact of the controller's lifetime, not a search decision."""
+        self._shutdown.set()
         with self._lock:
             waiting = list(self._waiting)
             self._waiting.clear()
         for exp, t in waiting:
-            t.set_condition(TrialCondition.KILLED, "TrialKilled", "scheduler shutdown")
+            t.set_condition(TrialCondition.KILLED, "SchedulerShutdown", "scheduler shutdown")
             self.state.update_trial(t)
         for h in list(self._handles.values()):
             h.kill()
@@ -484,7 +493,15 @@ class TrialScheduler:
                 TrialCondition.EARLY_STOPPED, "TrialEarlyStopped", "Trial is early stopped"
             )
         elif result.outcome == TrialOutcome.KILLED:
-            trial.set_condition(TrialCondition.KILLED, "TrialKilled", result.message)
+            with self._lock:
+                deliberate = trial.name in self._intentional_kills
+            if self._shutdown.is_set() and not deliberate:
+                trial.set_condition(
+                    TrialCondition.KILLED, "SchedulerShutdown",
+                    "controller shutdown while trial was running",
+                )
+            else:
+                trial.set_condition(TrialCondition.KILLED, "TrialKilled", result.message)
         elif result.outcome == TrialOutcome.FAILED:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", result.message)
         elif not metrics_available and spec.metrics_collector_spec.collector_kind != CollectorKind.NONE:

@@ -21,8 +21,11 @@ match of the job-queue design:
 PBT is inherently stateful (the reference keeps an in-memory queue in the
 per-experiment service pod); here the suggester instance is per-experiment
 (the controller keeps one Suggester per experiment, mirroring the
-deployment-per-experiment topology) and state is additionally reconstructible
-from trial labels on restart.
+deployment-per-experiment topology). The queue state (pending/running/
+completed jobs, sample pools, RNG) is snapshotted to
+``<checkpoint_root>/_state.pkl`` after every suggestion round and restored by
+a fresh instance on controller restart — the FromVolume persistence the
+reference gets from its suggestion PVC (composer.go:296+).
 """
 
 from __future__ import annotations
@@ -140,7 +143,51 @@ class PBT(Suggester):
             )
         os.makedirs(self.checkpoint_root, exist_ok=True)
         self._initialized = True
+        if self._load_state():
+            return  # resumed: queues + rng restored, don't reseed
         self._seed_from_base(self.population_size)
+
+    # -- queue snapshot (FromVolume resume) -----------------------------------
+
+    def _state_path(self) -> str:
+        assert self.checkpoint_root is not None
+        return os.path.join(self.checkpoint_root, "_state.pkl")
+
+    def _save_state(self) -> None:
+        if not self._initialized or self.checkpoint_root is None:
+            return
+        import pickle
+
+        payload = {
+            "pending": self.pending,
+            "running": self.running,
+            "completed": self.completed,
+            "sample_pool": self.sample_pool,
+            "rng": self.rng,
+        }
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, self._state_path())
+
+    def _load_state(self) -> bool:
+        if self.checkpoint_root is None or not os.path.exists(self._state_path()):
+            return False
+        import pickle
+
+        with open(self._state_path(), "rb") as f:
+            payload = pickle.load(f)
+        self.pending = payload["pending"]
+        self.running = payload["running"]
+        self.completed = payload["completed"]
+        self.sample_pool = payload["sample_pool"]
+        self.rng = payload["rng"]
+        for s in self.samplers:
+            # samplers were built against the fresh seed rng before the
+            # restore — rebind so perturb/sample continue the restored
+            # stream instead of replaying the pre-restart one
+            s.rng = self.rng
+        return True
 
     def _seed_from_base(self, count: int) -> None:
         for _ in range(count):
@@ -272,6 +319,7 @@ class PBT(Suggester):
                     labels=labels,
                 )
             )
+        self._save_state()
         return SuggestionReply(assignments=assignments)
 
     def checkpoint_dir(self, trial_name: str) -> str:

@@ -1,0 +1,13 @@
+"""Entry-point trial functions for cross-process resume tests (importable by
+name from a fresh controller process — in-memory lambdas can't resume)."""
+
+import time
+
+
+def enas_eval(assignments, ctx):
+    """Deterministic pseudo-accuracy for an ENAS-suggested architecture —
+    fast stand-in for child-network training."""
+    time.sleep(0.3)
+    arch = assignments.get("architecture", "")
+    score = 0.3 + (hash(arch) % 1000) / 2000.0  # 0.3 .. 0.8, arch-dependent
+    ctx.report(**{"Validation-accuracy": score})
