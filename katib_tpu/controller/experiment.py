@@ -280,10 +280,14 @@ class ExperimentController:
                 pass
             exp = self.reconcile(name)
         # drain this experiment's still-running trials (goal-reached leaves
-        # stragglers); other experiments sharing the controller are untouched
-        for t in self.state.list_trials(name):
-            if not t.is_terminal:
-                self.scheduler.kill(t.name)
+        # stragglers); other experiments sharing the controller are untouched.
+        # NOT on shutdown: close() already killed them with the
+        # SchedulerShutdown reason — a kill() here would record them as
+        # deliberate and defeat requeue-on-resume.
+        if not self._closed.is_set():
+            for t in self.state.list_trials(name):
+                if not t.is_terminal:
+                    self.scheduler.kill(t.name)
         return exp
 
     def load_experiment(self, name: str) -> Experiment:
@@ -328,6 +332,8 @@ class ExperimentController:
             )
             if trial.is_terminal and not shutdown_killed:
                 continue
+            if self.scheduler.is_active(trial.name):
+                continue  # idempotence: a second load must not double-submit
             if resumable:
                 checkpoint_dir = None
                 try:

@@ -174,6 +174,12 @@ class TrialScheduler:
         with self._lock:
             return len(self._waiting) + len(self._handles)
 
+    def is_active(self, trial_name: str) -> bool:
+        with self._lock:
+            return trial_name in self._handles or any(
+                t.name == trial_name for _, t in self._waiting
+            )
+
     def join(self, timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.time() + timeout
         for t in list(self._threads):

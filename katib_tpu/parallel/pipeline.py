@@ -20,11 +20,19 @@ Embedding and the tied LM head live outside the rotation (computed on every
 pipe device; only stage 0's embedding and the last stage's head carry
 gradients — masking in the schedule routes cotangents correctly).
 
+Tensor parallelism composes INSIDE each stage: the shard_map is manual over
+'pipe' and 'data' only (``axis_names``), leaving 'model' an automatic GSPMD
+axis — stage weights carry the TP shardings from
+``transformer.param_sharding_rules`` and XLA inserts the within-stage
+all-gathers/reduce-scatters over 'model' while the rotation stays a manual
+ppermute over 'pipe'. This is the standard pp x tp x dp TPU layout: TP on the
+innermost (fastest-ICI) axis, pipeline and data outermost.
+
 Constraints: batch divisible by n_microbatches × data-axis size; positions
 are the standard arange(T) (identical across microbatches, so RoPE state
-doesn't need to travel with activations); mesh axes fsdp/seq/model/expert
-must be 1 on this path (compose TP/SP within a stage is future work —
-pipeline composes with pure DP here).
+doesn't need to travel with activations); mesh axes fsdp/seq/expert must be
+1 on this path (ZeRO/sequence/expert sharding within a stage is future
+work — pipeline composes with DP and TP here).
 """
 
 from __future__ import annotations
@@ -80,7 +88,7 @@ def make_pipeline_lm_train_step(
     n_stages = sizes.get("pipe", 1)
     if n_stages < 2:
         raise ValueError("pipeline path needs mesh axis 'pipe' >= 2")
-    for axis in ("fsdp", "seq", "model", "expert"):
+    for axis in ("fsdp", "seq", "expert"):
         if sizes.get(axis, 1) != 1:
             raise ValueError(f"pipeline path requires mesh axis '{axis}' == 1")
     if config.num_layers % n_stages != 0:
@@ -96,12 +104,24 @@ def make_pipeline_lm_train_step(
         jax.random.PRNGKey(seed + 1), (config.vocab_size, config.embed_dim), jnp.float32
     ) * 0.02
     blocks = _stack_block_init(config, n_stages, lps, seed)
+    # Stage weights: 'pipe' on the stage dim (manual), the block's TP rules
+    # on the trailing dims ('model' is an auto/GSPMD axis inside the
+    # shard_map; fsdp entries in the rules are size-1 here).
+    import flax
+
+    from ..models.transformer import param_sharding_rules
+
+    flat_blocks = flax.traverse_util.flatten_dict(blocks)
+    sharded_blocks = {
+        k: jax.device_put(
+            v,
+            NamedSharding(mesh, P("pipe", None, *tuple(param_sharding_rules(k)))),
+        )
+        for k, v in flat_blocks.items()
+    }
     params = {
         "embed": jax.device_put(embed, NamedSharding(mesh, P(None, None))),
-        "blocks": jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P(*(("pipe",) + (None,) * (a.ndim - 1))))),
-            blocks,
-        ),
+        "blocks": flax.traverse_util.unflatten_dict(sharded_blocks),
         "ln_f": jax.device_put(jnp.ones((config.embed_dim,)), NamedSharding(mesh, P(None))),
     }
 
@@ -185,12 +205,16 @@ def make_pipeline_lm_train_step(
     blocks_spec = jax.tree.map(
         lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), params["blocks"]
     )
+    # Manual over pipe+data only: 'model' stays automatic, so the TP
+    # shardings on the stage weights make XLA insert the within-stage
+    # collectives while the rotation stays a manual ppermute over 'pipe'.
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
         in_specs=(P(None, None), blocks_spec, P(None), P("data", None), P("data", None)),
         out_specs=(P(), P(None, None), blocks_spec, P(None)),
         check_vma=False,
+        axis_names={"pipe", "data"},
     )
 
     def step(params, opt_state, tokens, targets):

@@ -41,7 +41,8 @@ def test_dryrun_multichip_subprocess_under_timeout():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip OK [tp/sp/ep/dp]" in proc.stdout
-    assert "dryrun_multichip OK [pp/dp]" in proc.stdout
+    assert "dryrun_multichip OK [fsdp/tp/dp]" in proc.stdout
+    assert "dryrun_multichip OK [pp/tp/dp]" in proc.stdout
 
 
 def test_entry_compiles_single_device():
