@@ -25,7 +25,7 @@ import time
 from typing import Any, List, Optional, Sequence
 
 from ..api.defaults import set_defaults
-from ..api.spec import ExperimentSpec, ResumePolicy, UNAVAILABLE_METRIC_VALUE
+from ..api.spec import ExperimentSpec
 from ..api.status import (
     Experiment,
     ExperimentCondition,
@@ -215,10 +215,9 @@ class ExperimentController:
 
     @staticmethod
     def _observation_available(exp: Experiment, trial: Trial) -> bool:
-        if trial.observation is None:
-            return False
-        m = trial.observation.metric(exp.spec.objective.objective_metric_name)
-        return m is not None and m.latest != UNAVAILABLE_METRIC_VALUE
+        from ..db.store import observation_available
+
+        return observation_available(trial.observation, exp.spec.objective)
 
     def _checkpoint_dir_for(self, exp: Experiment, trial: Trial) -> Optional[str]:
         """PBT trials get their lineage directory (the suggestion-PVC mount,

@@ -248,6 +248,21 @@ def objective_value(
     return _parse_float(raw)
 
 
+def observation_available(
+    observation: Optional[Observation], objective: ObjectiveSpec
+) -> bool:
+    """Latest-value availability of the objective metric — the predicate the
+    experiment controller's request math uses to exclude incomplete
+    early-stopped trials (experiment_controller.go:449-461). Hyperband's
+    full-width guard MUST use this same predicate: if the two ever disagreed
+    for a trial, the guard's expected width would permanently exceed the
+    controller's request and the experiment would stall (ADVICE r2)."""
+    if observation is None:
+        return False
+    m = observation.metric(objective.objective_metric_name)
+    return m is not None and m.latest != UNAVAILABLE_METRIC_VALUE
+
+
 def obs_db_path(root: Optional[str]) -> Optional[str]:
     """Canonical observation-log DB location under a state root."""
     import os

@@ -59,7 +59,10 @@ class NativeTailer:
         finally:
             self._lib.mt_free(buf)
         out: List[Parsed] = []
-        for entry in raw.splitlines():
+        # records are framed with '\n' by the C++ side; str.splitlines()
+        # would also split on \v, \f, NEL, U+2028/9 inside deferred
+        # non-ASCII lines, corrupting their records
+        for entry in raw.split("\n"):
             if entry.startswith("\x02"):
                 # non-ASCII line deferred by the kernel: parse with the real
                 # Unicode-aware regex (same path as PyTailer)

@@ -51,6 +51,15 @@ def _on_tpu() -> bool:
         return False
 
 
+def _use_kernel(interpret: Optional[bool]) -> bool:
+    """Three-state kernel dispatch shared by every flash entry point:
+    ``True`` forces the Pallas path (interpret mode off-TPU — kernel tests),
+    ``False`` forces the dense fallback, ``None`` auto-selects by backend
+    (interpret-mode Pallas off-TPU is orders of magnitude slower than one
+    fused XLA attention)."""
+    return interpret is True or (interpret is not False and _on_tpu())
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
@@ -376,14 +385,8 @@ def flash_attention(
         qs = q if sm_scale is None else q * (sm_scale * math.sqrt(d))
         return dense_attention(qs, k, v, causal=causal)
 
-    if t % block_q or t % block_k or t < 16:
+    if t % block_q or t % block_k or t < 16 or not _use_kernel(interpret):
         return dense_fallback()
-    if interpret is None:
-        # off-TPU, interpret-mode Pallas is orders of magnitude slower than
-        # one fused XLA attention; reserve it for explicit kernel tests
-        if not _on_tpu():
-            return dense_fallback()
-        interpret = False
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
 
@@ -435,7 +438,7 @@ def flash_attention_with_lse(
         tk == t  # the kernel grid assumes equal q/kv lengths
         and bq and bk and t % bq == 0 and tk % bk == 0 and t >= 16
     ):
-        use_kernel = interpret is True or (interpret is not False and _on_tpu())
+        use_kernel = _use_kernel(interpret)
 
     if use_kernel:
         def to_bhtd(x):
@@ -443,7 +446,7 @@ def flash_attention_with_lse(
 
         o, lse = _fwd(
             to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, float(sm_scale),
-            bq, bk, bool(interpret or False),
+            bq, bk, bool(interpret),
         )
         o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
         lse = lse.reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
@@ -480,12 +483,16 @@ def flash_block_grads(
     do: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-block gradients against a GLOBAL logsumexp: with p = exp(s - lse)
     every K/V block's (dq, dk, dv) contribution is independent, so ring
     attention's backward can call this once per rotation. Layout
     [B, T, H, D]; lse [B, T, H] f32. Uses the Pallas _bwd kernels on TPU
-    (scores never materialize), dense f32 math elsewhere."""
+    (scores never materialize), dense f32 math elsewhere. ``interpret=True``
+    forces the kernel path in Pallas interpret mode (CI coverage of the ring
+    backward's kernel glue off-TPU); ``interpret=False`` forces the dense
+    fallback."""
     b, t, h, d = q.shape
     if k.shape[1] != t:
         raise ValueError("flash_block_grads needs equal q/k block lengths")
@@ -493,14 +500,15 @@ def flash_block_grads(
         sm_scale = 1.0 / math.sqrt(d)
 
     bq = _auto_block(t, 1024)
-    if bq and t % bq == 0 and t >= 16 and _on_tpu():
+    use_kernel = bq and t % bq == 0 and t >= 16 and _use_kernel(interpret)
+    if use_kernel:
         def to_bhtd(x):
             return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
 
         lse_bhtd = lse.transpose(0, 2, 1).reshape(b * h, t, 1)
         dq, dk, dv = _bwd(
             to_bhtd(q), to_bhtd(k), to_bhtd(v), to_bhtd(o), lse_bhtd,
-            to_bhtd(do), causal, float(sm_scale), bq, bq, False,
+            to_bhtd(do), causal, float(sm_scale), bq, bq, bool(interpret),
         )
         back = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
         return back(dq), back(dk), back(dv)
@@ -528,9 +536,10 @@ def merge_attention_blocks(
     """Fold two normalized partial attentions (over disjoint K/V blocks) into
     one: o = softmax-weighted combination, lse = log(e^lse1 + e^lse2).
     o: [B, T, H, D]; lse: [B, T, H] f32. Fully-masked partials carry
-    lse = -inf and drop out exactly."""
+    lse = NEG_INF (finite −1e30, not −inf) and drop out exactly."""
     m = jnp.maximum(lse1, lse2)
-    m_safe = jnp.where(jnp.isinf(m) & (m < 0), 0.0, m)  # both -inf: avoid nan
+    both_masked = m <= NEG_INF  # masked lse is the FINITE sentinel NEG_INF
+    m_safe = jnp.where(both_masked, 0.0, m)  # avoid exp(-1e30 - -1e30) = 1 drift
     w1 = jnp.exp(lse1 - m_safe)
     w2 = jnp.exp(lse2 - m_safe)
     denom = jnp.maximum(w1 + w2, 1e-30)
@@ -539,7 +548,7 @@ def merge_attention_blocks(
         + o2.astype(jnp.float32) * (w2 / denom)[..., None]
     ).astype(o1.dtype)
     lse = m_safe + jnp.log(denom)
-    lse = jnp.where(jnp.isinf(m) & (m < 0), NEG_INF, lse)
+    lse = jnp.where(both_masked, NEG_INF, lse)
     return o, lse
 
 

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _ring_fwd_loop(q, k, v, axis_name: str, causal: bool):
+def _ring_fwd_loop(q, k, v, axis_name: str, causal: bool, interpret: Optional[bool] = None):
     """Forward ring: per step, flash-attend local Q against the held K/V
     block (Pallas kernel on TPU, dense+lse fallback elsewhere) and fold the
     normalized block output into the running result by logsumexp weights.
@@ -55,15 +55,15 @@ def _ring_fwd_loop(q, k, v, axis_name: str, causal: bool):
         if causal:
             o_b, lse_b = jax.lax.cond(
                 src == my_idx,
-                lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=True),
+                lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=True, interpret=interpret),
                 lambda: jax.lax.cond(
                     src < my_idx,
-                    lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=False),
+                    lambda: flash_attention_with_lse(q, k_blk, v_blk, causal=False, interpret=interpret),
                     masked_block,  # strictly-future block: contributes nothing
                 ),
             )
         else:
-            o_b, lse_b = flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+            o_b, lse_b = flash_attention_with_lse(q, k_blk, v_blk, causal=False, interpret=interpret)
         o, lse = merge_attention_blocks(o, lse, o_b, lse_b)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -76,7 +76,7 @@ def _ring_fwd_loop(q, k, v, axis_name: str, causal: bool):
     return o, lse
 
 
-def _ring_bwd_loop(q, k, v, o, lse, do, axis_name: str, causal: bool):
+def _ring_bwd_loop(q, k, v, o, lse, do, axis_name: str, causal: bool, interpret: Optional[bool] = None):
     """Backward ring (standard flash/ring backward): with the global
     logsumexp, every block's gradient contribution is independent
     (p = exp(s - lse); ds = p * (dp - delta)), computed per rotation by
@@ -97,7 +97,8 @@ def _ring_bwd_loop(q, k, v, o, lse, do, axis_name: str, causal: bool):
 
         def block(blk_causal):
             return lambda: flash_block_grads(
-                q, k_blk, v_blk, o, lse, do, causal=blk_causal
+                q, k_blk, v_blk, o, lse, do, causal=blk_causal,
+                interpret=interpret,
             )
 
         if causal:
@@ -130,7 +131,7 @@ def _ring_bwd_loop(q, k, v, o, lse, do, axis_name: str, causal: bool):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False, interpret: Optional[bool] = None):
     """Body to run INSIDE shard_map over ``axis_name``: local blocks of
     q/k/v shaped [B, T_local, H, D]. Forward uses the Pallas flash kernel
     per block on TPU; the custom VJP runs the ring backward from the saved
@@ -139,16 +140,16 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = False):
 
     @jax.custom_vjp
     def ring(q, k, v):
-        o, _ = _ring_fwd_loop(q, k, v, axis_name, causal)
+        o, _ = _ring_fwd_loop(q, k, v, axis_name, causal, interpret)
         return o
 
     def ring_fwd(q, k, v):
-        o, lse = _ring_fwd_loop(q, k, v, axis_name, causal)
+        o, lse = _ring_fwd_loop(q, k, v, axis_name, causal, interpret)
         return o, (q, k, v, o, lse)
 
     def ring_bwd(res, do):
         q, k, v, o, lse = res
-        return _ring_bwd_loop(q, k, v, o, lse, do, axis_name, causal)
+        return _ring_bwd_loop(q, k, v, o, lse, do, axis_name, causal, interpret)
 
     ring.defvjp(ring_fwd, ring_bwd)
     return ring(q, k, v)

@@ -167,16 +167,18 @@ class HyperBand(Suggester):
         # bracket gracefully). Early-stopped trials without an objective
         # observation permanently reduce the controller's request total
         # (experiment.py requests math), so they reduce the expected width
-        # too — otherwise this guard would deadlock waiting for a request
-        # size that can never arrive.
-        from ..db.store import objective_value
+        # too — counted with the SAME availability predicate the controller
+        # uses (db.store.observation_available); a divergent predicate here
+        # would make full_width exceed the controller's request forever and
+        # stall the experiment.
+        from ..db.store import observation_available
 
         obj = request.experiment.objective
         incomplete_es = sum(
             1
             for t in request.trials
             if t.condition == TrialCondition.EARLY_STOPPED
-            and objective_value(t.observation, obj) is None
+            and not observation_available(t.observation, obj)
         )
         parallel = request.experiment.parallel_trial_count or 1
         max_t = request.experiment.max_trial_count

@@ -312,7 +312,8 @@ class _Handler(BaseHTTPRequestHandler):
                     events = [e.to_dict() for e in ctrl.events.list(name)]
                     limit = parse_qs(urlparse(self.path).query).get("limit", [None])[0]
                     if limit is not None and limit.isdigit():
-                        events = events[-int(limit):]  # tail: the recent records
+                        n = int(limit)  # [-0:] would return the FULL list
+                        events = events[-n:] if n > 0 else []
                     return self._send(events)
                 if sub == "suggestion":
                     s = ctrl.state.get_suggestion(name)

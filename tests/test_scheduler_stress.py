@@ -75,3 +75,79 @@ def test_parallel_64_throughput_and_cleanup(tmp_path):
             break
         time.sleep(0.2)
     assert not leftovers, f"leaked trial threads: {leftovers[:5]} (+{len(leftovers)})"
+
+
+def _napping_trial(assignments, ctx):
+    time.sleep(0.1)
+    ctx.report(score=float(assignments["x"]))
+
+
+def test_concurrent_experiments_share_allocator(tmp_path):
+    """Multiple experiments on ONE controller/allocator (VERDICT r2 item 8):
+    the reference gets cross-experiment isolation free from K8s; the
+    single-process design must prove progress with mixed gang sizes —
+    including a whole-machine gang (num_devices == total) that must wait for
+    every chip to free up without deadlocking the others."""
+    c = ExperimentController(root_dir=str(tmp_path), devices=list(range(8)))
+
+    def spec(name, num_devices, max_trials, parallel):
+        from katib_tpu.api import TrialResources
+
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=_napping_trial,
+                resources=TrialResources(num_devices=num_devices),
+            ),
+            max_trial_count=max_trials,
+            parallel_trial_count=parallel,
+        )
+
+    try:
+        c.create_experiment(spec("half-gang", 4, 6, 2))       # 2x4 = all chips
+        c.create_experiment(spec("single-chip", 1, 12, 4))    # churns alongside
+        c.create_experiment(spec("whole-machine", 8, 2, 1))   # starvation case
+
+        results = {}
+
+        def drive(name):
+            results[name] = c.run(name, timeout=100)
+
+        threads = [
+            threading.Thread(target=drive, args=(n,), daemon=True)
+            for n in ("half-gang", "single-chip", "whole-machine")
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=110)
+        assert all(not t.is_alive() for t in threads), (
+            f"deadlock: run() threads alive after {time.time() - t0:.0f}s; "
+            f"free={c.scheduler.allocator.free_count} "
+            f"active={c.scheduler.active_count()}"
+        )
+
+        for name, n_trials in (
+            ("half-gang", 6), ("single-chip", 12), ("whole-machine", 2)
+        ):
+            exp = results[name]
+            assert exp.status.is_succeeded, (name, exp.status.message)
+            trials = c.state.list_trials(name)
+            assert len(trials) == n_trials
+            assert all(t.condition == TrialCondition.SUCCEEDED for t in trials), [
+                (t.name, t.condition.value, t.message) for t in trials
+            ]
+
+        assert c.scheduler.allocator.free_count == 8
+        assert c.scheduler.quarantined_count == 0
+        assert c.scheduler.active_count() == 0
+    finally:
+        c.close()

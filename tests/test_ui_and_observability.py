@@ -89,6 +89,14 @@ class TestUIServer:
         assert "TrialCreated" in reasons
         assert any(e["kind"] == "Trial" and e["reason"] == "TrialSucceeded" for e in events)
 
+    def test_events_limit(self, stack):
+        base, _, _ = stack
+        _, _, body = get(f"{base}/api/experiments/ui-exp/events?limit=2")
+        assert len(json.loads(body)) == 2
+        # limit=0 is an empty tail, not the full list ([-0:] pitfall)
+        _, _, body = get(f"{base}/api/experiments/ui-exp/events?limit=0")
+        assert json.loads(body) == []
+
     def test_prometheus_metrics(self, stack):
         base, _, _ = stack
         status, ctype, body = get(f"{base}/metrics")
