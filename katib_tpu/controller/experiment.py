@@ -35,7 +35,7 @@ from ..api.status import (
 )
 from ..api.validation import validate_experiment
 from ..db.state import ExperimentStateStore
-from ..db.store import ObservationStore, open_store
+from ..db.store import ObservationStore, observation_available, open_store
 from ..earlystop.medianstop import registered_early_stoppers
 from ..suggest.base import registered_algorithms
 from .scheduler import TrialScheduler
@@ -215,8 +215,6 @@ class ExperimentController:
 
     @staticmethod
     def _observation_available(exp: Experiment, trial: Trial) -> bool:
-        from ..db.store import observation_available
-
         return observation_available(trial.observation, exp.spec.objective)
 
     def _checkpoint_dir_for(self, exp: Experiment, trial: Trial) -> Optional[str]:

@@ -30,8 +30,8 @@ exactly like run_trial.py parses its flags.
 
 from __future__ import annotations
 
+import functools
 import json
-import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -102,6 +102,83 @@ def architect_alpha_grad(
     return jax.tree.map(lambda da, h: da - xi * h, dalpha, hessian)
 
 
+def _make_w_tx(weight_decay, momentum, lr, grad_clip):
+    """SGD momentum + weight decay + clip (run_trial.py w_optim). Pure
+    construction — safe to rebuild inside the traced step with traced
+    hyperparameter values (state structure is value-independent)."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.clip_by_global_norm(grad_clip),
+        optax.sgd(lr, momentum=momentum),
+    )
+
+
+def _make_a_tx(weight_decay, lr):
+    """Adam(0.5, 0.999) + weight decay (run_trial.py alpha_optim)."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.adam(lr, b1=0.5, b2=0.999),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_search_step(model: "DartsSupernet", total_steps: int,
+                          w_lr_min: float, w_grad_clip: float):
+    """ONE jitted bilevel step per static configuration, shared across
+    DartsSearch instances (flax Modules are frozen dataclasses — hashable
+    cache keys). Every trial of an HPO sweep reuses the same Python
+    callable, so trials 2+ skip jax retracing entirely on top of the
+    persistent-XLA-cache compile hit; hyperparameter VALUES arrive through
+    the traced ``hyper`` argument."""
+
+    def momentum_of(opt_state):
+        # trace of optax.sgd momentum buffer inside the chain
+        return opt_state[2][0].trace
+
+    def step(weights, alphas, w_opt_state, a_opt_state, step_idx, hyper, train_batch, valid_batch):
+        # cosine decay from the traced base lr (run_trial.py lr_scheduler):
+        # lr(t) = w_lr_min + (w_lr - w_lr_min) * 0.5 * (1 + cos(pi t/T))
+        frac = jnp.clip(step_idx / total_steps, 0.0, 1.0)
+        xi = w_lr_min + (hyper["w_lr"] - w_lr_min) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        w_tx = _make_w_tx(hyper["w_weight_decay"], hyper["w_momentum"], xi, w_grad_clip)
+        a_tx = _make_a_tx(hyper["alpha_weight_decay"], hyper["alpha_lr"])
+
+        # 1) alpha update from the unrolled objective
+        dalpha = architect_alpha_grad(
+            model,
+            weights,
+            alphas,
+            momentum_of(w_opt_state),
+            train_batch,
+            valid_batch,
+            xi,
+            hyper["w_momentum"],
+            hyper["w_weight_decay"],
+        )
+        a_updates, a_opt_state = a_tx.update(dalpha, a_opt_state, alphas)
+        alphas = optax.apply_updates(alphas, a_updates)
+
+        # 2) weight update on the training batch
+        loss, g_w = jax.value_and_grad(
+            lambda w: _loss_fn(model, w, alphas, train_batch)
+        )(weights)
+        w_updates, w_opt_state = w_tx.update(g_w, w_opt_state, weights)
+        weights = optax.apply_updates(weights, w_updates)
+        return weights, alphas, w_opt_state, a_opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_eval_step(model: "DartsSupernet"):
+    def evaluate(weights, alphas, batch):
+        x, y = batch
+        logits = model.apply({"params": merge_params(weights, alphas)}, x)
+        return (jnp.argmax(logits, -1) == y).mean()
+
+    return jax.jit(evaluate)
+
+
 class DartsSearch:
     """Alternating bilevel optimization driver (run_trial.py train loop)."""
 
@@ -149,23 +226,6 @@ class DartsSearch:
 
     # ------------------------------------------------------------------
 
-    def _make_w_tx(self, weight_decay, momentum, lr):
-        """SGD momentum + weight decay + clip (run_trial.py w_optim). Pure
-        construction — safe to rebuild inside the traced step with traced
-        hyperparameter values (state structure is value-independent)."""
-        return optax.chain(
-            optax.add_decayed_weights(weight_decay),
-            optax.clip_by_global_norm(self.w_grad_clip),
-            optax.sgd(lr, momentum=momentum),
-        )
-
-    def _make_a_tx(self, weight_decay, lr):
-        """Adam(0.5, 0.999) + weight decay (run_trial.py alpha_optim)."""
-        return optax.chain(
-            optax.add_decayed_weights(weight_decay),
-            optax.adam(lr, b1=0.5, b2=0.999),
-        )
-
     def build(self, sample_shape: Tuple[int, ...], total_steps: int) -> None:
         from ..utils.modelinit import jitted_init
 
@@ -174,10 +234,10 @@ class DartsSearch:
         self.weights, self.alphas = split_params(params)
 
         self.total_steps = max(total_steps, 1)
-        self.w_opt_state = self._make_w_tx(
-            self.w_weight_decay, self.w_momentum, self.w_lr
+        self.w_opt_state = _make_w_tx(
+            self.w_weight_decay, self.w_momentum, self.w_lr, self.w_grad_clip
         ).init(self.weights)
-        self.a_opt_state = self._make_a_tx(
+        self.a_opt_state = _make_a_tx(
             self.alpha_weight_decay, self.alpha_lr
         ).init(self.alphas)
         self.step_idx = 0
@@ -192,8 +252,10 @@ class DartsSearch:
             "alpha_weight_decay": jnp.float32(self.alpha_weight_decay),
         }
 
-        self._search_step = self._compile_step()
-        self._eval_step = self._compile_eval()
+        self._search_step = _compiled_search_step(
+            self.model, self.total_steps, self.w_lr_min, self.w_grad_clip
+        )
+        self._eval_step = _compiled_eval_step(self.model)
         self._built = True
 
     def _epoch_iter(self, x, y, rng):
@@ -212,58 +274,6 @@ class DartsSearch:
 
             sharding = NamedSharding(self.mesh, P("data"))
         return prefetch_to_device(base, sharding=sharding)
-
-    def _compile_step(self):
-        model = self.model
-        total_steps = self.total_steps
-        w_lr_min = self.w_lr_min
-
-        def momentum_of(opt_state):
-            # trace of optax.sgd momentum buffer inside the chain
-            return opt_state[2][0].trace
-
-        def step(weights, alphas, w_opt_state, a_opt_state, step_idx, hyper, train_batch, valid_batch):
-            # cosine decay from the traced base lr (run_trial.py lr_scheduler):
-            # lr(t) = w_lr_min + (w_lr - w_lr_min) * 0.5 * (1 + cos(pi t/T))
-            frac = jnp.clip(step_idx / total_steps, 0.0, 1.0)
-            xi = w_lr_min + (hyper["w_lr"] - w_lr_min) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
-            w_tx = self._make_w_tx(hyper["w_weight_decay"], hyper["w_momentum"], xi)
-            a_tx = self._make_a_tx(hyper["alpha_weight_decay"], hyper["alpha_lr"])
-
-            # 1) alpha update from the unrolled objective
-            dalpha = architect_alpha_grad(
-                model,
-                weights,
-                alphas,
-                momentum_of(w_opt_state),
-                train_batch,
-                valid_batch,
-                xi,
-                hyper["w_momentum"],
-                hyper["w_weight_decay"],
-            )
-            a_updates, a_opt_state = a_tx.update(dalpha, a_opt_state, alphas)
-            alphas = optax.apply_updates(alphas, a_updates)
-
-            # 2) weight update on the training batch
-            loss, g_w = jax.value_and_grad(
-                lambda w: _loss_fn(model, w, alphas, train_batch)
-            )(weights)
-            w_updates, w_opt_state = w_tx.update(g_w, w_opt_state, weights)
-            weights = optax.apply_updates(weights, w_updates)
-            return weights, alphas, w_opt_state, a_opt_state, loss
-
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
-
-    def _compile_eval(self):
-        model = self.model
-
-        def evaluate(weights, alphas, batch):
-            x, y = batch
-            logits = model.apply({"params": merge_params(weights, alphas)}, x)
-            return (jnp.argmax(logits, -1) == y).mean()
-
-        return jax.jit(evaluate)
 
     # ------------------------------------------------------------------
 

@@ -9,7 +9,16 @@ eagerly.
 
 from __future__ import annotations
 
+import functools
+
 import jax
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_init_fn(model):
+    # one jitted init per (hashable) module config: repeated trials of an
+    # HPO sweep reuse the same callable and skip the init retrace
+    return jax.jit(model.init)
 
 
 def jitted_init(model, rngs, *args, device=None):
@@ -21,6 +30,10 @@ def jitted_init(model, rngs, *args, device=None):
     """
     import contextlib
 
+    try:
+        fn = _cached_init_fn(model)  # flax Modules with hashable fields
+    except TypeError:
+        fn = jax.jit(model.init)  # unhashable config: uncached fallback
     ctx = jax.default_device(device) if device is not None else contextlib.nullcontext()
     with ctx:
-        return jax.jit(model.init)(rngs, *args)["params"]
+        return fn(rngs, *args)["params"]

@@ -1,0 +1,40 @@
+"""Every shipped example spec must parse, default, and pass admission
+validation — the reference's examples/ are exercised by its e2e CI; here a
+broken example would otherwise only fail in a user's hands."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from katib_tpu.api import set_defaults, validate_experiment
+from katib_tpu.api.spec import ExperimentSpec
+from katib_tpu.earlystop.medianstop import registered_early_stoppers
+from katib_tpu.suggest.base import registered_algorithms
+
+EXAMPLES = sorted(
+    glob.glob(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "examples", "**", "*.json"),
+        recursive=True,
+    )
+)
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_spec_is_valid(path):
+    with open(path) as f:
+        raw = json.load(f)
+    spec = ExperimentSpec.from_dict(raw)
+    assert spec.name, path
+    set_defaults(spec)
+    validate_experiment(
+        spec,
+        known_algorithms=registered_algorithms(),
+        known_early_stopping=registered_early_stoppers(),
+    )
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 14
