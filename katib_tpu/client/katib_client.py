@@ -151,9 +151,12 @@ class KatibClient:
         parallel_trial_count: Optional[int] = None,
         max_failed_trial_count: Optional[int] = None,
         num_devices_per_trial: int = 1,
+        num_hosts_per_trial: int = 1,
         retain_trials: bool = False,
         pack: bool = False,
         env: Optional[Dict[str, str]] = None,
+        success_condition: str = "",
+        failure_condition: str = "",
     ) -> Experiment:
         """Turn a Python objective function into an Experiment
         (katib_client.py tune, :163-434).
@@ -162,6 +165,13 @@ class KatibClient:
         trial context as a second argument) and reports metrics via
         katib_tpu.report_metrics or by returning a metric dict.
         ``parameters`` maps names to katib_tpu.client.search builders.
+
+        ``num_hosts_per_trial > 1`` gang-schedules each trial across worker
+        processes (jax.distributed) — requires ``pack=True`` (an in-memory
+        callable cannot span processes). ``success_condition`` /
+        ``failure_condition`` define trial-state predicates
+        (controller/conditions.py); stdout-based conditions also require
+        ``pack=True``.
         """
         named_params = []
         for pname, pspec in parameters.items():
@@ -170,9 +180,12 @@ class KatibClient:
             )
             named_params.append(ps)
 
+        resources = TrialResources(
+            num_devices=num_devices_per_trial, num_hosts=num_hosts_per_trial
+        )
         if pack:
             template = self._packed_template(objective, named_params, env or {})
-            template.resources = TrialResources(num_devices=num_devices_per_trial)
+            template.resources = resources
             template.retain = retain_trials
         else:
             fn = objective
@@ -191,9 +204,11 @@ class KatibClient:
                 wrapped = fn
             template = TrialTemplate(
                 function=wrapped,
-                resources=TrialResources(num_devices=num_devices_per_trial),
+                resources=resources,
                 retain=retain_trials,
             )
+        template.success_condition = success_condition
+        template.failure_condition = failure_condition
 
         spec = ExperimentSpec(
             name=name,

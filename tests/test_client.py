@@ -66,6 +66,56 @@ class TestTune:
             assert "x" in d["parameter_assignments"]
             assert d["metrics"][0]["name"] == "score"
 
+    def test_tune_packed_with_conditions(self, client):
+        """tune() forwards trial success/failure conditions; a failure
+        condition fails rc=0 packed trials."""
+        client.tune(
+            name="tune-cond",
+            objective=objective_packed,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=1,
+            parallel_trial_count=1,
+            pack=True,
+            failure_condition="metrics['score'] > -1",  # always trips
+        )
+        exp = client.run("tune-cond", timeout=120)
+        assert exp.status.trials_failed == 1
+
+    def test_tune_rejects_multihost_function(self, client):
+        """num_hosts > 1 needs pack=True (in-memory callables can't span
+        processes) — admission must reject the in-process combination."""
+        from katib_tpu.api import ValidationError
+
+        with pytest.raises(ValidationError):
+            client.tune(
+                name="tune-mh-bad",
+                objective=objective_inprocess,
+                parameters={"x": search.double(min=0.0, max=1.0)},
+                objective_metric_name="score",
+                max_trial_count=1,
+                num_hosts_per_trial=2,
+            )
+
+    def test_tune_packed_multihost(self, client):
+        """pack=True + num_hosts=2: the serialized objective runs as a
+        2-worker gang; process 0's stdout is collected."""
+        client.tune(
+            name="tune-mh",
+            objective=objective_packed,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=1,
+            parallel_trial_count=1,
+            pack=True,
+            num_hosts_per_trial=2,
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        exp = client.run("tune-mh", timeout=180)
+        assert exp.status.is_succeeded, exp.status.message
+        details = client.get_success_trial_details("tune-mh")
+        assert len(details) == 1
+
     def test_trial_metrics_from_store(self, client):
         client.tune(
             name="tune-metrics",
