@@ -15,6 +15,7 @@ format (served by katib_tpu.ui.server at /metrics).
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -87,6 +88,9 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._collector = None  # per-scrape gauge recompute hook
+        self._collector_names: Tuple[str, ...] = ()
+        self._collector_error_logged = False
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -98,8 +102,45 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[key] = value
 
+    @staticmethod
+    def gauge_key(name: str, **labels: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+        """Key builder for collector result dicts (see set_collector)."""
+        return (name, tuple(sorted(labels.items())))
+
+    def set_collector(self, fn, names: Tuple[str, ...] = ()) -> None:
+        """Register a hook invoked at the start of every render(): the
+        reference's custom-collector pattern (prometheus_metrics.go collect)
+        — current-state gauges are recomputed from live state per scrape, so
+        they can't go stale through any mutation path. ``fn`` returns a dict
+        of ``gauge_key(...) -> value``; ``names`` declares which gauge names
+        the collector owns. render() swaps every series of the owned names in
+        ONE lock acquisition, so a concurrent scrape never observes a
+        cleared-but-not-yet-repopulated registry, and owned series vanish
+        when the collector returns none for them (deleted experiments)."""
+        self._collector = fn
+        self._collector_names = tuple(names)
+
     def render(self) -> str:
         """Prometheus text exposition format."""
+        if self._collector is not None:
+            try:
+                collected = self._collector()
+            except Exception:
+                # a scrape must not fail because state was mid-mutation —
+                # but a persistent collector bug must not be silent either
+                if not self._collector_error_logged:
+                    self._collector_error_logged = True
+                    logging.getLogger("katib_tpu.metrics").exception(
+                        "gauge collector failed; current-state gauges frozen "
+                        "(logged once)"
+                    )
+                collected = None
+            if collected is not None:
+                names = set(self._collector_names) | {key[0] for key in collected}
+                with self._lock:
+                    for key in [k for k in self._gauges if k[0] in names]:
+                        del self._gauges[key]
+                    self._gauges.update(collected)
         lines: List[str] = []
         with self._lock:
             for (name, labels), value in sorted(self._counters.items()):

@@ -77,6 +77,10 @@ class ExperimentController:
 
         self.events = EventRecorder()
         self.metrics = MetricsRegistry()
+        self.metrics.set_collector(
+            self._collect_current_gauges,
+            names=("katib_experiments_current", "katib_trials_current"),
+        )
         self._completed_seen: set = set()
         self._closed = threading.Event()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
@@ -174,6 +178,31 @@ class ExperimentController:
             self._on_completed(exp)
         self.state.update_experiment(exp)
         return exp
+
+    def _collect_current_gauges(self) -> dict:
+        """katib_experiments_current / katib_trials_current by last condition,
+        recomputed from LIVE state at every /metrics scrape (registered as
+        the MetricsRegistry collector — the reference's custom-collector
+        pattern, trial/util/prometheus_metrics.go collect). Scrape-time
+        recompute means no mutation path can leave them stale: late status
+        flips, post-run straggler kills, and deleted experiments all read
+        correctly on the next scrape. Returns the full gauge map; the
+        registry swaps it in atomically."""
+        key = self.metrics.gauge_key
+        gauges: dict = {}
+        for exp in self.state.list_experiments():
+            for cond in ExperimentCondition:
+                gauges[
+                    key("katib_experiments_current", experiment=exp.name, status=cond.value)
+                ] = 1.0 if cond == exp.status.condition else 0.0
+            counts: dict = {}
+            for t in self.state.list_trials(exp.name):
+                counts[t.condition.value] = counts.get(t.condition.value, 0) + 1
+            for cond in TrialCondition:
+                gauges[
+                    key("katib_trials_current", experiment=exp.name, status=cond.value)
+                ] = float(counts.get(cond.value, 0))
+        return gauges
 
     def _reconcile_trials(self, exp: Experiment, trials: List[Trial]) -> None:
         sts = exp.status

@@ -17,19 +17,50 @@ _DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "katib_tpu", "xla
 _initialized = False
 
 
+def _accelerator_platform(platforms: str, environ=None, libtpu_present=None) -> bool:
+    """Whether the process will (likely) run on an accelerator, decided
+    WITHOUT initializing a backend. ``platforms`` is the lowercased
+    jax_platforms config/env value ("" = auto-detect). On auto-detect,
+    accelerator presence is inferred from env hints / an installed libtpu —
+    a CPU-only host must not get the SIGILL-prone XLA:CPU cache, and a
+    wedged accelerator runtime must not be probed (jax.default_backend()
+    blocks for minutes inside the first trial's worker thread)."""
+    env = os.environ if environ is None else environ
+    if platforms.startswith("cpu"):
+        return False
+    if platforms:
+        return True  # tpu / axon / cuda / ... explicitly selected
+    if libtpu_present is None:
+        import importlib.util
+
+        libtpu_present = importlib.util.find_spec("libtpu") is not None
+    return bool(
+        env.get("PALLAS_AXON_POOL_IPS") or env.get("TPU_NAME") or libtpu_present
+    )
+
+
 def enable_compilation_cache(directory: Optional[str] = None) -> str:
     """Idempotently enable the persistent cache; returns the cache dir.
 
-    Accelerator backends only: XLA:CPU persists AOT results keyed loosely
+    Accelerator platforms only: XLA:CPU persists AOT results keyed loosely
     enough that entries written on a host with different CPU features load
-    with a SIGILL warning — and CPU compiles are cheap anyway."""
+    with a SIGILL warning — and CPU compiles are cheap anyway.
+
+    The platform check reads config/env, NEVER ``jax.default_backend()``:
+    probing the backend initializes it, and on a wedged tunneled-TPU runtime
+    that can block for minutes — inside the first trial's worker thread,
+    before any user code runs (observed as a trial stuck Running forever
+    while its siblings completed)."""
     global _initialized
     import jax
 
     cache_dir = directory or os.environ.get("KATIB_TPU_XLA_CACHE", _DEFAULT_DIR)
     if _initialized:
         return cache_dir
-    if jax.default_backend() == "cpu":
+    platforms = (
+        (jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS") or "").lower()
+    )
+    if not _accelerator_platform(platforms):
         _initialized = True
         return cache_dir
     os.makedirs(cache_dir, exist_ok=True)

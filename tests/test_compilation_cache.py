@@ -1,0 +1,37 @@
+"""enable_compilation_cache platform heuristic: never probes the backend
+(jax.default_backend() can block for minutes on a wedged tunneled runtime —
+observed as a trial stuck Running before user code ran); the decision is the
+pure function _accelerator_platform over config/env/accelerator hints."""
+
+from katib_tpu.utils.compilation import _accelerator_platform
+
+
+def test_explicit_cpu_skips():
+    assert _accelerator_platform("cpu", environ={}, libtpu_present=True) is False
+    assert _accelerator_platform("cpu,tpu", environ={}, libtpu_present=True) is False
+
+
+def test_explicit_accelerator_enables():
+    assert _accelerator_platform("axon", environ={}, libtpu_present=False) is True
+    assert _accelerator_platform("tpu", environ={}, libtpu_present=False) is True
+    assert _accelerator_platform("cuda", environ={}, libtpu_present=False) is True
+
+
+def test_auto_detect_cpu_only_host_skips():
+    assert _accelerator_platform("", environ={}, libtpu_present=False) is False
+
+
+def test_auto_detect_with_libtpu_enables():
+    assert _accelerator_platform("", environ={}, libtpu_present=True) is True
+
+
+def test_auto_detect_with_tunnel_env_enables():
+    assert (
+        _accelerator_platform("", environ={"PALLAS_AXON_POOL_IPS": "10.0.0.1"},
+                              libtpu_present=False)
+        is True
+    )
+    assert (
+        _accelerator_platform("", environ={"TPU_NAME": "pod0"}, libtpu_present=False)
+        is True
+    )

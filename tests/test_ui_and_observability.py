@@ -97,6 +97,40 @@ class TestUIServer:
         _, _, body = get(f"{base}/api/experiments/ui-exp/events?limit=0")
         assert json.loads(body) == []
 
+    def test_current_state_gauges(self, stack):
+        """katib_*_current gauges by last condition, recomputed from live
+        state per scrape (reference prometheus_metrics.go collect):
+        completed experiment shows Succeeded=1 and its trial count in the
+        Succeeded bucket; a deleted experiment's series disappear."""
+        base, ctrl, _ = stack
+        _, _, body = get(f"{base}/metrics")
+        assert 'katib_experiments_current{experiment="ui-exp",status="Succeeded"} 1' in body
+        assert 'katib_experiments_current{experiment="ui-exp",status="Running"} 0' in body
+        assert 'katib_trials_current{experiment="ui-exp",status="Succeeded"}' in body
+        # deletion staleness: a temp experiment's series vanish after delete
+        from katib_tpu.api import (
+            AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+            ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
+        )
+
+        spec = ExperimentSpec(
+            name="gauge-tmp",
+            parameters=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="s"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=lambda a, c: c.report(s=1.0)),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        ctrl.run("gauge-tmp", timeout=30)
+        _, _, body = get(f"{base}/metrics")
+        assert 'experiment="gauge-tmp"' in body
+        ctrl.delete_experiment("gauge-tmp")
+        _, _, body = get(f"{base}/metrics")
+        assert 'katib_experiments_current{experiment="gauge-tmp"' not in body
+        assert 'katib_trials_current{experiment="gauge-tmp"' not in body
+
     def test_prometheus_metrics(self, stack):
         base, _, _ = stack
         status, ctype, body = get(f"{base}/metrics")
