@@ -193,6 +193,27 @@ class TestRoundTrip:
         again = ExperimentSpec.from_json(spec.to_json())
         assert again.to_json() == spec.to_json()
 
+    def test_conditions_and_resources_roundtrip(self):
+        """successCondition/failureCondition and numDevices/numHosts survive
+        the JSON round-trip (they feed the scheduler + gang executor)."""
+        from katib_tpu.api import TrialResources
+
+        spec = make_spec(
+            trial_template=TrialTemplate(
+                command=["python", "t.py"],
+                resources=TrialResources(num_devices=4, num_hosts=2, topology="2x2"),
+                success_condition="metrics['acc'] > 0.5",
+                failure_condition="'OOM' in stdout",
+            )
+        )
+        again = ExperimentSpec.from_json(spec.to_json())
+        t = again.trial_template
+        assert t.success_condition == "metrics['acc'] > 0.5"
+        assert t.failure_condition == "'OOM' in stdout"
+        assert t.resources.num_devices == 4
+        assert t.resources.num_hosts == 2
+        assert t.resources.topology == "2x2"
+
     def test_trial_roundtrip(self):
         from katib_tpu.api import ParameterAssignment, Trial, TrialCondition
 
