@@ -234,6 +234,75 @@ def test_resume_enas_controller_pickle(tmp_path):
         ctrl2.close()
 
 
+def test_resume_hyperband_brackets_continue(tmp_path):
+    """Hyperband's entire algorithm state round-trips through
+    SuggestionState.algorithm_settings (the reference's state-in-settings
+    protocol), which the FromVolume snapshot persists — a fresh controller
+    must CONTINUE the bracket schedule mid-flight and land on exactly the
+    canonical 17-trial structure (4@1 + 2+4@2 + 1+2+4@4 for eta=2, r_l=4)."""
+    from collections import Counter
+
+    root = str(tmp_path)
+    spec = ExperimentSpec(
+        name="resume-hb",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ParameterSpec("budget", ParameterType.INT, FeasibleSpace(min="1", max="4")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec(
+            "hyperband",
+            algorithm_settings=[
+                AlgorithmSetting("eta", "2"),
+                AlgorithmSetting("r_l", "4"),
+                AlgorithmSetting("resource_name", "budget"),
+            ],
+        ),
+        trial_template=TrialTemplate(
+            command=[
+                "python", "-c",
+                "import math, time; time.sleep(0.3); "
+                "x=float('${trialParameters.x}'); b=float('${trialParameters.budget}'); "
+                "print(f'score={x * math.log1p(b)}')",
+            ],
+            trial_parameters=[
+                TrialParameterSpec(name="x", reference="x"),
+                TrialParameterSpec(name="budget", reference="budget"),
+            ],
+        ),
+        max_trial_count=40,
+        parallel_trial_count=4,
+        resume_policy=ResumePolicy.FROM_VOLUME,
+    )
+    ctrl1 = ExperimentController(root_dir=root, devices=list(range(8)))
+    ctrl1.create_experiment(spec)
+    _run_until_partial(ctrl1, "resume-hb", min_done=3)
+    ctrl1.close()
+
+    ctrl2 = ExperimentController(root_dir=root, devices=list(range(8)))
+    try:
+        ctrl2.load_experiment("resume-hb")
+        # the restored suggestion carries hyperband's serialized bracket state
+        sugg = ctrl2.state.get_suggestion("resume-hb")
+        assert sugg is not None and sugg.algorithm_settings, (
+            "hyperband state-in-settings not restored"
+        )
+        exp = ctrl2.run("resume-hb", timeout=300)
+        assert exp.status.is_succeeded, exp.status.message
+        assert ctrl2.suggestions.search_ended("resume-hb")
+        trials = ctrl2.state.list_trials("resume-hb")
+        assert all(t.condition == TrialCondition.SUCCEEDED for t in trials), [
+            (t.name, t.condition.value, t.message) for t in trials
+        ]
+        by_budget = Counter(
+            int(float(t.assignments_dict()["budget"])) for t in trials
+        )
+        assert by_budget[1] == 4 and by_budget[2] == 6 and by_budget[4] == 7, by_budget
+        assert len(trials) == 17
+    finally:
+        ctrl2.close()
+
+
 def test_resume_completed_experiment_noop(tmp_path):
     """Loading a completed experiment must not requeue anything."""
     root = str(tmp_path)
