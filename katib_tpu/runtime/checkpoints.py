@@ -34,6 +34,34 @@ def _pickle_steps(directory: str) -> List[int]:
     return sorted(steps)
 
 
+def store_for(
+    checkpoint_dir: Optional[str],
+    workdir: Optional[str],
+    subdir: Optional[str] = None,
+    rank: int = 0,
+) -> "CheckpointStore":
+    """Resolve a trial's checkpoint store location — shared by
+    TrialContext.checkpoint_store and the gang WorkerContext so the
+    precedence rule lives in one place. ``checkpoint_dir`` (the PBT lineage
+    dir when the suggester provides one) wins over the workdir. Non-primary
+    gang ranks (``rank > 0``) on a SHARED checkpoint_dir get a ``rank-<i>``
+    subdirectory: the pickle fallback writes fixed ``ckpt_<step>`` names, so
+    concurrent ranks in one directory would truncate each other's files;
+    rank 0 keeps the shared root (the lineage contract PBT's exploit copy
+    reads). Per-host workdirs are already disjoint, so no suffix there."""
+    base = checkpoint_dir or workdir
+    if base is None:
+        raise ValueError(
+            "trial has no checkpoint_dir or workdir (run the experiment "
+            "with a root_dir to get per-trial directories)"
+        )
+    if rank and checkpoint_dir is not None:
+        base = os.path.join(base, f"rank-{rank}")
+    if subdir:
+        base = os.path.join(base, subdir)
+    return CheckpointStore(base)
+
+
 class CheckpointStore:
     """Save/restore a pytree (params, opt state, step...) under a directory."""
 

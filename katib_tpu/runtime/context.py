@@ -9,7 +9,6 @@ and the gang-allocated device set from which the trial builds its mesh.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -74,6 +73,17 @@ class TrialContext:
             if len(axis_names) > 1:
                 raise ValueError("pass shape= for multi-axis meshes")
         return Mesh(arr, axis_names)
+
+    def checkpoint_store(self, subdir: Optional[str] = None):
+        """Typed orbax-backed save/restore (runtime/checkpoints.py) rooted at
+        this trial's checkpoint dir (the PBT lineage dir when the suggester
+        provides one) or its workdir — the elastic-resume idiom: restore the
+        latest step at start, save per epoch; a restarted trial
+        (max_trial_restarts, PBT exploit child, controller resume) continues
+        instead of starting over."""
+        from .checkpoints import store_for
+
+        return store_for(self.checkpoint_dir, self.workdir, subdir)
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.assignments.get(name, default)

@@ -83,6 +83,18 @@ class WorkerContext:
 
         return profile_trace(self.workdir, enabled=enabled)
 
+    def checkpoint_store(self, subdir=None):
+        """Elastic-resume store (see TrialContext.checkpoint_store). On a
+        SHARED checkpoint_dir (PBT lineage), non-primary ranks write under a
+        rank-<i> subdirectory so concurrent ranks never contend on the same
+        checkpoint files; rank 0 owns the lineage root. Per-host workdirs
+        are already disjoint."""
+        from .checkpoints import store_for
+
+        return store_for(
+            self.checkpoint_dir, self.workdir, subdir, rank=self.process_id
+        )
+
 
 def main() -> None:
     # CPU-forced gangs (tests, CPU smoke runs): neutralize any accelerator

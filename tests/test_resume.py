@@ -332,6 +332,53 @@ def test_resume_completed_experiment_noop(tmp_path):
         ctrl2.close()
 
 
+def test_elastic_trial_restart_resumes_from_checkpoint(tmp_path):
+    """ctx.checkpoint_store() + max_trial_restarts = elastic trials: a trial
+    that crashes mid-training is restarted by the scheduler and CONTINUES
+    from its last saved step instead of starting over (SURVEY.md §5
+    checkpoint/resume; trial elastic resume)."""
+    from katib_tpu.config import KatibConfig
+
+    progress = []
+
+    def crashy_trial(assignments, ctx):
+        store = ctx.checkpoint_store()
+        restored = store.restore()
+        start = int(restored["epoch"]) + 1 if restored else 0
+        for epoch in range(start, 6):
+            progress.append(epoch)
+            store.save(epoch, {"epoch": epoch})
+            if epoch == 2 and restored is None:
+                raise RuntimeError("simulated crash at epoch 2")
+        ctx.report(score=float(start))  # proves the restart resumed, not restarted
+
+    cfg = KatibConfig()
+    cfg.runtime.max_trial_restarts = 1
+    ctrl = ExperimentController(root_dir=str(tmp_path), config=cfg)
+    try:
+        spec = ExperimentSpec(
+            name="elastic",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=crashy_trial),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        exp = ctrl.run("elastic", timeout=60)
+        assert exp.status.is_succeeded, exp.status.message
+        trial = ctrl.state.list_trials("elastic")[0]
+        # the restart resumed from epoch 3 (after the crash at 2)
+        assert float(trial.observation.metric("score").latest) == 3.0
+        # epochs 0-2 ran once (first attempt), 3-5 ran once (resumed attempt)
+        assert progress == [0, 1, 2, 3, 4, 5], progress
+    finally:
+        ctrl.close()
+
+
 def test_load_unknown_experiment_raises(tmp_path):
     ctrl = ExperimentController(root_dir=str(tmp_path))
     try:
