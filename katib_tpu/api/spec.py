@@ -513,17 +513,36 @@ class NasConfig:
 # Trial template (TPU-native replacement for unstructured K8s runSpec)
 # ---------------------------------------------------------------------------
 
+def parse_topology(topology: Optional[str]) -> Optional[List[int]]:
+    """Parse an "AxB[xC...]" topology string into dims; None when unset or
+    malformed. The ONE parse rule shared by spec validation (which rejects
+    malformed strings at admission) and the trial contexts (which treat
+    malformed as absent — a worker env var bypasses admission)."""
+    if not topology:
+        return None
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        return None
+    return dims if all(d >= 1 for d in dims) else None
+
 @dataclass
 class TrialResources:
     """TPU slice request for one trial — replaces K8s resource requests.
 
     Katib delegates device placement to the trial CRD; here the scheduler
     gang-allocates TPU devices directly (SURVEY.md §7 layer 4).
+    ``topology`` ("2x2", "4x2", ...) must multiply out to ``num_devices``
+    (validated at admission) and becomes the default mesh shape of
+    ``ctx.mesh()`` inside the trial.
     """
 
     num_devices: int = 1          # TPU chips (or virtual CPU devices in tests)
     num_hosts: int = 1            # multi-host slice width (DCN processes)
-    topology: Optional[str] = None  # e.g. "2x2" — informational
+    topology: Optional[str] = None  # e.g. "2x2" — default ctx.mesh() shape
+
+    def topology_dims(self) -> Optional[List[int]]:
+        return parse_topology(self.topology)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"numDevices": self.num_devices, "numHosts": self.num_hosts}
@@ -581,8 +600,12 @@ class TrialTemplate:
     function: Optional[Callable[..., Any]] = None
     trial_parameters: List[TrialParameterSpec] = field(default_factory=list)
     resources: TrialResources = field(default_factory=TrialResources)
-    retain: bool = False  # reference experiment_types.go Retain: keep logs/workdir
-    primary_container_name: str = "training-container"  # parity field
+    # reference experiment_types.go Retain (retainRun): keep the trial's
+    # workdir (stdout/logs/profiles) after successful completion; without it
+    # the scheduler cleans up like the trial controller deletes finished jobs
+    # (trial_controller.go:297). Failed/killed workdirs are always kept for
+    # postmortem.
+    retain: bool = False
     success_condition: str = ""   # reference experiment_types.go:300-308 (GJSON in ref)
     failure_condition: str = ""
     env: Dict[str, str] = field(default_factory=dict)

@@ -231,7 +231,23 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
 
     if t.resources.num_hosts < 1:
         errs.append("trialTemplate.resources.numHosts must be >= 1")
-    elif t.resources.num_hosts > 1 and t.function is not None:
+    if t.resources.topology:
+        dims = t.resources.topology_dims()
+        if dims is None:
+            errs.append(
+                f"trialTemplate.resources.topology {t.resources.topology!r} "
+                "must be 'AxB[xC...]' positive integers"
+            )
+        else:
+            import math as _math
+
+            if _math.prod(dims) != t.resources.num_devices:
+                errs.append(
+                    f"trialTemplate.resources.topology {t.resources.topology!r} "
+                    f"multiplies to {_math.prod(dims)}, but numDevices is "
+                    f"{t.resources.num_devices}"
+                )
+    if t.resources.num_hosts > 1 and t.function is not None:
         errs.append(
             "trialTemplate.resources.numHosts > 1 requires a command or "
             "entryPoint template (an in-memory function cannot be "

@@ -605,6 +605,8 @@ class MultiHostExecutor(SubprocessExecutor):
             base_env["KATIB_TPU_ASSIGNMENTS"] = _json.dumps(trial.assignments_dict())
         if ctx.checkpoint_dir:
             base_env["KATIB_TPU_CHECKPOINT_DIR"] = ctx.checkpoint_dir
+        if template.resources.topology:
+            base_env["KATIB_TPU_TOPOLOGY"] = template.resources.topology
 
         metrics_file = None
         mc = spec.metrics_collector_spec

@@ -403,6 +403,7 @@ class TrialScheduler:
             checkpoint_dir=self._checkpoint_dirs.get(trial.name),
             devices=list(devices),
             labels=dict(trial.labels),
+            topology=spec.trial_template.resources.topology,
         )
 
     CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions
@@ -534,4 +535,21 @@ class TrialScheduler:
                 exp.name, "Trial", trial.name,
                 trial.conditions[-1].reason if trial.conditions else trial.condition.value,
                 trial.message, warning=warning,
+            )
+        # retainRun semantics (trial_controller.go:297 deletes the finished
+        # job unless retain): clean the workdir of successfully-finished
+        # trials; failed/killed/metrics-unavailable workdirs are always kept
+        # for postmortem (a deviation the reference can't offer — its pods
+        # are gone either way).
+        if (
+            not spec.trial_template.retain
+            and self.workdir_root
+            and trial.condition in (TrialCondition.SUCCEEDED, TrialCondition.EARLY_STOPPED)
+        ):
+            import os
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(self.workdir_root, exp.name, trial.name),
+                ignore_errors=True,
             )

@@ -25,6 +25,7 @@ class TrialContext:
     checkpoint_dir: Optional[str] = None
     devices: Optional[List[Any]] = None  # jax devices gang-allocated to this trial
     labels: Dict[str, str] = field(default_factory=dict)
+    topology: Optional[str] = None  # resources.topology — default mesh shape
 
     def report(self, **metrics: float) -> None:
         """Push metrics; raises katib_tpu.runtime.metrics.EarlyStopped when all
@@ -55,7 +56,9 @@ class TrialContext:
         """Build a jax.sharding.Mesh over this trial's allocated devices.
 
         Default: 1-D data mesh. Pass shape for multi-axis (e.g. shape=(2, 4),
-        axis_names=("data", "model")).
+        axis_names=("data", "model")), or set ``resources.topology``
+        ("2x4") in the trial template — it becomes the default shape when
+        the axis count matches.
         """
         import numpy as np
         from jax.sharding import Mesh
@@ -66,12 +69,21 @@ class TrialContext:
 
             devices = jax.devices()
         arr = np.array(devices)
+        if shape is None and self.topology and len(axis_names) > 1:
+            from ..api.spec import parse_topology
+
+            dims = parse_topology(self.topology)
+            if dims is not None and len(dims) == len(axis_names):
+                shape = tuple(dims)
         if shape is not None:
             arr = arr.reshape(shape)
         else:
             arr = arr.reshape((-1,) * 1)
             if len(axis_names) > 1:
-                raise ValueError("pass shape= for multi-axis meshes")
+                raise ValueError(
+                    "pass shape= for multi-axis meshes (or set "
+                    "resources.topology with one dim per axis)"
+                )
         return Mesh(arr, axis_names)
 
     def checkpoint_store(self, subdir: Optional[str] = None):

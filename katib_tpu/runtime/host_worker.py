@@ -43,6 +43,7 @@ class WorkerContext:
         self.checkpoint_dir = checkpoint_dir
         self.process_id = process_id
         self.num_processes = num_processes
+        self.topology = os.environ.get("KATIB_TPU_TOPOLOGY")
         self.labels: Dict[str, str] = {}
 
     def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
@@ -72,10 +73,19 @@ class WorkerContext:
         from jax.sharding import Mesh
 
         arr = np.array(self.jax_devices())
+        if shape is None and self.topology and len(axis_names) > 1:
+            from ..api.spec import parse_topology
+
+            dims = parse_topology(self.topology)
+            if dims is not None and len(dims) == len(axis_names):
+                shape = tuple(dims)
         if shape is not None:
             arr = arr.reshape(shape)
         elif len(axis_names) > 1:
-            raise ValueError("pass shape= for multi-axis meshes")
+            raise ValueError(
+                "pass shape= for multi-axis meshes (or set "
+                "resources.topology with one dim per axis)"
+            )
         return Mesh(arr, axis_names)
 
     def profile(self, enabled: bool = True):

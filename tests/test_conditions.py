@@ -178,6 +178,28 @@ class TestConditionsEndToEnd:
         assert trials[0].condition == TrialCondition.FAILED
         assert "success condition not met" in trials[0].message
 
+    def test_retain_controls_workdir_cleanup(self, controller, tmp_path):
+        """retainRun semantics (trial_controller.go:297): a successful
+        trial's workdir is deleted unless retain; failed workdirs are always
+        kept for postmortem."""
+        import os
+
+        for name, body, retain, expect_kept in (
+            ("ret-del", "print('score=1')", False, False),   # success, cleaned
+            ("ret-keep", "print('score=1')", True, True),    # success, retained
+            ("ret-fail", "import sys; print('score=1'); sys.exit(3)", False, True),
+        ):
+            spec = _subproc_spec(name, body)
+            spec.trial_template.retain = retain
+            spec.max_failed_trial_count = 1
+            controller.create_experiment(spec)
+            controller.run(name, timeout=60)
+            trial = controller.state.list_trials(name)[0]
+            workdir = os.path.join(controller.root_dir, "trials", name, trial.name)
+            assert os.path.exists(workdir) == expect_kept, (
+                name, trial.condition.value
+            )
+
     def test_admission_rejects_invalid_condition(self, controller):
         spec = _subproc_spec(
             "bad-cond",

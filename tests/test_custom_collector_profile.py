@@ -147,7 +147,8 @@ class TestProfiler:
         c = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
         try:
             spec = _spec(
-                "prof", MetricsCollectorSpec(), TrialTemplate(function=trial_fn)
+                "prof", MetricsCollectorSpec(),
+                TrialTemplate(function=trial_fn, retain=True),
             )
             c.create_experiment(spec)
             c.run("prof", timeout=60)

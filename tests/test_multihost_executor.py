@@ -70,6 +70,7 @@ def test_two_host_lm_trial_e2e(controller):
             # contributes its own (single) CPU device to the global mesh
             env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
             resources=TrialResources(num_devices=1, num_hosts=2),
+            retain=True,  # the test inspects host workdirs post-run
         ),
         max_trial_count=1,
         parallel_trial_count=1,

@@ -329,6 +329,49 @@ class TestPipelineParallel:
         assert losses[-1] < losses[0]
 
 
+class TestTopologyMesh:
+    def test_ctx_mesh_uses_topology_shape(self, devices):
+        from katib_tpu.runtime.context import TrialContext
+
+        ctx = TrialContext(
+            trial_name="t", experiment_name="e", assignments={},
+            reporter=None, devices=list(devices[:4]), topology="2x2",
+        )
+        mesh = ctx.mesh(axis_names=("data", "model"))
+        assert mesh.devices.shape == (2, 2)
+        # explicit shape still wins over topology
+        mesh = ctx.mesh(axis_names=("data", "model"), shape=(4, 1))
+        assert mesh.devices.shape == (4, 1)
+        # 1-D default ignores topology
+        assert ctx.mesh().devices.shape == (4,)
+
+    def test_topology_validated_against_num_devices(self):
+        from katib_tpu.api import (
+            AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+            ObjectiveType, ParameterSpec, ParameterType, TrialResources,
+            TrialTemplate, ValidationError, validate_experiment,
+        )
+
+        spec = ExperimentSpec(
+            name="topo",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="s"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                entry_point="m:f",
+                resources=TrialResources(num_devices=4, topology="2x3"),
+            ),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        with pytest.raises(ValidationError, match="multiplies to 6"):
+            validate_experiment(spec, known_algorithms={"random"})
+        spec.trial_template.resources.topology = "2x2"
+        validate_experiment(spec, known_algorithms={"random"})
+
+
 class TestPrefetch:
     """Device-prefetching input pipeline (katib_tpu.utils.prefetch)."""
 

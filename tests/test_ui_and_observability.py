@@ -349,6 +349,7 @@ class TestTrialLogsAndTemplates:
                 "command": ["python", "-c",
                             "print('hello-from-trial'); print('score=${trialParameters.x}')"],
                 "trialParameters": [{"name": "x", "reference": "x"}],
+                "retain": True,
             },
             "maxTrialCount": 1,
             "parallelTrialCount": 1,
