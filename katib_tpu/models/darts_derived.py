@@ -1,0 +1,219 @@
+"""Derived (discretized) DARTS network — retraining the searched genotype.
+
+The reference's DARTS flow stops at the search: the trial image prints
+``Best-Genotype`` (examples/v1beta1/trial-images/darts-cnn-cifar10/
+run_trial.py:29-259 / model.py genotype()) and retraining the derived
+architecture is left to the user. Here the derived network is a first-class
+model: ``DerivedNetwork`` instantiates ONLY the genotype's chosen ops (no
+mixed-op weighting, no alphas), and ``run_darts_retrain_trial`` is a trial
+entry point that consumes a ``genotype`` assignment — so the searched
+architecture can itself be trained (or HPO'd over its optimizer settings)
+through the same controller.
+
+TPU notes: identical compute idioms to the supernet (MatmulConv im2col
+matmuls onto the MXU, one jitted train step, traced optimizer
+hyperparameters are unnecessary here since retrain runs once per genotype).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import flax.linen as nn
+
+from ..ops.darts_ops import FactorizedReduce, StdConv, batch_norm, make_op
+from ..utils.datasets import batches, load_cifar10
+
+# genotype genes as nested tuples so flax Module fields stay hashable:
+# ((("sep_conv_3x3", 0), ("skip_connect", 1)), ...) — one inner tuple per node
+Gene = Tuple[Tuple[Tuple[str, int], ...], ...]
+
+
+def gene_from_json(gene_list) -> Gene:
+    """JSON round-trip turns the genotype's (op, edge) tuples into lists;
+    normalize back into hashable nested tuples."""
+    return tuple(
+        tuple((str(op), int(edge)) for op, edge in node) for node in gene_list
+    )
+
+
+class DerivedCell(nn.Module):
+    """A supernet Cell with the mixture collapsed to the chosen ops
+    (reference model.py Cell at deploy time)."""
+
+    gene: Gene
+    channels: int
+    reduction_prev: bool
+    reduction_cur: bool
+
+    @nn.compact
+    def __call__(self, s0, s1):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(channels=self.channels, name="pre0_reduce")(s0)
+        else:
+            s0 = StdConv(channels=self.channels, kernel_size=1, name="pre0")(s0)
+        s1 = StdConv(channels=self.channels, kernel_size=1, name="pre1")(s1)
+
+        states = [s0, s1]
+        for i, node_edges in enumerate(self.gene):
+            acc = None
+            for op_name, j in node_edges:
+                stride = 2 if self.reduction_cur and j < 2 else 1
+                out = make_op(op_name, self.channels, stride)(states[j])
+                acc = out if acc is None else acc + out
+            states.append(acc)
+        return jnp.concatenate(states[2:], axis=-1)
+
+
+class DerivedNetwork(nn.Module):
+    """model.py NetworkCNN with the genotype baked in: same stem, same
+    reduction schedule, cells built from the discrete genes."""
+
+    normal: Gene
+    reduce: Optional[Gene] = None
+    init_channels: int = 16
+    input_channels: int = 3
+    num_classes: int = 10
+    num_layers: int = 8
+    stem_multiplier: int = 3
+
+    def reduction_layers(self):
+        if self.num_layers == 1:
+            return []
+        if self.num_layers == 2:
+            return [1]
+        return [self.num_layers // 3, 2 * self.num_layers // 3]
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.darts_ops import MatmulConv
+
+        c_cur = self.stem_multiplier * self.init_channels
+        s = MatmulConv(c_cur, (3, 3), name="stem")(x)
+        s = batch_norm(s)
+        s0 = s1 = s
+
+        reductions = self.reduction_layers()
+        c = self.init_channels
+        reduction_prev = False
+        for layer in range(self.num_layers):
+            reduction_cur = layer in reductions
+            if reduction_cur:
+                c *= 2
+            gene = (self.reduce or self.normal) if reduction_cur else self.normal
+            cell = DerivedCell(
+                gene=gene,
+                channels=c,
+                reduction_prev=reduction_prev,
+                reduction_cur=reduction_cur,
+                name=f"cell{layer}",
+            )
+            s0, s1 = s1, cell(s0, s1)
+            reduction_prev = reduction_cur
+
+        out = s1.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(out)
+
+
+def run_darts_retrain_trial(assignments: Dict[str, str], ctx=None, **overrides) -> None:
+    """Trial entry point: train the architecture a DARTS search produced.
+
+    ``assignments['genotype']`` is the Best-Genotype JSON the search trial
+    reported; optimizer settings (lr, momentum, weight_decay, num_epochs,
+    batch_size, ...) come from the remaining assignments — making 'retrain
+    the winner, HPO its optimizer' a plain experiment over this entry point.
+    """
+    settings: Dict[str, Any] = dict(assignments)
+    settings.update(overrides)
+    gene_raw = settings.pop("genotype")
+    if isinstance(gene_raw, str):
+        # the search prints Best-Genotype as a Python repr (tuples, single
+        # quotes); literal_eval parses that directly and also accepts plain
+        # JSON (a JSON object without booleans/null is a Python literal)
+        import ast
+
+        try:
+            gene_raw = ast.literal_eval(gene_raw)
+        except (ValueError, SyntaxError):
+            gene_raw = json.loads(gene_raw)
+    lr = float(settings.get("lr", 0.025))
+    momentum = float(settings.get("momentum", 0.9))
+    weight_decay = float(settings.get("weight_decay", 3e-4))
+    grad_clip = float(settings.get("grad_clip", 5.0))
+    num_epochs = int(float(settings.get("num_epochs", 10)))
+    batch_size = int(float(settings.get("batch_size", 96)))
+    init_channels = int(float(settings.get("init_channels", 16)))
+    num_layers = int(float(settings.get("num_layers", 8)))
+    stem_multiplier = int(float(settings.get("stem_multiplier", 3)))
+    n_train = int(float(settings.get("num_train_examples", 0) or 0)) or None
+
+    model = DerivedNetwork(
+        normal=gene_from_json(gene_raw["normal"]),
+        reduce=gene_from_json(gene_raw["reduce"]) if gene_raw.get("reduce") else None,
+        init_channels=init_channels,
+        num_layers=num_layers,
+        stem_multiplier=stem_multiplier,
+    )
+
+    x, y = load_cifar10("train", n=n_train)
+    half = len(x) // 2
+    (x_t, y_t), (x_v, y_v) = (x[:half], y[:half]), (x[half:], y[half:])
+    steps_per_epoch = max(half // batch_size, 1)
+
+    from ..utils.modelinit import jitted_init
+
+    params = jitted_init(model, jax.random.PRNGKey(0), jnp.zeros((2,) + x.shape[1:]))
+    schedule = optax.cosine_decay_schedule(lr, max(steps_per_epoch * num_epochs, 1))
+    tx = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.clip_by_global_norm(grad_clip),
+        optax.sgd(schedule, momentum=momentum),
+    )
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        bx, by = batch
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def evaluate(params, batch):
+        bx, by = batch
+        logits = model.apply({"params": params}, bx)
+        return (jnp.argmax(logits, -1) == by).mean()
+
+    rng = np.random.default_rng(0)
+    best_acc = 0.0
+    for _epoch in range(num_epochs):
+        loss = jnp.float32(0.0)
+        for batch in batches(x_t, y_t, min(batch_size, len(x_t)), rng):
+            params, opt_state, loss = step(params, opt_state, batch)
+        import itertools
+
+        accs = [
+            evaluate(params, b)
+            for b in itertools.islice(
+                batches(x_v, y_v, min(batch_size, len(x_v)), rng), 50
+            )
+        ]
+        acc = float(jnp.stack(accs).mean()) if accs else 0.0
+        best_acc = max(best_acc, acc)
+        if ctx is not None:
+            ctx.report(**{"Validation-accuracy": acc, "Train-loss": float(loss)})
+        else:
+            print(f"Validation-accuracy={acc}")
+            print(f"Train-loss={float(loss)}")
+    print(f"Best-accuracy={best_acc}")

@@ -9,6 +9,8 @@ low-discrepancy stream.
 
 from __future__ import annotations
 
+import warnings
+
 from scipy.stats import qmc
 
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
@@ -27,7 +29,13 @@ class SobolSearch(Suggester):
         if skip:
             sampler.fast_forward(skip)
         n = request.current_request_number
-        points = sampler.random(n)
+        with warnings.catch_warnings():
+            # the ask/tell protocol requests whatever the controller's budget
+            # math produces — rarely a power of 2. The balance-property
+            # advisory doesn't apply: fast_forward keeps the global stream
+            # position, so successive requests still walk one Sobol sequence.
+            warnings.simplefilter("ignore", UserWarning)
+            points = sampler.random(n)
         assignments = [
             TrialAssignment(
                 name=self.make_trial_name(request.experiment),

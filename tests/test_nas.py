@@ -121,6 +121,64 @@ class TestDartsSupernet:
                 assert op != "none"
 
 
+class TestDartsDerived:
+    """Retraining the searched genotype (models/darts_derived.py): the
+    supernet's Best-Genotype builds a discrete network that trains through
+    the standard trial entry point — the deploy half of the DARTS flow the
+    reference leaves to the user."""
+
+    def test_derived_network_from_search_genotype(self):
+        from katib_tpu.models.darts_derived import DerivedNetwork, gene_from_json
+        from katib_tpu.models.darts_supernet import DartsSupernet, genotype
+
+        prims = ("max_pooling_3x3", "skip_connection", "separable_convolution_3x3", "none")
+        supernet = DartsSupernet(
+            primitives=prims, init_channels=4, num_layers=2, num_nodes=2, num_classes=10
+        )
+        x = jnp.zeros((2, 16, 16, 3))
+        params = supernet.init(jax.random.PRNGKey(0), x)["params"]
+        gene = genotype(params, prims, num_nodes=2)
+
+        derived = DerivedNetwork(
+            normal=gene_from_json(gene["normal"]),
+            reduce=gene_from_json(gene["reduce"]) if gene.get("reduce") else None,
+            init_channels=4, num_layers=2, stem_multiplier=1,
+        )
+        dparams = derived.init(jax.random.PRNGKey(1), x)["params"]
+        logits = derived.apply({"params": dparams}, x)
+        assert logits.shape == (2, 10)
+        # discrete: no alphas, no mixed-op branches for unchosen primitives
+        import flax
+
+        names = {k[-1] for k in flax.traverse_util.flatten_dict(dparams)}
+        assert not any(n.startswith("alpha_") for n in names)
+
+    def test_retrain_trial_learns(self):
+        """The retrain entry point consumes the search's printed
+        Best-Genotype repr and beats chance on the synthetic set."""
+        from katib_tpu.models.darts_derived import run_darts_retrain_trial
+
+        gene_repr = str({
+            "normal": [[("separable_convolution_3x3", 0), ("skip_connection", 1)],
+                       [("separable_convolution_3x3", 1), ("max_pooling_3x3", 2)]],
+            "normal_concat": [2, 3],
+        })
+        reported = {}
+
+        class Ctx:
+            def report(self, **m):
+                reported.update(m)
+
+        run_darts_retrain_trial(
+            {"genotype": gene_repr, "lr": "0.05"},
+            Ctx(),
+            num_epochs=5, num_train_examples=512, batch_size=32,
+            init_channels=8, num_layers=1, stem_multiplier=1,
+        )
+        # measured ~0.44 at this scale; 10-class chance = 0.1
+        assert reported["Validation-accuracy"] > 0.25
+
+
 class TestEnasSuggestion:
     def make(self):
         return nas_experiment(
