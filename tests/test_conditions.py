@@ -73,6 +73,37 @@ class TestConditionExpressions:
         with pytest.raises(ConditionError):
             parse_condition("exit_code ==")
 
+    def test_fuzz_never_escapes_condition_error(self):
+        """Arbitrary garbage must either parse+evaluate to a bool or raise
+        ConditionError — never crash with anything else and never execute
+        side effects."""
+        import random
+        import string
+
+        rng = random.Random(0)
+        fragments = [
+            "exit_code", "outcome", "metrics", "stdout", "metrics['a']",
+            "==", "<", ">=", "and", "or", "not", "in", "+", "*", "/",
+            "0", "1.5", "'x'", "(", ")", "[", "]", "__import__", ".", ",",
+            "lambda", ":", "None", "True",
+        ]
+        for i in range(500):
+            if i % 2:
+                # raw printable garbage (control chars, quotes, backslashes)
+                expr = "".join(rng.choices(string.printable, k=rng.randint(1, 30)))
+            else:
+                expr = " ".join(
+                    rng.choice(fragments) for _ in range(rng.randint(1, 8))
+                )
+            try:
+                result = evaluate_condition(
+                    expr, exit_code=0, outcome="completed",
+                    metrics={"a": 1.0}, stdout="ok",
+                )
+                assert isinstance(result, bool)
+            except ConditionError:
+                pass  # the only acceptable failure mode
+
 
 @pytest.fixture()
 def controller(tmp_path):

@@ -147,6 +147,37 @@ def test_primary_only_metric_collection(controller):
     assert values == [0.5], values
 
 
+def test_concurrent_gangs_get_distinct_coordinators(controller):
+    """Two 2-host gangs running in parallel must not collide on coordinator
+    ports (executor _free_port tracks recently-issued ports) or cross-wire
+    metric collection."""
+    spec = ExperimentSpec(
+        name="mh-parallel",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.25", max="0.25")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(
+            entry_point="gang_trial_helpers:report_and_exit",
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": TESTS_DIR},
+            resources=TrialResources(num_devices=1, num_hosts=2),
+        ),
+        max_trial_count=4,
+        parallel_trial_count=2,  # two gangs in flight at once
+    )
+    controller.create_experiment(spec)
+    exp = controller.run("mh-parallel", timeout=300)
+    assert exp.status.is_succeeded, exp.status.message
+    trials = controller.state.list_trials("mh-parallel")
+    assert len(trials) == 4
+    for t in trials:
+        assert t.condition == TrialCondition.SUCCEEDED, (t.name, t.message)
+        logs = controller.obs_store.get_observation_log(t.name)
+        values = [float(l.value) for l in logs if l.metric_name == "score"]
+        assert values == [0.25], (t.name, values)  # own primary only
+
+
 def test_num_hosts_validation(controller):
     base = dict(
         parameters=[
