@@ -95,6 +95,45 @@ def test_hyperband_multi_bracket_completion(controller):
     )["budget"])) == 4, "optimal trial should have seen the full budget"
 
 
+def test_hyperband_eta3_bracket_structure(controller):
+    """Bracket arithmetic pinned at a second configuration (eta=3, r_l=9,
+    s_max=2): rungs 9@1,3@3,1@9 + 9@3,3@9 + 9@9 = 34 trials, budgets
+    {1: 9, 3: 12, 9: 13} — guards the state-in-settings protocol against
+    regressions away from the eta=2 default the other tests use."""
+    from collections import Counter
+
+    spec = ExperimentSpec(
+        name="hb-eta3",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ParameterSpec("budget", ParameterType.INT, FeasibleSpace(min="1", max="9")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec(
+            "hyperband",
+            algorithm_settings=[
+                AlgorithmSetting("eta", "3"),
+                AlgorithmSetting("r_l", "9"),
+                AlgorithmSetting("resource_name", "budget"),
+            ],
+        ),
+        trial_template=TrialTemplate(function=_trial),
+        max_trial_count=60,
+        parallel_trial_count=9,  # ceil(eta^s_max)
+    )
+    controller.create_experiment(spec)
+    exp = controller.run("hb-eta3", timeout=300)
+    assert exp.status.is_completed, exp.status.message
+    assert controller.suggestions.search_ended("hb-eta3")
+    trials = controller.state.list_trials("hb-eta3")
+    assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
+    by_budget = Counter(int(float(t.assignments_dict()["budget"])) for t in trials)
+    assert by_budget[1] == 9, by_budget
+    assert by_budget[3] == 12, by_budget
+    assert by_budget[9] == 13, by_budget
+    assert len(trials) == 34
+
+
 def test_hyperband_budget_cap_shrinks_gracefully(controller):
     """When maxTrialCount caps the request mid-bracket, later rungs shrink
     (n follows the request number) — the run must still complete cleanly at
