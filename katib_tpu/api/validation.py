@@ -269,9 +269,11 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
             except ConditionError as e:
                 errs.append(f"trialTemplate.{cond_field}: {e}")
                 continue
-            if t.command is None:
-                # in-process trials capture no stdout — a stdout-based
-                # condition would silently never match
+            if t.command is None and t.resources.num_hosts <= 1:
+                # truly in-process trials capture no stdout — a stdout-based
+                # condition would silently never match. Multi-host entryPoint
+                # gangs DO capture stdout (MultiHostExecutor writes the
+                # primary's to host-0/stdout.log), so they are exempt.
                 import ast as _ast
 
                 if any(

@@ -613,7 +613,14 @@ class MultiHostExecutor(SubprocessExecutor):
         if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.file_path:
             metrics_file = mc.source.file_path
             if not os.path.isabs(metrics_file):
-                metrics_file = os.path.join(workdir, metrics_file)
+                # every worker's cwd is its per-host dir (or the shared
+                # working_dir override), so a script writing the relative
+                # filePath from its cwd lands in host-0/<file> for the
+                # primary — watch there, not the trial workdir, or the
+                # collector reports no metrics (single-host runs with
+                # cwd=workdir and is unaffected)
+                base = template.working_dir or os.path.join(workdir, "host-0")
+                metrics_file = os.path.join(base, metrics_file)
 
         monitor = None
         if trial.early_stopping_rules:

@@ -252,9 +252,15 @@ class TrialScheduler:
                     TrialOutcome.FAILED,
                     f"trial exceeded timeout of {self.trial_timeout}s",
                 )
+            # Classify (observation fold + success/failure conditions) BEFORE
+            # the restart decision: a non-zero-exit trial a success_condition
+            # rescues must not burn max_trial_restarts attempts, and an rc=0
+            # trial a failure_condition flips to Failed must be retried like
+            # any other failure.
+            result, observation = self._classify(exp, trial, result)
             restarted = self._maybe_restart(exp, trial, result)
             if not restarted:
-                self._finalize(exp, trial, result)
+                self._finalize(exp, trial, result, observation)
         except Exception:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
             self.state.update_trial(trial)
@@ -361,6 +367,10 @@ class TrialScheduler:
         if attempts >= self.max_trial_restarts:
             return False
         self._restarts[trial.name] = attempts + 1
+        # drop the failed attempt's metrics so the next attempt's fold (and
+        # its success/failure-condition classification) can't mix two
+        # executions — same invariant as the requeue path in experiment.py
+        self.obs_store.delete_observation_log(trial.name)
         trial.set_condition(
             TrialCondition.PENDING,
             "TrialRestarting",
@@ -481,15 +491,22 @@ class TrialScheduler:
             )
         return result
 
-    def _finalize(self, exp: Experiment, trial: Trial, result: ExecutionResult) -> None:
-        """Classification mirroring trial_controller_util.go:42-122 +
-        observation fold (:124-217)."""
-        spec = exp.spec
+    def _classify(self, exp: Experiment, trial: Trial, result: ExecutionResult):
+        """Fold the observation log and apply trial success/failure
+        conditions; returns the (possibly re-classified) result plus the
+        folded observation. Runs before the restart decision in _run_trial."""
         logs = self.obs_store.get_observation_log(trial.name)
-        observation = fold_observation(logs, spec.objective.all_metric_names())
+        observation = fold_observation(logs, exp.spec.objective.all_metric_names())
         trial.observation = observation
-        result = self._apply_conditions(exp, result, observation)
+        return self._apply_conditions(exp, result, observation), observation
 
+    def _finalize(
+        self, exp: Experiment, trial: Trial, result: ExecutionResult, observation
+    ) -> None:
+        """Terminal-condition bookkeeping for a trial whose result has
+        already been classified by _classify (the single classification
+        point); mirrors trial_controller_util.go:42-122."""
+        spec = exp.spec
         obj_metric = observation.metric(spec.objective.objective_metric_name)
         metrics_available = (
             obj_metric is not None and obj_metric.latest != UNAVAILABLE_METRIC_VALUE

@@ -129,7 +129,14 @@ def _compiled_search_step(model: "DartsSupernet", total_steps: int,
     cache keys). Every trial of an HPO sweep reuses the same Python
     callable, so trials 2+ skip jax retracing entirely on top of the
     persistent-XLA-cache compile hit; hyperparameter VALUES arrive through
-    the traced ``hyper`` argument."""
+    the traced ``hyper`` argument.
+
+    Memory bound: the cache pins up to maxsize compiled executables (plus
+    their Module keys) for the life of the process — sized for HPO sweeps,
+    which iterate one static config. A controller sweeping MANY distinct
+    architectures holds ≤16 programs; lower maxsize (or clear the caches via
+    ``_compiled_search_step.cache_clear()``) if device/host memory pressure
+    shows up before eviction does."""
 
     def momentum_of(opt_state):
         # trace of optax.sgd momentum buffer inside the chain

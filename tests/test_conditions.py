@@ -285,3 +285,117 @@ class TestConditionsEndToEnd:
         t = controller.state.list_trials("keep-msg")[0]
         assert "success condition not met" in t.message
         assert "exited with code 7" in t.message
+
+
+class TestConditionsVsRestarts:
+    """Conditions are applied BEFORE the restart decision (r3 advisor):
+    a success-rescued trial must not burn restart attempts; a
+    failure-condition'd rc=0 trial must be retried like any failure."""
+
+    def _controller(self, tmp_path, restarts=1):
+        from katib_tpu.config import KatibConfig, RuntimeConfig
+
+        return ExperimentController(
+            root_dir=str(tmp_path),
+            config=KatibConfig(runtime=RuntimeConfig(max_trial_restarts=restarts)),
+        )
+
+    def _counting_spec(self, name, tmp_path, body, **cond):
+        # every execution appends a line to a marker file — attempts are
+        # observable regardless of the final classification
+        marker = str(tmp_path / f"{name}.attempts")
+        return _subproc_spec(
+            name,
+            f"open({marker!r}, 'a').write('.'); " + body,
+            **cond,
+        ), marker
+
+    def test_success_rescue_skips_restart(self, tmp_path):
+        c = self._controller(tmp_path)
+        try:
+            spec, marker = self._counting_spec(
+                "rescue-no-restart", tmp_path,
+                "import sys; print('score=0.9'); sys.exit(1)",
+                success="metrics['score'] >= 0.5",
+            )
+            c.create_experiment(spec)
+            c.run("rescue-no-restart", timeout=120)
+            t = c.state.list_trials("rescue-no-restart")[0]
+            assert t.condition == TrialCondition.SUCCEEDED, t.message
+            with open(marker) as f:
+                assert len(f.read()) == 1  # exactly one attempt
+        finally:
+            c.close()
+
+    def test_failure_condition_triggers_restart(self, tmp_path):
+        c = self._controller(tmp_path)
+        try:
+            spec, marker = self._counting_spec(
+                "failcond-restarts", tmp_path,
+                "print('score=0.9'); print('NaN detected')",
+                failure="'NaN detected' in stdout",
+            )
+            spec.max_failed_trial_count = 1
+            c.create_experiment(spec)
+            c.run("failcond-restarts", timeout=120)
+            t = c.state.list_trials("failcond-restarts")[0]
+            assert t.condition == TrialCondition.FAILED
+            with open(marker) as f:
+                assert len(f.read()) == 2  # initial attempt + one restart
+        finally:
+            c.close()
+
+    def test_restart_clears_prior_attempt_metrics(self, tmp_path):
+        """The failed attempt's observation log must not leak into the
+        restarted attempt's condition classification: attempt 1 reports
+        nan_count=1 (failure condition met → restart), attempt 2 reports
+        only score — it must succeed, not re-fail on the stale nan_count."""
+        c = self._controller(tmp_path)
+        try:
+            marker = str(tmp_path / "flaky.marker")
+            body = (
+                "import os; first = not os.path.exists({m!r}); "
+                "open({m!r}, 'a').write('.'); "
+                "print('score=0.9'); "
+                "print('nan_count=1') if first else None"
+            ).format(m=marker)
+            spec = _subproc_spec(
+                "restart-clean-fold", body,
+                failure="metrics['nan_count'] > 0",  # missing metric -> not met
+            )
+            spec.objective.additional_metric_names = ["nan_count"]
+            spec.max_failed_trial_count = 1
+            c.create_experiment(spec)
+            c.run("restart-clean-fold", timeout=120)
+            t = c.state.list_trials("restart-clean-fold")[0]
+            assert t.condition == TrialCondition.SUCCEEDED, t.message
+            with open(marker) as f:
+                assert len(f.read()) == 2
+        finally:
+            c.close()
+
+
+def test_admission_allows_stdout_condition_for_multihost(controller):
+    """Gang entryPoint trials DO capture stdout (MultiHostExecutor writes the
+    primary's to host-0/stdout.log) — a stdout condition must pass admission
+    even though command is None (r3 advisor)."""
+    from katib_tpu.api import TrialResources
+
+    spec = ExperimentSpec(
+        name="stdout-gang",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(
+            entry_point="gang_trial_helpers:report_and_exit",
+            resources=TrialResources(num_hosts=2),
+            success_condition="'done' in stdout",
+        ),
+        max_trial_count=1,
+        parallel_trial_count=1,
+    )
+    controller.create_experiment(spec)  # must not raise
