@@ -8,10 +8,18 @@ full CIFAR-10) must finish inside the 40-minute workflow timeout
 examples/v1beta1/nas/darts-cpu.yaml).
 
 Structure (round-1 failed with an unbounded in-process TPU init that died on
-a wedged backend): the parent process never touches JAX. It launches a child
-per attempt — TPU x3 with backoff, then a CPU fallback — each under a hard
-timeout, and prints the child's one-line JSON (plus diagnostics on
-fallback). The child measures:
+a wedged backend; round-3's driver capture was rc=124 because the children's
+summed worst-case budgets exceeded the driver's own timeout): the parent
+process never touches JAX and enforces ONE total deadline
+(``BENCH_TOTAL_BUDGET``, default 1140 s) from which every child timeout is
+derived. A cheap bounded probe subprocess measures the accelerator's
+round-trip latency FIRST — a wedged tunnel (roundtrip ≫ 10 ms, or a probe
+that hangs) skips the TPU child entirely so the CPU fallback inherits the
+whole envelope. Children self-trim optional stages against
+``BENCH_CHILD_DEADLINE`` and checkpoint every finished stage to
+``BENCH_RESULT_FILE`` so a mid-run kill still yields the stages that
+completed. The sentinel JSON line is therefore printed with time to spare in
+every failure mode. The child measures:
 
 - DARTS bilevel search-step latency (darts-cpu e2e config) and the projected
   1-epoch experiment wall-clock vs the reference's 40-min CI envelope;
@@ -59,6 +67,24 @@ def _peak_flops(device_kind: str):
 # ---------------------------------------------------------------------------
 # Child: actual measurements (runs entirely inside one bounded subprocess)
 # ---------------------------------------------------------------------------
+
+def _child_remaining() -> float:
+    """Seconds left in this child's envelope (inf when unbounded)."""
+    deadline = os.environ.get("BENCH_CHILD_DEADLINE")
+    return float(deadline) - time.time() if deadline else float("inf")
+
+
+def _checkpoint_stage(payload: dict) -> None:
+    """Persist the stages finished so far; the parent salvages this file if
+    the child is killed mid-run, so a deadline never zeroes the evidence."""
+    path = os.environ.get("BENCH_RESULT_FILE")
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
 
 def _force_cpu() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -231,11 +257,21 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
     run_timeout = 2400.0
     deadline = os.environ.get("BENCH_CHILD_DEADLINE")
     if deadline:
-        run_timeout = float(deadline) - time.time() - 30.0  # kill margin
+        run_timeout = _child_remaining() - 30.0  # kill margin
         if run_timeout < 60.0:
             return {"skipped": f"only {run_timeout:.0f}s left in child budget"}
 
     n_trials = int(os.environ.get("BENCH_E2E_TRIALS", "10" if on_tpu else "3"))
+    # trim the trial count to what the envelope can fit rather than letting
+    # ctrl.run raise TimeoutError and lose the whole stage (measured: first
+    # trial ~120s TPU / ~150s CPU including the shared-step compile;
+    # cache-hit trials ~10s TPU / ~280s CPU at the scales below)
+    est_first = 120.0 if on_tpu else 150.0
+    est_trial = 10.0 if on_tpu else 280.0
+    if run_timeout < est_first:
+        return {"skipped": f"{run_timeout:.0f}s left cannot fit the first trial"}
+    n_requested = n_trials
+    n_trials = max(1, min(n_trials, 1 + int((run_timeout - est_first) / est_trial)))
     if on_tpu:
         # model scale at which the synthetic CIFAR stand-in is demonstrably
         # learnable (>=0.9 val-acc in 3 epochs at good hyperparameters)
@@ -300,7 +336,7 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
             m = t.observation.metric("Validation-accuracy") if t.observation else None
             if m is not None and m.max != "unavailable":
                 trial_accs.append(round(float(m.max), 4))
-        return {
+        out = {
             "wallclock_s": round(wallclock, 2),
             "verified": True,
             "algorithm": "tpe",
@@ -309,6 +345,9 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
             "trial_accs": trial_accs,
             "scale": scale,
         }
+        if n_trials < n_requested:
+            out["trimmed_from"] = n_requested  # budget, not capability
+        return out
     finally:
         ctrl.close()
         shutil.rmtree(root, ignore_errors=True)
@@ -372,60 +411,10 @@ def child_main(platform: str) -> None:
         # soft CPU fallback would be reported as the TPU result
         raise SystemExit("tpu child got a CPU backend (accelerator init fell back)")
 
-    darts = _bench_darts(jax, np, on_tpu)
-    lm = _bench_lm(jax, np, on_tpu)
-    lm_large = None
-    if on_tpu and os.environ.get("BENCH_SKIP_LM_LARGE") != "1":
-        try:
-            lm_large = _bench_lm(jax, np, on_tpu, size="large")
-        except Exception as e:
-            lm_large = {"error": f"{type(e).__name__}: {e}"[:300]}
-    flash = _bench_flash_vs_dense(jax, np) if on_tpu else None
-    e2e = None
-    if os.environ.get("BENCH_SKIP_E2E") != "1":
-        try:
-            e2e = _bench_e2e_experiment(jax, np, on_tpu)
-        except Exception as e:  # keep the primary metric even if e2e breaks
-            e2e = {"error": f"{type(e).__name__}: {e}"[:300]}
-
+    darts = _bench_darts(jax, np, on_tpu)  # required: the headline metric
     projected = darts["projected_s"]
     steady_state = darts["step_ms"] / 1e3 * STEPS_PER_EPOCH
-    extras = {
-        "platform": devices[0].platform,
-        "device_kind": lm["device_kind"],
-        "darts_step_ms": round(darts["step_ms"], 2),
-        # the projected headline decomposed: one-time XLA compile vs the
-        # steady-state epoch — quote BOTH when citing this number
-        "darts_compile_s": round(darts["compile_s"], 1),
-        "darts_steady_state_epoch_s": round(steady_state, 2),
-        "lm_step_ms": round(lm["step_ms"], 2),
-        "lm_tokens_per_s": round(lm["tokens_per_s"]),
-        "lm_config": f"params={lm['n_params']}, b={lm['batch']}, T={lm['seq_len']}",
-        "mfu": lm["mfu"],
-        "mfu_small": lm["mfu"],
-    }
-    if lm_large is not None:
-        if "error" in lm_large:
-            extras["lm_large"] = lm_large
-        else:
-            extras["mfu_large"] = lm_large["mfu"]
-            extras["lm_large"] = {
-                "step_ms": round(lm_large["step_ms"], 2),
-                "tokens_per_s": round(lm_large["tokens_per_s"]),
-                "config": f"params={lm_large['n_params']}, b={lm_large['batch']}, T={lm_large['seq_len']}",
-                "compile_s": round(lm_large["compile_s"], 1),
-            }
-    if e2e is not None:
-        extras["e2e_experiment"] = e2e
-    if flash is not None:
-        extras["flash_attention"] = {
-            "flash_ms": round(flash["flash_ms"], 3),
-            "dense_ms": round(flash["dense_ms"], 3),
-            "speedup": round(flash["speedup"], 2),
-            "max_err_vs_dense": flash["max_err_vs_dense"],
-            "shape": flash["shape"],
-        }
-    print(json.dumps({
+    payload = {
         "metric": "darts_cifar10_e2e_projected_wallclock",
         "value": round(projected, 2),
         "unit": (
@@ -434,18 +423,113 @@ def child_main(platform: str) -> None:
             f"{darts['compile_s']:.1f}s)"
         ),
         "vs_baseline": round(BASELINE_SECONDS / projected, 2),
-        "extras": extras,
-    }))
+        "extras": {
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", "cpu"),
+            "darts_step_ms": round(darts["step_ms"], 2),
+            # the projected headline decomposed: one-time XLA compile vs the
+            # steady-state epoch — quote BOTH when citing this number
+            "darts_compile_s": round(darts["compile_s"], 1),
+            "darts_steady_state_epoch_s": round(steady_state, 2),
+        },
+    }
+    extras = payload["extras"]
+    _checkpoint_stage(payload)
+
+    # optional stages, cheapest-first, each budget-gated and checkpointed so
+    # a mid-run kill keeps everything already measured
+    def gate(name: str, need_s: float) -> bool:
+        left = _child_remaining()
+        if left - need_s < 15.0:
+            extras[name] = {"skipped": f"{left:.0f}s left < {need_s:.0f}s estimate"}
+            _checkpoint_stage(payload)
+            return False
+        return True
+
+    if gate("lm", 90.0):
+        try:
+            lm = _bench_lm(jax, np, on_tpu)
+            extras.update({
+                "lm_step_ms": round(lm["step_ms"], 2),
+                "lm_tokens_per_s": round(lm["tokens_per_s"]),
+                "lm_config": f"params={lm['n_params']}, b={lm['batch']}, T={lm['seq_len']}",
+                "mfu": lm["mfu"],
+                "mfu_small": lm["mfu"],
+            })
+        except Exception as e:
+            extras["lm"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if on_tpu and os.environ.get("BENCH_SKIP_LM_LARGE") != "1" and gate("lm_large", 150.0):
+        try:
+            lm_large = _bench_lm(jax, np, on_tpu, size="large")
+            extras["mfu_large"] = lm_large["mfu"]
+            extras["lm_large"] = {
+                "step_ms": round(lm_large["step_ms"], 2),
+                "tokens_per_s": round(lm_large["tokens_per_s"]),
+                "config": f"params={lm_large['n_params']}, b={lm_large['batch']}, T={lm_large['seq_len']}",
+                "compile_s": round(lm_large["compile_s"], 1),
+            }
+        except Exception as e:
+            extras["lm_large"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if on_tpu and gate("flash_attention", 90.0):
+        try:
+            flash = _bench_flash_vs_dense(jax, np)
+            extras["flash_attention"] = {
+                "flash_ms": round(flash["flash_ms"], 3),
+                "dense_ms": round(flash["dense_ms"], 3),
+                "speedup": round(flash["speedup"], 2),
+                "max_err_vs_dense": flash["max_err_vs_dense"],
+                "shape": flash["shape"],
+            }
+        except Exception as e:
+            extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if os.environ.get("BENCH_SKIP_E2E") != "1":
+        try:
+            extras["e2e_experiment"] = _bench_e2e_experiment(jax, np, on_tpu)
+        except Exception as e:  # keep the primary metric even if e2e breaks
+            extras["e2e_experiment"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    print(json.dumps(payload))
 
 
 # ---------------------------------------------------------------------------
 # Parent: bounded orchestration, never initializes JAX itself
 # ---------------------------------------------------------------------------
 
+def _salvage(result_file: str, diag: str):
+    """Recover the stages a killed child had already checkpointed — a
+    deadline mid-run degrades the report to 'partial', never to nothing."""
+    try:
+        with open(result_file) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not payload.get("metric"):
+        return None
+    payload.setdefault("extras", {})["partial"] = diag
+    return payload
+
+
 def _run_child(platform: str, timeout_s: float):
     """Returns (parsed_json | None, diagnostic_str | None)."""
+    import tempfile
+
     env = dict(os.environ)
     env["BENCH_CHILD_DEADLINE"] = str(time.time() + timeout_s)
+    result_file = os.path.join(
+        tempfile.gettempdir(), f"bench-{platform}-{os.getpid()}.json"
+    )
+    try:
+        os.unlink(result_file)  # never salvage a previous attempt's file
+    except OSError:
+        pass
+    env["BENCH_RESULT_FILE"] = result_file
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
@@ -456,10 +540,12 @@ def _run_child(platform: str, timeout_s: float):
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
-        return None, f"{platform} child timed out after {timeout_s:.0f}s"
+        diag = f"{platform} child timed out after {timeout_s:.0f}s"
+        return _salvage(result_file, diag), diag
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
-        return None, f"{platform} child rc={proc.returncode}: {' | '.join(tail)[-400:]}"
+        diag = f"{platform} child rc={proc.returncode}: {' | '.join(tail)[-400:]}"
+        return _salvage(result_file, diag), diag
     for line in reversed(proc.stdout.strip().splitlines()):
         if line.startswith("{"):
             try:
@@ -469,46 +555,124 @@ def _run_child(platform: str, timeout_s: float):
     return None, f"{platform} child produced no JSON line"
 
 
+def _probe_tpu(timeout_s: float):
+    """Bounded probe subprocess: init the accelerator backend and measure the
+    host round-trip BEFORE committing the TPU child's budget. A wedged axon
+    tunnel either blocks init for minutes (the timeout catches it) or shows a
+    degraded round-trip (the threshold catches it). Returns (ok, diagnostic)."""
+    max_rt = float(os.environ.get("BENCH_PROBE_MAX_RT_MS", "40"))
+    code = (
+        "import json, jax\n"
+        "d = jax.devices()\n"
+        "assert d[0].platform != 'cpu', 'no accelerator backend'\n"
+        "from katib_tpu.utils.timing import roundtrip_ms\n"
+        "print(json.dumps({'rt_ms': round(roundtrip_ms(), 2),"
+        " 'device_kind': getattr(d[0], 'device_kind', '?')}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s (tunnel wedged or backend hung)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-2:]
+        return False, f"probe rc={proc.returncode}: {' | '.join(tail)[-200:]}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                info = json.loads(line)
+                rt = float(info["rt_ms"])
+            except (ValueError, KeyError, TypeError):
+                continue  # stray log line; keep scanning upward
+            if rt > max_rt:
+                return False, (
+                    f"roundtrip {rt}ms > {max_rt}ms threshold "
+                    "(tunnel degraded; timings would be garbage)"
+                )
+            return True, f"rt {rt}ms on {info.get('device_kind', '?')}"
+    return False, "probe produced no JSON"
+
+
 def main() -> None:
-    tpu_errors = []
-    # TPU init on a wedged tunnel can block for many minutes before erroring;
-    # bound the TPU phase (worst case 1500s + retry) before the CPU fallback
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
-    # the TPU child needs headroom for the DARTS compile (~160s) + LM/flash
-    # stages (now incl. the ~134M-param config) + the 10-trial e2e experiment
-    # (first-trial compile + cache-hit trials); 600s forced the e2e to skip.
-    # A retry after a TIMEOUT gets a shorter leash — a tunnel that burned the
-    # full budget once is likely wedged, and the CPU fallback must still get
-    # its turn. A retry after a fast failure (init error) keeps the full
-    # budget: the TPU may be healthy and the e2e stage must not be skipped.
-    timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
-    retry_timeout_s = float(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", "600"))
-    if os.environ.get("BENCH_FORCE_CPU") != "1":
-        for attempt in range(attempts):
-            prev_timed_out = bool(tpu_errors) and "timed out" in tpu_errors[-1]
-            result, err = _run_child(
-                "tpu", retry_timeout_s if prev_timed_out else timeout_s
-            )
+    """One total deadline governs everything (round-3 lesson: the children's
+    summed worst cases must never exceed what the caller is willing to wait).
+    Order: cheap probe → TPU child (budget minus the CPU reserve) → CPU child
+    (whatever remains) → sentinel. Every arm is derived from `remaining()`,
+    so the sentinel line always prints inside BENCH_TOTAL_BUDGET."""
+    deadline = time.time() + float(os.environ.get("BENCH_TOTAL_BUDGET", "1140"))
+    margin = 20.0  # sentinel/print headroom
+    cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE", "360"))
+
+    def remaining() -> float:
+        return deadline - time.time()
+
+    errors = []
+    use_tpu = os.environ.get("BENCH_FORCE_CPU") != "1"
+    probe_note = None
+    if use_tpu:
+        probe_budget = min(
+            float(os.environ.get("BENCH_PROBE_TIMEOUT", "150")),
+            remaining() - cpu_reserve - margin,
+        )
+        if probe_budget < 10:
+            use_tpu = False
+            errors.append("tpu probe skipped: total budget too small")
+        else:
+            ok, diag = _probe_tpu(probe_budget)
+            probe_note = diag
+            if not ok:
+                use_tpu = False
+                errors.append(f"tpu probe: {diag}")
+    if use_tpu:
+        for attempt in range(int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))):
+            budget = remaining() - cpu_reserve - margin
+            cap = os.environ.get("BENCH_TPU_TIMEOUT")
+            if cap:
+                budget = min(budget, float(cap))
+            if budget < 120:
+                errors.append(
+                    f"tpu attempt {attempt + 1} skipped: {budget:.0f}s left "
+                    "after the CPU reserve"
+                )
+                break
+            result, err = _run_child("tpu", budget)
             if result is not None:
+                extras = result.setdefault("extras", {})
+                if probe_note:
+                    extras["probe"] = probe_note
+                if errors:
+                    extras["tpu_retry_errors"] = errors
                 print(json.dumps(result))
                 return
-            tpu_errors.append(err)
-            if attempt < attempts - 1:
-                time.sleep(10 * (attempt + 1))
-    # measured CPU fallback: ~1100s on a quiet box (darts stage ~170s + lm
-    # ~30s + 3-trial learning e2e ~880s); leave contention headroom
-    result, err = _run_child("cpu", float(os.environ.get("BENCH_CPU_TIMEOUT", "2000")))
-    if result is not None:
-        result.setdefault("extras", {})["tpu_init_errors"] = tpu_errors
-        print(json.dumps(result))
-        return
+            errors.append(err)
+            if "timed out" in (err or ""):
+                break  # the tunnel burned its whole leash; don't re-queue it
+            time.sleep(5)
+    cpu_budget = remaining() - margin
+    cap = os.environ.get("BENCH_CPU_TIMEOUT")
+    if cap:
+        cpu_budget = min(cpu_budget, float(cap))
+    if cpu_budget >= 60:
+        result, err = _run_child("cpu", cpu_budget)
+        if result is not None:
+            result.setdefault("extras", {})["tpu_init_errors"] = errors
+            print(json.dumps(result))
+            return
+        errors.append(err)
+    else:
+        errors.append(f"cpu child skipped: only {cpu_budget:.0f}s left")
     # final fallback: still one parseable JSON line, value = sentinel
     print(json.dumps({
         "metric": "darts_cifar10_e2e_projected_wallclock",
         "value": -1.0,
         "unit": "seconds (BENCH FAILED — see extras.errors)",
         "vs_baseline": 0.0,
-        "extras": {"errors": tpu_errors + [err]},
+        "extras": {"errors": errors},
     }))
 
 

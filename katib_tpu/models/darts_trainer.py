@@ -259,6 +259,23 @@ class DartsSearch:
             "alpha_weight_decay": jnp.float32(self.alpha_weight_decay),
         }
 
+        if self.mesh is not None:
+            # Data-parallel bilevel search (SURVEY §7 hard part 1): supernet
+            # weights, alphas, and optimizer state are explicitly replicated
+            # over the mesh while _epoch_iter shards batches over 'data' —
+            # GSPMD then all-reduces both the weight grads and the
+            # finite-difference Hessian terms of the alpha grads, with no
+            # involuntary resharding of the replicated state.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(self.mesh, P())
+            (self.weights, self.alphas, self.w_opt_state, self.a_opt_state) = (
+                jax.device_put(
+                    (self.weights, self.alphas, self.w_opt_state, self.a_opt_state),
+                    replicated,
+                )
+            )
+
         self._search_step = _compiled_search_step(
             self.model, self.total_steps, self.w_lr_min, self.w_grad_clip
         )

@@ -93,12 +93,19 @@ class Attention(nn.Module):
             from ..parallel.mesh import mesh_axis_sizes
 
             sizes = mesh_axis_sizes(self.mesh)
+            # 'expert' is a batch axis here: outside the MoE layers it acts
+            # as pure data parallelism (see parallel.mesh.activation_batch_axes)
+            batch_axes = ("data", "fsdp", "expert")
             if sizes.get("seq", 1) > 1:
                 # cross-device sequence blocks: ring schedule over ppermute
-                o = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
+                o = ring_attention(
+                    q, k, v, self.mesh, causal=cfg.causal, batch_axes=batch_axes
+                )
             else:
                 # seq unsharded: fused Pallas flash kernel per local shard
-                o = sharded_flash_attention(q, k, v, self.mesh, causal=cfg.causal)
+                o = sharded_flash_attention(
+                    q, k, v, self.mesh, causal=cfg.causal, batch_axes=batch_axes
+                )
         else:
             o = flash_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(
@@ -175,31 +182,114 @@ class MoE(nn.Module):
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(), (nx, hidden, e), jnp.float32
         )
+        if self.mesh is not None:
+            # ZeRO idiom (as for the embed table): expert weights are STORED
+            # with 'fsdp' on the embed dim but COMPUTED gathered — otherwise
+            # the FFN einsums propagate embed-dim-over-'fsdp' onto the
+            # activations, which can't meet the batch-sharded residual layout
+            # without an involuntary full rematerialization. 'expert' and
+            # 'model' stay sharded at compute time.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            w_in = jax.lax.with_sharding_constraint(
+                w_in, NamedSharding(self.mesh, P("expert", None, "model"))
+            )
+            w_gate = jax.lax.with_sharding_constraint(
+                w_gate, NamedSharding(self.mesh, P("expert", None, "model"))
+            )
+            w_out = jax.lax.with_sharding_constraint(
+                w_out, NamedSharding(self.mesh, P("expert", "model", None))
+            )
+
+        ep = bp = 1
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_axis_sizes
+
+            sizes = mesh_axis_sizes(self.mesh)
+            ep = sizes.get("expert", 1)
+            bp = ep * sizes.get("data", 1) * sizes.get("fsdp", 1)
+
+        def _ffn(expert_in, w_in, w_gate, w_out):
+            h = jnp.einsum("bxce,xef->bxcf", expert_in, w_in.astype(cfg.dtype))
+            g = jnp.einsum("bxce,xef->bxcf", expert_in, w_gate.astype(cfg.dtype))
+            return jnp.einsum(
+                "bxcf,xfe->bxce", nn.silu(g) * h, w_out.astype(cfg.dtype)
+            )
+
+        if ep > 1 and nx % ep == 0 and b % bp == 0:
+            # Explicit expert parallelism: tokens arrive batch-sharded over
+            # data×fsdp×expert (activation_batch_axes), each device builds
+            # its batch shard's dispatch buffer locally, and ONE tiled
+            # all_to_all per direction exchanges batch-shards for
+            # expert-shards over the ICI 'expert' axis — where GSPMD's
+            # fallback lowering (all-gather + slice) moves ep× the bytes and
+            # replicates the FFN compute. The batch axes are manual so the
+            # body stays batch-sharded end to end; only 'model' (TP on the
+            # expert FFN matmuls) remains a GSPMD-auto axis.
+            from jax.sharding import PartitionSpec as P
+
+            def dispatch_ffn_combine(dispatch, combine, x, w_in, w_gate, w_out):
+                expert_in = jnp.einsum(
+                    "btxc,bte->bxce", dispatch.astype(cfg.dtype), x
+                )  # [B/bp, X, C, E]
+                expert_in = jax.lax.all_to_all(
+                    expert_in, "expert", split_axis=1, concat_axis=0, tiled=True
+                )  # [B·ep/bp, X/ep, C, E] — each device holds ITS experts' tokens
+                out = _ffn(expert_in, w_in, w_gate, w_out)
+                out = jax.lax.all_to_all(
+                    out, "expert", split_axis=0, concat_axis=1, tiled=True
+                )  # [B/bp, X, C, E] — tokens return to their batch shard
+                return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+
+            batch_axes = ("data", "fsdp", "expert")
+            ein_spec = P(batch_axes, None, None, None)
+            w_spec = P("expert", None, None)  # replicated over data/fsdp,
+            fn = jax.shard_map(                   # 'model' TP stays auto
+                dispatch_ffn_combine,
+                mesh=self.mesh,
+                in_specs=(ein_spec, ein_spec, P(batch_axes, None, None),
+                          w_spec, w_spec, w_spec),
+                out_specs=P(batch_axes, None, None),
+                check_vma=False,
+                axis_names={"data", "fsdp", "expert"},
+            )
+            # jit wrapper: a partial-manual shard_map (axis_names ⊂ mesh
+            # axes) only traces under jit; the wrapper inlines when the
+            # caller is already jitted and makes eager apply/init work too
+            return jax.jit(fn)(dispatch, combine, x, w_in, w_gate, w_out)
 
         expert_in = jnp.einsum(
             "btxc,bte->bxce", dispatch.astype(cfg.dtype), x
         )  # [B, X, C, E]
-        constraint = None
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..parallel.mesh import mesh_axis_sizes
-
-            sizes = mesh_axis_sizes(self.mesh)
-            batch_par = sizes.get("data", 1) * sizes.get("fsdp", 1)
-            batch_axes = ("data", "fsdp") if b % batch_par == 0 else None
-            x_axis = "expert" if nx % sizes.get("expert", 1) == 0 else None
-            if batch_axes or x_axis:
-                constraint = NamedSharding(self.mesh, P(batch_axes, x_axis, None, None))
-        if constraint is not None:
-            # routes the token all-to-all over the 'expert' ICI axis
-            expert_in = jax.lax.with_sharding_constraint(expert_in, constraint)
-        h = jnp.einsum("bxce,xef->bxcf", expert_in, w_in.astype(cfg.dtype))
-        g = jnp.einsum("bxce,xef->bxcf", expert_in, w_gate.astype(cfg.dtype))
-        out = jnp.einsum("bxcf,xfe->bxce", nn.silu(g) * h, w_out.astype(cfg.dtype))
-        if constraint is not None:
-            out = jax.lax.with_sharding_constraint(out, constraint)
+        out = _ffn(expert_in, w_in, w_gate, w_out)
         return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+
+
+def _pin_residual(x, mesh):
+    """Pin the residual stream [B, T, E] to its canonical layout (batch over
+    'data'/'fsdp', sequence over 'seq', embed replicated).
+
+    Without this, GSPMD propagates layouts *through* the residual adds — e.g.
+    the MoE dispatch's batch-over-'expert' sharding meets ring attention's
+    seq-sharded shard_map boundary and the partitioner falls back to an
+    involuntary full rematerialization (replicate, then re-partition) of the
+    activation every step. An explicit constraint at each block boundary
+    keeps every transition a cheap all-to-all/collective-permute."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import activation_batch_axes, mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
+    b, t, _ = x.shape
+    batch_axes = activation_batch_axes(sizes, b) or None
+    seq_axis = "seq" if sizes.get("seq", 1) > 1 and t % sizes["seq"] == 0 else None
+    if batch_axes is None and seq_axis is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch_axes, seq_axis, None))
+    )
 
 
 class Block(nn.Module):
@@ -208,14 +298,17 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        x = x + Attention(self.config, self.mesh, name="attn")(
-            RMSNorm(name="ln1")(x), positions
+        x = _pin_residual(
+            x + Attention(self.config, self.mesh, name="attn")(
+                RMSNorm(name="ln1")(x), positions
+            ),
+            self.mesh,
         )
         if self.config.num_experts > 0:
             x = x + MoE(self.config, self.mesh, name="moe")(RMSNorm(name="ln2")(x))
         else:
             x = x + MLP(self.config, name="mlp")(RMSNorm(name="ln2")(x))
-        return x
+        return _pin_residual(x, self.mesh)
 
 
 class TransformerLM(nn.Module):
@@ -231,7 +324,20 @@ class TransformerLM(nn.Module):
         emb = self.param(
             "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.embed_dim), jnp.float32
         )
-        x = emb[tokens].astype(cfg.dtype)
+        if self.mesh is not None:
+            # ZeRO idiom: the table is STORED sharded over 'fsdp'
+            # (param_sharding_rules) but COMPUTED replicated — one cheap
+            # [V, E] all-gather here instead of the involuntary full
+            # rematerialization the partitioner otherwise emits for the
+            # token-gather forward (and its scatter-add transpose), whose
+            # activations can't transition from embed-dim-sharded to
+            # batch-sharded efficiently.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            emb = jax.lax.with_sharding_constraint(
+                emb, NamedSharding(self.mesh, P(None, None))
+            )
+        x = _pin_residual(emb[tokens].astype(cfg.dtype), self.mesh)
         for i in range(cfg.num_layers):
             x = Block(cfg, self.mesh, name=f"block{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)

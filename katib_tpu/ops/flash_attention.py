@@ -595,13 +595,9 @@ def sharded_flash_attention(
     b, _, h, _ = q.shape
     # Shard only over axes the actual shape divides; anything else computes
     # replicated on those devices (correct, just redundant).
-    batch_list, prod = [], 1
-    for a in batch_axes:
-        s = sizes.get(a, 1)
-        if s > 1 and b % (prod * s) == 0:
-            batch_list.append(a)
-            prod *= s
-    batch = tuple(batch_list) or None
+    from ..parallel.mesh import activation_batch_axes
+
+    batch = activation_batch_axes(sizes, b, batch_axes) or None
     head_size = sizes.get(head_axis, 1)
     head = head_axis if head_size > 1 and h % head_size == 0 else None
     spec = P(batch, None, head, None)

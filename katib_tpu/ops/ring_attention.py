@@ -188,7 +188,14 @@ def ring_attention(
     if sizes.get(seq_axis, 1) == 1:
         return dense_attention(q, k, v, causal=causal)
 
-    spec = P(tuple(a for a in batch_axes if sizes.get(a, 1) > 1) or None, seq_axis, head_axis, None)
+    # shard batch only over axes the batch size actually divides (anything
+    # else computes replicated on those devices — correct, just redundant)
+    from ..parallel.mesh import activation_batch_axes
+
+    spec = P(
+        activation_batch_axes(sizes, q.shape[0], batch_axes) or None,
+        seq_axis, head_axis, None,
+    )
     fn = jax.shard_map(
         functools.partial(ring_attention_local, axis_name=seq_axis, causal=causal),
         mesh=mesh,

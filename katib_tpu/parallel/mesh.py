@@ -96,8 +96,41 @@ def mesh_axis_sizes(mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
-def batch_spec():
-    """Canonical activation sharding: batch over data+fsdp, sequence over seq."""
+ACTIVATION_BATCH_AXES = ("data", "fsdp", "expert")
+
+
+def activation_batch_axes(
+    mesh_or_sizes, batch: int, axes: Sequence[str] = ACTIVATION_BATCH_AXES
+) -> Tuple[str, ...]:
+    """Greedy batch-sharding axes for activations: shard over each of
+    data/fsdp/expert in order while ``batch`` divides the running product.
+
+    'expert' acts as pure extra data parallelism OUTSIDE the MoE layers —
+    attention and norms never compute redundantly across the expert axis —
+    and the MoE dispatch einsum's sharding constraint re-splits tokens
+    expert-wise with one all-to-all at the layer boundary (the scaling-book
+    EP recipe)."""
+    sizes = (
+        mesh_or_sizes
+        if isinstance(mesh_or_sizes, dict)
+        else mesh_axis_sizes(mesh_or_sizes)
+    )
+    out: List[str] = []
+    prod = 1
+    for a in axes:
+        s = sizes.get(a, 1)
+        if s > 1 and batch % (prod * s) == 0:
+            out.append(a)
+            prod *= s
+    return tuple(out)
+
+
+def batch_spec(batch: Optional[int] = None, mesh=None):
+    """Canonical activation sharding: batch over data+fsdp+expert, sequence
+    over seq. With ``batch`` and ``mesh`` given, the batch axes are trimmed
+    to what the batch size actually divides."""
     from jax.sharding import PartitionSpec as P
 
-    return P(("data", "fsdp"), "seq")
+    if batch is None or mesh is None:
+        return P(ACTIVATION_BATCH_AXES, "seq")
+    return P(activation_batch_axes(mesh, batch) or None, "seq")

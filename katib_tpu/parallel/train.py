@@ -72,7 +72,7 @@ def make_lm_train_step(
         # Placement on a non-default chip (a trial gang-allocated to chip k
         # of a multi-chip host) is preserved by running creation and every
         # step under jax.default_device(target) instead of committing.
-        batch_sharding = None
+        batch_mesh = None
     elif multiprocess:
         # Multi-host gang (MultiHostExecutor workers): params must be born
         # globally sharded — device_put can't target another process's
@@ -91,7 +91,7 @@ def make_lm_train_step(
             out_shardings=sharding_tree,
         )
         params = init_fn(jax.random.PRNGKey(seed))
-        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
+        batch_mesh = mesh
     else:
         # shard params + opt state
         flat_specs = {
@@ -105,7 +105,7 @@ def make_lm_train_step(
             param_specs,
             is_leaf=lambda x: not isinstance(x, dict),
         )
-        batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
+        batch_mesh = mesh
 
     # Non-default target chip: uncommitted execution follows the *default*
     # device, so pin creation and every step with jax.default_device —
@@ -163,7 +163,7 @@ def make_lm_train_step(
         if positions is None:
             b, t = tokens.shape
             positions = np.broadcast_to(np.arange(t, dtype="int32"), (b, t))
-        if batch_sharding is None:
+        if batch_mesh is None:
             ctx = (
                 jax.default_device(pin_device)
                 if pin_device is not None
@@ -171,6 +171,11 @@ def make_lm_train_step(
             )
             with ctx:
                 return jnp.asarray(tokens), jnp.asarray(targets), jnp.asarray(positions)
+        from ..parallel.mesh import batch_spec
+
+        batch_sharding = NamedSharding(
+            batch_mesh, batch_spec(tokens.shape[0], batch_mesh)
+        )
         return (
             jax.device_put(tokens, batch_sharding),
             jax.device_put(targets, batch_sharding),

@@ -30,7 +30,9 @@ def host_sync(x) -> float:
 
 def roundtrip_ms(repeats: int = 3) -> float:
     """Per-call dispatch + host-read round-trip latency in milliseconds
-    (~90ms through the axon tunnel, microseconds on a local device)."""
+    (low single-digit ms through a healthy axon tunnel, ~70-90ms when the
+    tunnel is degraded, microseconds on a local device) — bench.py's probe
+    uses this as its tunnel-health signal."""
     import jax
     import jax.numpy as jnp
 
