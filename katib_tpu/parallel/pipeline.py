@@ -20,19 +20,20 @@ Embedding and the tied LM head live outside the rotation (computed on every
 pipe device; only stage 0's embedding and the last stage's head carry
 gradients — masking in the schedule routes cotangents correctly).
 
-Tensor parallelism composes INSIDE each stage: the shard_map is manual over
-'pipe' and 'data' only (``axis_names``), leaving 'model' an automatic GSPMD
-axis — stage weights carry the TP shardings from
-``transformer.param_sharding_rules`` and XLA inserts the within-stage
-all-gathers/reduce-scatters over 'model' while the rotation stays a manual
-ppermute over 'pipe'. This is the standard pp x tp x dp TPU layout: TP on the
-innermost (fastest-ICI) axis, pipeline and data outermost.
+Tensor parallelism and ZeRO compose INSIDE each stage: the shard_map is
+manual over 'pipe' and 'data' only (``axis_names``), leaving 'model' and
+'fsdp' automatic GSPMD axes — stage weights carry the TP + fsdp shardings
+from ``transformer.param_sharding_rules`` (each stage's weights and
+optimizer state are additionally sharded over 'fsdp', gathered at compute,
+grads reduce-scattered back) and XLA inserts the within-stage collectives
+while the rotation stays a manual ppermute over 'pipe'. This is the
+standard pp x fsdp x tp x dp TPU layout: TP on the innermost (fastest-ICI)
+axis, pipeline and data outermost.
 
 Constraints: batch divisible by n_microbatches × data-axis size; positions
 are the standard arange(T) (identical across microbatches, so RoPE state
-doesn't need to travel with activations); mesh axes fsdp/seq/expert must be
-1 on this path (ZeRO/sequence/expert sharding within a stage is future
-work — pipeline composes with DP and TP here).
+doesn't need to travel with activations); mesh axes seq/expert must be 1 on
+this path (sequence/expert sharding within a stage is future work).
 """
 
 from __future__ import annotations
@@ -88,7 +89,7 @@ def make_pipeline_lm_train_step(
     n_stages = sizes.get("pipe", 1)
     if n_stages < 2:
         raise ValueError("pipeline path needs mesh axis 'pipe' >= 2")
-    for axis in ("fsdp", "seq", "expert"):
+    for axis in ("seq", "expert"):
         if sizes.get(axis, 1) != 1:
             raise ValueError(f"pipeline path requires mesh axis '{axis}' == 1")
     if config.num_layers % n_stages != 0:
@@ -104,9 +105,10 @@ def make_pipeline_lm_train_step(
         jax.random.PRNGKey(seed + 1), (config.vocab_size, config.embed_dim), jnp.float32
     ) * 0.02
     blocks = _stack_block_init(config, n_stages, lps, seed)
-    # Stage weights: 'pipe' on the stage dim (manual), the block's TP rules
-    # on the trailing dims ('model' is an auto/GSPMD axis inside the
-    # shard_map; fsdp entries in the rules are size-1 here).
+    # Stage weights: 'pipe' on the stage dim (manual), the block's TP + ZeRO
+    # rules on the trailing dims ('model' and 'fsdp' are auto/GSPMD axes
+    # inside the shard_map: TP splits the matmuls, fsdp shards storage and
+    # gathers at compute).
     import flax
 
     from ..models.transformer import param_sharding_rules

@@ -75,6 +75,17 @@ class CheckpointStore:
                 use_orbax = True
             except ImportError:
                 use_orbax = False
+            if use_orbax and jax.distributed.is_initialized():
+                # Gang workers get INDEPENDENT per-rank stores (store_for:
+                # per-host workdirs / rank-<i> subdirs), but orbax's
+                # CheckpointManager runs sync_global_processes barriers that
+                # assume ONE checkpoint shared by every process — per-rank
+                # saves then deadlock or die on a barrier-name mismatch.
+                # (is_initialized() inspects only the distributed client; it
+                # never initializes the XLA backend.) A future globally-
+                # sharded-array checkpoint path should pass use_orbax=True
+                # and a shared directory explicitly.
+                use_orbax = False
         self.use_orbax = use_orbax
 
     # -- orbax path ----------------------------------------------------------

@@ -24,3 +24,20 @@ def report_and_exit(assignments, ctx):
     """Minimal healthy gang worker: every worker reports (only process 0's
     stdout is collected), then exits 0."""
     ctx.report(score=float(assignments.get("x", "0.5")) + ctx.process_id)
+
+
+def crashy_elastic(assignments, ctx):
+    """Elastic gang worker: every rank checkpoints each epoch; worker 1 dies
+    once at epoch 2, killing the gang. The retried gang must resume every
+    rank from its own last saved epoch instead of step 0 (SURVEY.md §7 hard
+    part 5: gang scheduling composed with checkpoint/resume)."""
+    store = ctx.checkpoint_store()
+    restored = store.restore()
+    start = int(restored["epoch"]) + 1 if restored else 0
+    for epoch in range(start, 6):
+        store.save(epoch, {"epoch": epoch})
+        if epoch == 2 and restored is None and ctx.process_id == 1:
+            os._exit(23)
+        time.sleep(0.15)
+    # primary's value proves the restarted gang RESUMED (start >= 1)
+    ctx.report(resume_epoch=float(start))

@@ -379,6 +379,53 @@ def test_elastic_trial_restart_resumes_from_checkpoint(tmp_path):
         ctrl.close()
 
 
+def test_elastic_gang_restart_resumes_from_checkpoint(tmp_path):
+    """Multi-host elasticity (SURVEY.md §7 hard part 5): a worker killed
+    mid-trial fails the gang deterministically, max_trial_restarts retries
+    it, and every rank of the retried gang resumes from its own latest
+    checkpoint (per-host workdir stores) instead of step 0."""
+    from katib_tpu.api import TrialResources
+    from katib_tpu.config import KatibConfig
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    cfg = KatibConfig()
+    cfg.runtime.max_trial_restarts = 1
+    ctrl = ExperimentController(root_dir=str(tmp_path), config=cfg)
+    try:
+        spec = ExperimentSpec(
+            name="elastic-gang",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="resume_epoch"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                entry_point="gang_trial_helpers:crashy_elastic",
+                env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": tests_dir},
+                resources=TrialResources(num_devices=1, num_hosts=2),
+                retain=True,
+            ),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        exp = ctrl.run("elastic-gang", timeout=300)
+        assert exp.status.is_succeeded, exp.status.message
+        trial = ctrl.state.list_trials("elastic-gang")[0]
+        assert trial.condition == TrialCondition.SUCCEEDED, trial.message
+        # the restarted primary resumed from its checkpoint, not epoch 0
+        resumed_from = float(trial.observation.metric("resume_epoch").latest)
+        assert resumed_from >= 1.0, resumed_from
+        # the retry really happened (restart message recorded on the way)
+        assert any(
+            c.reason == "TrialRestarting" for c in trial.conditions
+        ), [c.reason for c in trial.conditions]
+    finally:
+        ctrl.close()
+
+
 def test_load_unknown_experiment_raises(tmp_path):
     ctrl = ExperimentController(root_dir=str(tmp_path))
     try:
