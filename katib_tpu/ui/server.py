@@ -63,10 +63,25 @@ a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
 <h1>katib-tpu experiments</h1>
 <div id="exps">loading...</div>
 <h2 id="selname"></h2><div id="trials"></div>
+<div id="cmpbar" style="display:none;margin:.5rem 0">
+ <button id="cmpbtn">compare selected</button>
+ <span class="muted">objective curves of the checked trials on one plot</span></div>
+<div id="cmpbox" style="display:none"><h2>trial comparison</h2><div id="cmp"></div></div>
 <pre id="logbox"></pre>
 <div id="nasbox" style="display:none"><h2>architectures (NAS)</h2><div id="nas"></div></div>
 <div id="evbox" style="display:none"><h2>events</h2><div id="events"></div></div>
 <h2>trial templates</h2><div id="templates" class="muted">loading...</div>
+<h2>new experiment</h2>
+<div id="createbox">
+ <div class="muted">POST /api/experiments — paste the bearer token printed at
+ server start; trialTemplate must be a command/entryPoint template (or pick a
+ stored template ref)</div>
+ token <input id="tok" type="password" size="26">
+ &nbsp;template ref <select id="tplref"><option value="">(inline trialTemplate)</option></select>
+ &nbsp;<button id="createbtn">create + run</button>
+ <span id="createmsg" class="muted"></span><br>
+ <textarea id="specbox" rows="14" style="width:100%;font:.78rem/1.3 monospace"></textarea>
+</div>
 <script>
 async function j(u){return (await fetch(u)).json()}
 const esc=s=>String(s??'').replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
@@ -89,26 +104,92 @@ async function load(){
  for(const a of document.querySelectorAll('.explink'))
   a.onclick=(ev)=>{ev.preventDefault();sel(a.dataset.name)};
  if(CUR)sel(CUR)}
+let OBJMETRIC=null;
 async function sel(n){
  CUR=n;
- const ts=await j(`/api/experiments/${encodeURIComponent(n)}/trials`);
+ const [ts,full]=await Promise.all([
+  j(`/api/experiments/${encodeURIComponent(n)}/trials`),
+  j(`/api/experiments/${encodeURIComponent(n)}`)]);
+ OBJMETRIC=full?.spec?.objective?.objectiveMetricName??null;
  const curves=await Promise.all(ts.map(async t=>{
   try{const m=await j(`/api/trials/${encodeURIComponent(t.name)}/metrics?limit=200`);
    return m.filter(x=>!isNaN(parseFloat(x.value))).map(x=>parseFloat(x.value));}
   catch(e){return []}}));
  document.getElementById('selname').textContent=`trials of ${n}`;
+ // the 3s auto-refresh rebuilds this table: carry checked compare boxes over
+ const checked=new Set([...document.querySelectorAll('.cmpsel:checked')].map(c=>c.dataset.trial));
  document.getElementById('trials').innerHTML=table(ts.map((t,i)=>({
+  sel:`<input type="checkbox" class="cmpsel" data-trial="${esc(t.name)}"${checked.has(t.name)?' checked':''}>`,
   trial:esc(t.name),status:esc(t.condition),status_cls:t.condition,
   assignments:`<code>${esc(JSON.stringify(t.assignments))}</code>`,
   metric:esc(t.objective??''),curve:spark(curves[i]),
   logs:`<a href="#" class="loglink" data-exp="${esc(n)}" data-trial="${esc(t.name)}">logs</a>`})),
-  ['trial','status','assignments','metric','curve','logs']);
+  ['sel','trial','status','assignments','metric','curve','logs']);
+ document.getElementById('cmpbar').style.display=ts.length?'block':'none';
  for(const a of document.querySelectorAll('.loglink'))
   a.onclick=async(ev)=>{ev.preventDefault();
    const r=await fetch(`/api/experiments/${encodeURIComponent(a.dataset.exp)}/trials/${encodeURIComponent(a.dataset.trial)}/logs`);
    const b=document.getElementById('logbox');
    b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}
  loadNas(n);loadEvents(n)}
+const PALETTE=['#0b57d0','#b3261e','#0a7d36','#7b5ea7','#b26a00','#00838f','#ad1457','#5d4037'];
+async function compareSel(){
+ const names=[...document.querySelectorAll('.cmpsel:checked')].map(c=>c.dataset.trial);
+ const box=document.getElementById('cmpbox');
+ if(!names.length){box.style.display='none';return}
+ const series=await Promise.all(names.map(async t=>{
+  const m=await j(`/api/trials/${encodeURIComponent(t)}/metrics?limit=500`);
+  return m.filter(x=>(!OBJMETRIC||x.metric===OBJMETRIC)&&!isNaN(parseFloat(x.value)))
+          .map(x=>parseFloat(x.value))}));
+ const w=640,h=240,L=46,B=22,T=10,R=8;
+ const all=series.flat();
+ if(!all.length){box.style.display='block';
+  document.getElementById('cmp').innerHTML='<i>no numeric observations for the objective metric</i>';return}
+ const mn=Math.min(...all),mx=Math.max(...all),rg=(mx-mn)||1;
+ const maxlen=Math.max(...series.map(s=>s.length));
+ const X=i=>L+(maxlen>1?i/(maxlen-1):0)*(w-L-R);
+ const Y=v=>T+(1-(v-mn)/rg)*(h-T-B);
+ let s=`<svg width="${w}" height="${h}" style="background:#fff;box-shadow:0 1px 2px #0002">`;
+ for(const f of [0,0.5,1]){const v=mn+f*rg,y=Y(v);
+  s+=`<line x1="${L}" y1="${y}" x2="${w-R}" y2="${y}" stroke="#eee"/>`+
+     `<text x="${L-4}" y="${y+3}" text-anchor="end" font-size="9" fill="#888">${v.toPrecision(3)}</text>`}
+ s+=`<text x="${(L+w-R)/2}" y="${h-6}" text-anchor="middle" font-size="9" fill="#888">report # (${esc(OBJMETRIC??'objective')})</text>`;
+ series.forEach((vals,k)=>{if(vals.length<1)return;
+  const col=PALETTE[k%PALETTE.length];
+  if(vals.length===1){s+=`<circle cx="${X(0)}" cy="${Y(vals[0])}" r="3" fill="${col}"/>`;return}
+  const pts=vals.map((v,i)=>`${X(i).toFixed(1)},${Y(v).toFixed(1)}`).join(' ');
+  s+=`<polyline points="${pts}" fill="none" stroke="${col}" stroke-width="1.6"/>`});
+ s+='</svg>';
+ const legend=names.map((t,k)=>
+  `<span style="color:${PALETTE[k%PALETTE.length]}">&#9632;</span> ${esc(t)}`).join(' &nbsp; ');
+ box.style.display='block';
+ document.getElementById('cmp').innerHTML=s+`<div class="muted">${legend}</div>`}
+document.getElementById('cmpbtn').onclick=compareSel;
+const SPEC_EXAMPLE={"name":"ui-demo","parameters":[{"name":"x","parameterType":"double",
+  "feasibleSpace":{"min":"0.1","max":"1.0"}}],
+ "objective":{"type":"maximize","objectiveMetricName":"score"},
+ "algorithm":{"algorithmName":"random"},
+ "trialTemplate":{"command":["python","-c",
+  "print('score='+'${trialParameters.x}')"],
+  "trialParameters":[{"name":"x","reference":"x"}]},
+ "maxTrialCount":3,"parallelTrialCount":1};
+document.getElementById('specbox').value=JSON.stringify(SPEC_EXAMPLE,null,1);
+async function createExp(){
+ const msg=document.getElementById('createmsg');
+ msg.textContent='...';
+ let payload;
+ try{payload=JSON.parse(document.getElementById('specbox').value)}
+ catch(e){msg.textContent=`spec is not valid JSON: ${e.message}`;return}
+ const ref=document.getElementById('tplref').value;
+ if(ref){payload.trial_template_ref=ref;delete payload.trialTemplate}
+ const r=await fetch('/api/experiments',{method:'POST',
+  headers:{'Content-Type':'application/json',
+   'X-Katib-Token':document.getElementById('tok').value},
+  body:JSON.stringify(payload)});
+ const out=await r.json();
+ msg.textContent=r.ok?`created ${out.created}`:`error ${r.status}: ${out.error}`;
+ if(r.ok)load()}
+document.getElementById('createbtn').onclick=createExp;
 function archSvg(g){
  const n=g.nodes.length,w=Math.max(n*90,90),h=86;
  let s=`<svg width="${w}" height="${h}">`;
@@ -148,7 +229,11 @@ async function loadTemplates(){
  const names=Object.keys(t);
  document.getElementById('templates').innerHTML=
   names.length?table(names.map(n=>({name:esc(n),
-   template:`<code>${esc(JSON.stringify(t[n]).slice(0,160))}</code>`})),['name','template']):'<i>none</i>'}
+   template:`<code>${esc(JSON.stringify(t[n]).slice(0,160))}</code>`})),['name','template']):'<i>none</i>';
+ const selEl=document.getElementById('tplref');
+ const cur=selEl.value;
+ selEl.innerHTML='<option value="">(inline trialTemplate)</option>'+
+  names.map(n=>`<option${n===cur?' selected':''}>${esc(n)}</option>`).join('')}
 load();loadTemplates();setInterval(load,3000);
 </script></body></html>"""
 

@@ -37,6 +37,15 @@ def crashy_elastic(assignments, ctx):
     for epoch in range(start, 6):
         store.save(epoch, {"epoch": epoch})
         if epoch == 2 and restored is None and ctx.process_id == 1:
+            # don't race worker 0's first save: the resume assertion needs
+            # rank 0 to hold >=1 checkpoint when the gang dies, and process
+            # launch skew on a loaded box can exceed the epoch cadence
+            peer = os.path.join(os.path.dirname(ctx.workdir), "host-0")
+            deadline = time.time() + 30
+            while time.time() < deadline and not any(
+                f.startswith("ckpt_") for f in os.listdir(peer)
+            ):
+                time.sleep(0.05)
             os._exit(23)
         time.sleep(0.15)
     # primary's value proves the restarted gang RESUMED (start >= 1)

@@ -143,9 +143,32 @@ class TestUIServer:
         base, _, _ = stack
         status, ctype, body = get(f"{base}/")
         assert status == 200 and "html" in ctype and "katib-tpu" in body
-        # detail panels: metric sparklines, NAS architecture SVGs, events
-        for fn in ("function spark", "function archSvg", "loadNas", "loadEvents"):
+        # detail panels: metric sparklines, NAS architecture SVGs, events,
+        # the cross-trial comparison plot and the create-experiment form
+        for fn in (
+            "function spark", "function archSvg", "loadNas", "loadEvents",
+            "compareSel", "createExp", "specbox", "cmpbtn",
+        ):
             assert fn in body, f"dashboard missing {fn}"
+        # the form's prefilled example spec is what a first-time user POSTs
+        # unmodified — it must be strict JSON and accepted by the live server
+        import re
+        import urllib.request
+
+        m = re.search(r"const SPEC_EXAMPLE=(\{.*?\});", body, re.S)
+        assert m, "dashboard missing SPEC_EXAMPLE"
+        example = json.loads(m.group(1))
+        example["name"] = "dash-example-post"
+        _, _, token = stack
+        req = urllib.request.Request(
+            f"{base}/api/experiments",
+            data=json.dumps(example).encode(),
+            headers={"Content-Type": "application/json", "X-Katib-Token": token},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 201
+            assert json.loads(r.read())["created"] == "dash-example-post"
         import urllib.error
 
         with pytest.raises(urllib.error.HTTPError) as ei:
