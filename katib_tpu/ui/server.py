@@ -182,13 +182,15 @@ async function createExp(){
  catch(e){msg.textContent=`spec is not valid JSON: ${e.message}`;return}
  const ref=document.getElementById('tplref').value;
  if(ref){payload.trial_template_ref=ref;delete payload.trialTemplate}
- const r=await fetch('/api/experiments',{method:'POST',
-  headers:{'Content-Type':'application/json',
-   'X-Katib-Token':document.getElementById('tok').value},
-  body:JSON.stringify(payload)});
- const out=await r.json();
- msg.textContent=r.ok?`created ${out.created}`:`error ${r.status}: ${out.error}`;
- if(r.ok)load()}
+ try{
+  const r=await fetch('/api/experiments',{method:'POST',
+   headers:{'Content-Type':'application/json',
+    'X-Katib-Token':document.getElementById('tok').value},
+   body:JSON.stringify(payload)});
+  const out=await r.json().catch(()=>({error:`non-JSON response (${r.status})`}));
+  msg.textContent=r.ok?`created ${out.created}`:`error ${r.status}: ${out.error}`;
+  if(r.ok)load()}
+ catch(e){msg.textContent=`request failed: ${e.message}`}}
 document.getElementById('createbtn').onclick=createExp;
 function archSvg(g){
  const n=g.nodes.length,w=Math.max(n*90,90),h=86;

@@ -126,9 +126,19 @@ def main() -> None:
         )
         ctrl.create_experiment(spec)
         t0 = time.time()
-        exp = ctrl.run(name, timeout=args.timeout)
+        verification = "ok"
+        try:
+            exp = ctrl.run(name, timeout=args.timeout)
+        except TimeoutError as e:
+            # record what DID run — a partial artifact beats a lost hour
+            verification = f"run timeout: {e}"
+            exp = ctrl.state.get_experiment(name)
         wallclock = time.time() - t0
-        verify_experiment_results(ctrl, exp)
+        if verification == "ok":
+            try:
+                verify_experiment_results(ctrl, exp)
+            except Exception as e:
+                verification = f"verification failed: {type(e).__name__}: {e}"
 
         trials = ctrl.state.list_trials(name)
         accs, per_trial = [], []
@@ -164,6 +174,7 @@ def main() -> None:
                 a.name: a.value for a in opt.parameter_assignments
             } if opt else None,
             "reason": exp.status.reason.value,
+            "verification": verification,
             "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "trials": per_trial,
         }

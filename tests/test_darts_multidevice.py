@@ -67,3 +67,63 @@ def test_darts_genotype_parity_across_mesh_sizes():
     _, _, s1 = _run(None, epochs=1)
     _, _, s4 = _run(make_mesh(devices[:4]), epochs=1)
     assert s1.genotype() == s4.genotype()
+
+
+def test_darts_hpo_trial_shards_over_gang_devices(tmp_path):
+    """Through the WHOLE stack: a trial gang-allocated 2 devices builds a
+    2-device 'data' mesh inside run_darts_hpo_trial (ctx.mesh) and runs the
+    bilevel search sharded — the controller-level caller of
+    DartsSearch(mesh=...)."""
+    from katib_tpu.api import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialResources,
+        TrialTemplate,
+    )
+    from katib_tpu.api.status import TrialCondition
+    from katib_tpu.controller.experiment import ExperimentController
+
+    meshes = []
+
+    def darts_trial(assignments, ctx):
+        from katib_tpu.models.darts_trainer import run_darts_hpo_trial
+
+        meshes.append(len(ctx.jax_devices()))
+        run_darts_hpo_trial(
+            assignments, ctx,
+            num_epochs=1, num_train_examples=64, batch_size=16,
+            init_channels=2, num_nodes=1, stem_multiplier=1, num_layers=2,
+        )
+
+    ctrl = ExperimentController(
+        root_dir=str(tmp_path), devices=jax.devices()[:2]
+    )
+    try:
+        spec = ExperimentSpec(
+            name="darts-gang",
+            parameters=[
+                ParameterSpec(
+                    "w_lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.1")
+                ),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="Validation-accuracy",
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=darts_trial,
+                resources=TrialResources(num_devices=2),
+            ),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        exp = ctrl.run("darts-gang", timeout=300)
+        assert exp.status.is_succeeded, exp.status.message
+        assert meshes == [2]  # the trial really got (and used) both devices
+        t = ctrl.state.list_trials("darts-gang")[0]
+        assert t.condition == TrialCondition.SUCCEEDED
+        acc = t.observation.metric("Validation-accuracy")
+        assert acc is not None and float(acc.max) > 0.0
+    finally:
+        ctrl.close()

@@ -14,11 +14,15 @@ from katib_tpu.earlystop.medianstop import registered_early_stoppers
 from katib_tpu.suggest.base import registered_algorithms
 
 EXAMPLES = sorted(
-    glob.glob(
+    p
+    for p in glob.glob(
         os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "examples", "**", "*.json"),
         recursive=True,
     )
+    # examples/records/ holds experiment RESULT records (scripts/run_north_star.py),
+    # not submit-able specs
+    if os.sep + "records" + os.sep not in p
 )
 
 
