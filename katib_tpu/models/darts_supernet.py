@@ -73,6 +73,15 @@ class DartsSupernet(nn.Module):
     num_layers: int = 8
     num_nodes: int = 4
     stem_multiplier: int = 3
+    # Rematerialize each cell in the backward pass (jax.checkpoint via
+    # nn.remat): the supernet's activation memory is dominated by the |O|
+    # parallel mixed-op outputs per edge per cell, and the second-order
+    # architect differentiates through five forward/backward passes — remat
+    # caps stored activations at cell boundaries (O(num_layers) tensors)
+    # at the cost of one extra forward per cell in the backward. This is
+    # the TPU answer to SURVEY §7 hard part 1's "memory of the supernet":
+    # trade MXU FLOPs (abundant) for HBM (the bottleneck).
+    remat_cells: bool = False
 
     def reduction_layers(self) -> List[int]:
         if self.num_layers == 1:
@@ -115,13 +124,14 @@ class DartsSupernet(nn.Module):
         s0 = s1 = s
 
         reductions = self.reduction_layers()
+        cell_cls = nn.remat(Cell) if self.remat_cells else Cell
         c = self.init_channels
         reduction_prev = False
         for layer in range(self.num_layers):
             reduction_cur = layer in reductions
             if reduction_cur:
                 c *= 2
-            cell = Cell(
+            cell = cell_cls(
                 primitives=self.primitives,
                 num_nodes=self.num_nodes,
                 channels=c,

@@ -213,6 +213,8 @@ class DartsSearch:
         self.num_nodes = int(s.get("num_nodes", 4))
         self.stem_multiplier = int(s.get("stem_multiplier", 3))
         self.print_step = int(s.get("print_step", 50))
+        # settings arrive as strings from HPO assignments: explicit opt-in
+        remat = str(s.get("remat_cells", "")).strip().lower() in ("1", "true", "yes", "on")
 
         prims = list(primitives)
         if "none" not in prims:
@@ -226,6 +228,7 @@ class DartsSearch:
             num_layers=num_layers,
             num_nodes=self.num_nodes,
             stem_multiplier=self.stem_multiplier,
+            remat_cells=remat,
         )
         self.mesh = mesh
         self.seed = seed

@@ -24,9 +24,10 @@ def _data(n=32, hw=16):
     return (x[: n // 2], y[: n // 2]), (x[n // 2 :], y[n // 2 :])
 
 
-def _run(mesh, epochs=2):
+def _run(mesh, epochs=2, settings=None):
     search = DartsSearch(
-        primitives=PRIMS, num_layers=2, settings=SETTINGS, mesh=mesh, seed=0
+        primitives=PRIMS, num_layers=2, settings=settings or SETTINGS,
+        mesh=mesh, seed=0,
     )
     search.build((16, 16, 3), total_steps=epochs * 2)
     train, valid = _data()
@@ -56,6 +57,20 @@ def test_darts_data_parallel_matches_single_device():
 
     np.testing.assert_allclose(losses_1, losses_2, rtol=2e-4, atol=2e-5)
     assert abs(acc_1 - acc_2) < 1e-6
+
+
+def test_darts_remat_cells_is_semantics_preserving():
+    """remat_cells (jax.checkpoint per cell — the supernet-memory answer)
+    must change only the backward's memory/recompute schedule, never the
+    math: identical losses, accuracy, and genotype."""
+    losses_a, acc_a, sa = _run(None, epochs=1)
+    losses_b, acc_b, sb = _run(
+        None, epochs=1, settings=dict(SETTINGS, remat_cells="1")
+    )
+    assert sb.model.remat_cells and not sa.model.remat_cells
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-5, atol=1e-6)
+    assert abs(acc_a - acc_b) < 1e-6
+    assert sa.genotype() == sb.genotype()
 
 
 def test_darts_genotype_parity_across_mesh_sizes():
