@@ -80,6 +80,11 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     config: TransformerConfig
     mesh: Optional[Any] = None
+    # Set when this module is traced INSIDE a shard_map that is manual over
+    # a sequence axis (pipeline stages with sequence parallelism): attention
+    # runs the ring schedule directly over that axis instead of wrapping its
+    # own shard_map. positions must be GLOBAL (caller offsets by rank).
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -89,7 +94,11 @@ class Attention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         q = rotary_embed(q, positions)
         k = rotary_embed(k, positions)
-        if self.mesh is not None:
+        if self.seq_axis is not None:
+            from ..ops.ring_attention import ring_attention_local
+
+            o = ring_attention_local(q, k, v, self.seq_axis, causal=cfg.causal)
+        elif self.mesh is not None:
             from ..parallel.mesh import mesh_axis_sizes
 
             sizes = mesh_axis_sizes(self.mesh)
@@ -295,11 +304,12 @@ def _pin_residual(x, mesh):
 class Block(nn.Module):
     config: TransformerConfig
     mesh: Optional[Any] = None
+    seq_axis: Optional[str] = None  # see Attention.seq_axis
 
     @nn.compact
     def __call__(self, x, positions):
         x = _pin_residual(
-            x + Attention(self.config, self.mesh, name="attn")(
+            x + Attention(self.config, self.mesh, self.seq_axis, name="attn")(
                 RMSNorm(name="ln1")(x), positions
             ),
             self.mesh,

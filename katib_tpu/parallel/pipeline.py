@@ -30,10 +30,17 @@ while the rotation stays a manual ppermute over 'pipe'. This is the
 standard pp x fsdp x tp x dp TPU layout: TP on the innermost (fastest-ICI)
 axis, pipeline and data outermost.
 
-Constraints: batch divisible by n_microbatches × data-axis size; positions
-are the standard arange(T) (identical across microbatches, so RoPE state
-doesn't need to travel with activations); mesh axes seq/expert must be 1 on
-this path (sequence/expert sharding within a stage is future work).
+Sequence parallelism also composes INSIDE each stage: with mesh axis
+``seq > 1`` the shard_map goes manual over 'seq' as well, tokens and
+activations carry T/seq_par-length shards, and each stage's attention runs
+the ring schedule (``Attention.seq_axis`` → ``ring_attention_local``) over
+the axis — long-context training through a pipeline.
+
+Constraints: batch divisible by n_microbatches × data-axis size; T divisible
+by the seq-axis size; positions are arange(T) offset by the seq rank
+(identical across microbatches, so RoPE state doesn't need to travel with
+activations); mesh axis expert must be 1 on this path (expert sharding
+within a stage is future work).
 """
 
 from __future__ import annotations
@@ -89,17 +96,21 @@ def make_pipeline_lm_train_step(
     n_stages = sizes.get("pipe", 1)
     if n_stages < 2:
         raise ValueError("pipeline path needs mesh axis 'pipe' >= 2")
-    for axis in ("seq", "expert"):
-        if sizes.get(axis, 1) != 1:
-            raise ValueError(f"pipeline path requires mesh axis '{axis}' == 1")
+    if sizes.get("expert", 1) != 1:
+        raise ValueError("pipeline path requires mesh axis 'expert' == 1")
     if config.num_layers % n_stages != 0:
         raise ValueError(
             f"num_layers {config.num_layers} not divisible by pipe={n_stages}"
         )
     lps = config.num_layers // n_stages
     n_micro = num_microbatches or 2 * n_stages
+    # sequence parallelism inside each stage: the shard_map goes manual over
+    # 'seq' too, activations carry T/seq_par tokens, and the stage's
+    # attention runs the ring schedule (Attention.seq_axis) directly over
+    # the axis — long context composes with the pipeline
+    seq_par = sizes.get("seq", 1)
 
-    block = Block(config, mesh=None)
+    block = Block(config, mesh=None, seq_axis="seq" if seq_par > 1 else None)
 
     embed = jax.random.normal(
         jax.random.PRNGKey(seed + 1), (config.vocab_size, config.embed_dim), jnp.float32
@@ -141,11 +152,15 @@ def make_pipeline_lm_train_step(
         return x
 
     def device_loss(embed_p, blocks_local, lnf, tokens, targets):
-        # tokens/targets: [B_local, T]
+        # tokens/targets: [B_local, T_local] (T sharded over 'seq' when
+        # seq_par > 1 — positions must be GLOBAL for RoPE and causality)
         b, t = tokens.shape
         mb = b // n_micro
         stage = jax.lax.axis_index("pipe")
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (mb, t))
+        t_off = jax.lax.axis_index("seq") * t if seq_par > 1 else 0
+        positions = jnp.broadcast_to(
+            t_off + jnp.arange(t, dtype=jnp.int32), (mb, t)
+        )
 
         x = embed_p[tokens].astype(config.dtype).reshape(n_micro, mb, t, -1)
         tgt = targets.reshape(n_micro, mb, t)
@@ -193,30 +208,41 @@ def make_pipeline_lm_train_step(
         masked = jnp.where(stage == n_stages - 1, local / n_micro, 0.0)
         return jax.lax.psum(masked, "pipe")
 
+    def _allmean(g):
+        # replicated-param gradient: average the per-shard contributions
+        # over the batch axis and (with in-stage SP) the sequence axis —
+        # the ring ppermute transposes have already routed cross-shard
+        # cotangents, so each rank holds d(sum of all ranks' losses)/d(its
+        # copy) and the mean over ranks is the shared-param gradient
+        g = jax.lax.pmean(g, "data")
+        return jax.lax.pmean(g, "seq") if seq_par > 1 else g
+
     def spmd_step(embed_p, blocks_local, lnf, tokens, targets):
         loss, grads = jax.value_and_grad(device_loss, argnums=(0, 1, 2))(
             embed_p, blocks_local, lnf, tokens, targets
         )
         g_embed, g_blocks, g_lnf = grads
-        g_embed = jax.lax.pmean(jax.lax.psum(g_embed, "pipe"), "data")
-        g_lnf = jax.lax.pmean(jax.lax.psum(g_lnf, "pipe"), "data")
-        g_blocks = jax.tree.map(lambda g: jax.lax.pmean(g, "data"), g_blocks)
-        loss = jax.lax.pmean(loss, "data")
+        g_embed = _allmean(jax.lax.psum(g_embed, "pipe"))
+        g_lnf = _allmean(jax.lax.psum(g_lnf, "pipe"))
+        g_blocks = jax.tree.map(_allmean, g_blocks)
+        loss = _allmean(loss)
         return loss, g_embed, g_blocks, g_lnf
 
     blocks_spec = jax.tree.map(
         lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), params["blocks"]
     )
-    # Manual over pipe+data only: 'model' stays automatic, so the TP
-    # shardings on the stage weights make XLA insert the within-stage
-    # collectives while the rotation stays a manual ppermute over 'pipe'.
+    # Manual over pipe+data (+seq with in-stage SP): 'model' and 'fsdp' stay
+    # automatic, so the TP/ZeRO shardings on the stage weights make XLA
+    # insert the within-stage collectives while the rotation stays a manual
+    # ppermute over 'pipe' and attention rings over 'seq'.
+    token_spec = P("data", "seq" if seq_par > 1 else None)
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(None, None), blocks_spec, P(None), P("data", None), P("data", None)),
+        in_specs=(P(None, None), blocks_spec, P(None), token_spec, token_spec),
         out_specs=(P(), P(None, None), blocks_spec, P(None)),
         check_vma=False,
-        axis_names={"pipe", "data"},
+        axis_names={"pipe", "data"} | ({"seq"} if seq_par > 1 else set()),
     )
 
     def step(params, opt_state, tokens, targets):
@@ -230,7 +256,7 @@ def make_pipeline_lm_train_step(
 
     step_fn = jax.jit(step, donate_argnums=(0, 1))
 
-    batch_sharding = NamedSharding(mesh, P("data", None))
+    batch_sharding = NamedSharding(mesh, token_spec)
 
     def put_batch(tokens, targets):
         return (
