@@ -150,6 +150,12 @@ class MoE(nn.Module):
 
     config: TransformerConfig
     mesh: Optional[Any] = None
+    # Set when traced INSIDE a shard_map already manual over an expert axis
+    # (pipeline stages with expert parallelism): the module's FFN weights
+    # are created at their LOCAL shard shape [X/ep, E, F] and the token
+    # exchange is a direct all_to_all over the axis — no nested shard_map.
+    expert_axis: Optional[str] = None
+    expert_axis_size: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -182,14 +188,24 @@ class MoE(nn.Module):
         aux = cfg.moe_aux_weight * nx * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "moe_aux_loss", aux)
 
+        # in the manual (in-pipeline) mode the FFN weights live at their
+        # LOCAL shard shape — the stage's shard_map in_specs put 'expert'
+        # on the X dim, so each device holds nx/ep experts
+        nx_local = nx
+        if self.expert_axis is not None:
+            assert nx % self.expert_axis_size == 0, (
+                f"num_experts {nx} not divisible by expert axis "
+                f"{self.expert_axis_size}"
+            )
+            nx_local = nx // self.expert_axis_size
         w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(), (nx, e, hidden), jnp.float32
+            "w_in", nn.initializers.lecun_normal(), (nx_local, e, hidden), jnp.float32
         )
         w_gate = self.param(
-            "w_gate", nn.initializers.lecun_normal(), (nx, e, hidden), jnp.float32
+            "w_gate", nn.initializers.lecun_normal(), (nx_local, e, hidden), jnp.float32
         )
         w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(), (nx, hidden, e), jnp.float32
+            "w_out", nn.initializers.lecun_normal(), (nx_local, hidden, e), jnp.float32
         )
         if self.mesh is not None:
             # ZeRO idiom (as for the embed table): expert weights are STORED
@@ -225,6 +241,26 @@ class MoE(nn.Module):
                 "bxcf,xfe->bxce", nn.silu(g) * h, w_out.astype(cfg.dtype)
             )
 
+        def _a2a_dispatch_ffn_combine(dispatch, combine, x, w_in, w_gate, w_out, axis):
+            expert_in = jnp.einsum(
+                "btxc,bte->bxce", dispatch.astype(cfg.dtype), x
+            )  # [b_local, X, C, E]
+            expert_in = jax.lax.all_to_all(
+                expert_in, axis, split_axis=1, concat_axis=0, tiled=True
+            )  # [b_local·ep, X/ep, C, E] — each device holds ITS experts' tokens
+            out = _ffn(expert_in, w_in, w_gate, w_out)
+            out = jax.lax.all_to_all(
+                out, axis, split_axis=0, concat_axis=1, tiled=True
+            )  # [b_local, X, C, E] — tokens return to their batch shard
+            return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+
+        if self.expert_axis is not None and self.expert_axis_size > 1:
+            # already inside a manual shard_map (pipeline stage): exchange
+            # tokens directly over the axis, weights are pre-sharded
+            return _a2a_dispatch_ffn_combine(
+                dispatch, combine, x, w_in, w_gate, w_out, self.expert_axis
+            )
+
         if ep > 1 and nx % ep == 0 and b % bp == 0:
             # Explicit expert parallelism: tokens arrive batch-sharded over
             # data×fsdp×expert (activation_batch_axes), each device builds
@@ -238,17 +274,9 @@ class MoE(nn.Module):
             from jax.sharding import PartitionSpec as P
 
             def dispatch_ffn_combine(dispatch, combine, x, w_in, w_gate, w_out):
-                expert_in = jnp.einsum(
-                    "btxc,bte->bxce", dispatch.astype(cfg.dtype), x
-                )  # [B/bp, X, C, E]
-                expert_in = jax.lax.all_to_all(
-                    expert_in, "expert", split_axis=1, concat_axis=0, tiled=True
-                )  # [B·ep/bp, X/ep, C, E] — each device holds ITS experts' tokens
-                out = _ffn(expert_in, w_in, w_gate, w_out)
-                out = jax.lax.all_to_all(
-                    out, "expert", split_axis=0, concat_axis=1, tiled=True
-                )  # [B/bp, X, C, E] — tokens return to their batch shard
-                return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+                return _a2a_dispatch_ffn_combine(
+                    dispatch, combine, x, w_in, w_gate, w_out, "expert"
+                )
 
             batch_axes = ("data", "fsdp", "expert")
             ein_spec = P(batch_axes, None, None, None)
@@ -272,6 +300,18 @@ class MoE(nn.Module):
         )  # [B, X, C, E]
         out = _ffn(expert_in, w_in, w_gate, w_out)
         return jnp.einsum("btxc,bxce->bte", combine.astype(cfg.dtype), out)
+
+
+def collect_moe_aux(mutated) -> jnp.ndarray:
+    """Sum every sown 'moe_aux_loss' leaf from a ``mutable=['intermediates']``
+    apply result — the one place the sow key is interpreted (used by both
+    the jit train step and the pipeline's stage loop)."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(mutated.get("intermediates", {}))
+    return jnp.float32(
+        sum(jnp.sum(jnp.asarray(v)) for k, v in flat.items() if "moe_aux_loss" in k)
+    )
 
 
 def _pin_residual(x, mesh):
@@ -304,7 +344,9 @@ def _pin_residual(x, mesh):
 class Block(nn.Module):
     config: TransformerConfig
     mesh: Optional[Any] = None
-    seq_axis: Optional[str] = None  # see Attention.seq_axis
+    seq_axis: Optional[str] = None      # see Attention.seq_axis
+    expert_axis: Optional[str] = None   # see MoE.expert_axis
+    expert_axis_size: int = 1
 
     @nn.compact
     def __call__(self, x, positions):
@@ -315,7 +357,10 @@ class Block(nn.Module):
             self.mesh,
         )
         if self.config.num_experts > 0:
-            x = x + MoE(self.config, self.mesh, name="moe")(RMSNorm(name="ln2")(x))
+            x = x + MoE(
+                self.config, self.mesh, self.expert_axis, self.expert_axis_size,
+                name="moe",
+            )(RMSNorm(name="ln2")(x))
         else:
             x = x + MLP(self.config, name="mlp")(RMSNorm(name="ln2")(x))
         return _pin_residual(x, self.mesh)

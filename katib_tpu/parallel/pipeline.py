@@ -36,11 +36,20 @@ activations carry T/seq_par-length shards, and each stage's attention runs
 the ring schedule (``Attention.seq_axis`` → ``ring_attention_local``) over
 the axis — long-context training through a pipeline.
 
-Constraints: batch divisible by n_microbatches × data-axis size; T divisible
-by the seq-axis size; positions are arange(T) offset by the seq rank
-(identical across microbatches, so RoPE state doesn't need to travel with
-activations); mesh axis expert must be 1 on this path (expert sharding
-within a stage is future work).
+Expert parallelism composes the same way: with ``expert > 1`` the axis is a
+manual batch axis outside the MoE layers (extra data parallelism), each
+device's stage holds num_experts/expert_par expert FFNs
+(``MoE.expert_axis``), one tiled all_to_all per direction exchanges
+batch-shards for expert-shards inside the layer, and per-stage
+load-balance aux losses (computed per shard — the standard per-device MoE
+aux treatment) fold into the pipeline loss via the 'pipe' psum, masked to
+the steps where the stage held a real microbatch.
+
+Constraints: batch divisible by n_microbatches × data-axis size (× the
+expert-axis size when expert > 1); T divisible by the seq-axis size;
+num_experts divisible by the expert-axis size; positions are arange(T)
+offset by the seq rank (identical across microbatches, so RoPE state
+doesn't need to travel with activations).
 """
 
 from __future__ import annotations
@@ -53,7 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..models.transformer import Block, RMSNorm, TransformerConfig
+from ..models.transformer import Block, RMSNorm, TransformerConfig, collect_moe_aux
 from .mesh import mesh_axis_sizes
 
 
@@ -80,6 +89,7 @@ def make_pipeline_lm_train_step(
     learning_rate: float = 1e-3,
     num_microbatches: Optional[int] = None,
     seed: int = 0,
+    tx=None,
 ):
     """Returns (params, opt_state, step_fn, put_batch) with
     step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss)
@@ -96,8 +106,6 @@ def make_pipeline_lm_train_step(
     n_stages = sizes.get("pipe", 1)
     if n_stages < 2:
         raise ValueError("pipeline path needs mesh axis 'pipe' >= 2")
-    if sizes.get("expert", 1) != 1:
-        raise ValueError("pipeline path requires mesh axis 'expert' == 1")
     if config.num_layers % n_stages != 0:
         raise ValueError(
             f"num_layers {config.num_layers} not divisible by pipe={n_stages}"
@@ -109,8 +117,24 @@ def make_pipeline_lm_train_step(
     # attention runs the ring schedule (Attention.seq_axis) directly over
     # the axis — long context composes with the pipeline
     seq_par = sizes.get("seq", 1)
+    # expert parallelism inside each stage: 'expert' is a manual batch axis
+    # outside the MoE layers (extra DP) and the MoE exchanges tokens for
+    # experts with a direct all_to_all over it (MoE.expert_axis); each
+    # device's stage holds num_experts/expert_par expert FFNs
+    expert_par = sizes.get("expert", 1)
+    moe_in_stage = expert_par > 1 and config.num_experts > 0
+    if moe_in_stage and config.num_experts % expert_par != 0:
+        raise ValueError(
+            f"num_experts {config.num_experts} not divisible by "
+            f"expert={expert_par}"
+        )
 
-    block = Block(config, mesh=None, seq_axis="seq" if seq_par > 1 else None)
+    block = Block(
+        config, mesh=None,
+        seq_axis="seq" if seq_par > 1 else None,
+        expert_axis="expert" if moe_in_stage else None,
+        expert_axis_size=expert_par if moe_in_stage else 1,
+    )
 
     embed = jax.random.normal(
         jax.random.PRNGKey(seed + 1), (config.vocab_size, config.embed_dim), jnp.float32
@@ -138,18 +162,26 @@ def make_pipeline_lm_train_step(
         "ln_f": jax.device_put(jnp.ones((config.embed_dim,)), NamedSharding(mesh, P(None))),
     }
 
-    tx = optax.adamw(learning_rate, weight_decay=0.01)
+    tx = tx or optax.adamw(learning_rate, weight_decay=0.01)
     opt_state = tx.init(params)
 
     def stage_apply(blocks_local, x, positions):
-        # blocks_local leaves [1, lps, ...]; scan over the stage's layers
+        # blocks_local leaves [1, lps, ...]; scan over the stage's layers.
+        # MoE stages also surface the sown load-balance aux loss (computed
+        # per shard — the mean over shards approximates the global statistic,
+        # the standard per-device MoE aux treatment).
         layer_params = jax.tree.map(lambda a: a[0], blocks_local)
 
         def one(carry, p):
-            return block.apply({"params": p}, carry, positions), None
+            if config.num_experts > 0:
+                y, mut = block.apply(
+                    {"params": p}, carry, positions, mutable=["intermediates"]
+                )
+                return y, collect_moe_aux(mut)
+            return block.apply({"params": p}, carry, positions), jnp.float32(0.0)
 
-        x, _ = jax.lax.scan(one, x, layer_params)
-        return x
+        x, auxs = jax.lax.scan(one, x, layer_params)
+        return x, jnp.sum(auxs)
 
     def device_loss(embed_p, blocks_local, lnf, tokens, targets):
         # tokens/targets: [B_local, T_local] (T sharded over 'seq' when
@@ -166,7 +198,7 @@ def make_pipeline_lm_train_step(
         tgt = targets.reshape(n_micro, mb, t)
 
         def body(carry, step_i):
-            state, out_buf = carry
+            state, out_buf, aux_tot = carry
             shifted = jax.lax.ppermute(
                 state, "pipe", [(i, (i + 1) % n_stages) for i in range(n_stages)]
             )
@@ -178,18 +210,23 @@ def make_pipeline_lm_train_step(
                 jnp.zeros_like(x[0]),
             )
             x_in = jnp.where(stage == 0, inp, shifted)
-            y = stage_apply(blocks_local, x_in, positions)
+            y, aux = stage_apply(blocks_local, x_in, positions)
+            # aux only counts while this stage holds a REAL microbatch —
+            # bubble steps run on zero activations and would pollute it
+            valid = (step_i >= stage) & (step_i < stage + n_micro)
+            aux_tot = aux_tot + jnp.where(valid, aux, 0.0)
             widx = jnp.clip(step_i - (n_stages - 1), 0, n_micro - 1)
             cur = jax.lax.dynamic_index_in_dim(out_buf, widx, 0, keepdims=False)
             out_buf = jax.lax.dynamic_update_index_in_dim(
                 out_buf, jnp.where(step_i >= n_stages - 1, y, cur), widx, 0
             )
-            return (y, out_buf), None
+            return (y, out_buf, aux_tot), None
 
         state0 = jnp.zeros_like(x[0])
         out_buf0 = jnp.zeros_like(x)
-        (_, out_buf), _ = jax.lax.scan(
-            body, (state0, out_buf0), jnp.arange(n_micro + n_stages - 1)
+        (_, out_buf, aux_tot), _ = jax.lax.scan(
+            body, (state0, out_buf0, jnp.float32(0.0)),
+            jnp.arange(n_micro + n_stages - 1),
         )
 
         # Head + loss. SPMD means every stage executes this code (a
@@ -206,16 +243,41 @@ def make_pipeline_lm_train_step(
 
         local, _ = jax.lax.scan(ce_micro, jnp.float32(0.0), (h, tgt))
         masked = jnp.where(stage == n_stages - 1, local / n_micro, 0.0)
-        return jax.lax.psum(masked, "pipe")
+        # CE lives on the last stage; every stage contributes its own MoE
+        # aux (per-microbatch average) — one psum folds both across 'pipe'
+        return jax.lax.psum(masked + aux_tot / n_micro, "pipe")
 
-    def _allmean(g):
-        # replicated-param gradient: average the per-shard contributions
-        # over the batch axis and (with in-stage SP) the sequence axis —
-        # the ring ppermute transposes have already routed cross-shard
-        # cotangents, so each rank holds d(sum of all ranks' losses)/d(its
-        # copy) and the mean over ranks is the shared-param gradient
+    def _allmean(g, expert_sharded=False):
+        # Parameter gradient vs the MEAN loss over all shards: the ring
+        # ppermute / a2a transposes have already routed cross-shard
+        # cotangents, so each rank holds d(sum of the losses it fed)/d(its
+        # copy). Replicated params average over every batch-like axis
+        # (data, seq, expert). Expert-SHARDED leaves exist once per expert
+        # group — no expert mean — but their per-rank grad already sums the
+        # expert_par device losses of their data rank, so it must be scaled
+        # by 1/expert_par to match the (1/(D·E))·Σ mean-loss gradient that
+        # every other parameter gets.
         g = jax.lax.pmean(g, "data")
-        return jax.lax.pmean(g, "seq") if seq_par > 1 else g
+        if seq_par > 1:
+            g = jax.lax.pmean(g, "seq")
+        if expert_par > 1:
+            g = g / expert_par if expert_sharded else jax.lax.pmean(g, "expert")
+        return g
+
+    # Per-leaf manual specs and an expert-sharded mask: MoE FFN weights are
+    # MANUAL-sharded over 'expert' (each device owns distinct experts), so
+    # their grads must NOT be averaged over the expert axis — every other
+    # block param is replicated across it and must be.
+    spec_map, exp_map = {}, {}
+    for k in flax.traverse_util.flatten_dict(params["blocks"]):
+        rules = tuple(param_sharding_rules(k))
+        spec_map[k] = P("pipe", None, *(
+            ("expert" if (moe_in_stage and r == "expert") else None)
+            for r in rules
+        ))
+        exp_map[k] = moe_in_stage and ("expert" in rules)
+    blocks_spec = flax.traverse_util.unflatten_dict(spec_map)
+    blocks_expert_sharded = flax.traverse_util.unflatten_dict(exp_map)
 
     def spmd_step(embed_p, blocks_local, lnf, tokens, targets):
         loss, grads = jax.value_and_grad(device_loss, argnums=(0, 1, 2))(
@@ -224,25 +286,28 @@ def make_pipeline_lm_train_step(
         g_embed, g_blocks, g_lnf = grads
         g_embed = _allmean(jax.lax.psum(g_embed, "pipe"))
         g_lnf = _allmean(jax.lax.psum(g_lnf, "pipe"))
-        g_blocks = jax.tree.map(_allmean, g_blocks)
+        g_blocks = jax.tree.map(_allmean, g_blocks, blocks_expert_sharded)
         loss = _allmean(loss)
         return loss, g_embed, g_blocks, g_lnf
 
-    blocks_spec = jax.tree.map(
-        lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), params["blocks"]
-    )
-    # Manual over pipe+data (+seq with in-stage SP): 'model' and 'fsdp' stay
-    # automatic, so the TP/ZeRO shardings on the stage weights make XLA
-    # insert the within-stage collectives while the rotation stays a manual
-    # ppermute over 'pipe' and attention rings over 'seq'.
-    token_spec = P("data", "seq" if seq_par > 1 else None)
+    # Manual over pipe+data (+seq/+expert with in-stage SP/EP): 'model' and
+    # 'fsdp' stay automatic, so the TP/ZeRO shardings on the stage weights
+    # make XLA insert the within-stage collectives while the rotation stays
+    # a manual ppermute over 'pipe', attention rings over 'seq', and the
+    # MoE all_to_all rides 'expert'.
+    batch_axes = ("data", "expert") if expert_par > 1 else "data"
+    token_spec = P(batch_axes, "seq" if seq_par > 1 else None)
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
         in_specs=(P(None, None), blocks_spec, P(None), token_spec, token_spec),
         out_specs=(P(), P(None, None), blocks_spec, P(None)),
         check_vma=False,
-        axis_names={"pipe", "data"} | ({"seq"} if seq_par > 1 else set()),
+        axis_names=(
+            {"pipe", "data"}
+            | ({"seq"} if seq_par > 1 else set())
+            | ({"expert"} if expert_par > 1 else set())
+        ),
     )
 
     def step(params, opt_state, tokens, targets):

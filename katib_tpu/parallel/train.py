@@ -127,18 +127,12 @@ def make_lm_train_step(
     def step(params, opt_state, tokens, targets, positions):
         def loss_fn(p):
             if config.num_experts > 0:
+                from ..models.transformer import collect_moe_aux
+
                 logits, mutated = model.apply(
                     {"params": p}, tokens, positions, mutable=["intermediates"]
                 )
-                import flax
-
-                flat = flax.traverse_util.flatten_dict(mutated.get("intermediates", {}))
-                aux = sum(
-                    jnp.sum(jnp.asarray(v))
-                    for k, v in flat.items()
-                    if "moe_aux_loss" in k
-                )
-                return lm_loss(logits, targets) + aux
+                return lm_loss(logits, targets) + collect_moe_aux(mutated)
             logits = model.apply({"params": p}, tokens, positions)
             return lm_loss(logits, targets)
 
