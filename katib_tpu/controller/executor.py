@@ -19,6 +19,7 @@ execution paths:
 from __future__ import annotations
 
 import importlib
+import logging
 import os
 import re
 import signal
@@ -45,6 +46,8 @@ from ..runtime.metrics import (
     parse_text_lines,
     set_current_reporter,
 )
+
+log = logging.getLogger("katib_tpu.executor")
 
 # placeholder grammar is shared with spec validation so the two can't drift
 from ..api.validation import META_PARAM_RE as META_RE, TRIAL_PARAM_RE
@@ -598,7 +601,11 @@ class MultiHostExecutor(SubprocessExecutor):
         ).rstrip(os.pathsep)
         base_env[ENV_TRIAL_NAME] = trial.name
         base_env["KATIB_TPU_EXPERIMENT"] = trial.experiment_name
-        base_env.setdefault("KATIB_TPU_COORDINATOR", f"127.0.0.1:{_free_port()}")
+        # coordinator endpoint: auto-assigned unless the template/env pins it
+        # (a cluster launcher spanning machines). Auto ports come from a
+        # probe-close-bind cycle, so an unrelated process can steal the port
+        # in the window — detected below and retried with a fresh port.
+        auto_port = "KATIB_TPU_COORDINATOR" not in base_env
         base_env["KATIB_TPU_NUM_PROCESSES"] = str(n_hosts)
         if template.entry_point is not None:
             base_env["KATIB_TPU_ENTRY_POINT"] = template.entry_point
@@ -630,47 +637,67 @@ class MultiHostExecutor(SubprocessExecutor):
                 spec.objective.type,
             )
 
-        procs: List[subprocess.Popen] = []
-        outs = []
         stdout0 = os.path.join(workdir, "host-0", "stdout.log")
-        prom_logs: List[MetricLog] = []
-        try:
-            for i in range(n_hosts):
-                hostdir = os.path.join(workdir, f"host-{i}")
-                os.makedirs(hostdir, exist_ok=True)
-                env_i = dict(base_env)
-                env_i["KATIB_TPU_PROCESS_ID"] = str(i)
-                env_i["KATIB_TPU_WORKDIR"] = hostdir
-                if i == 0:
-                    # primary: push binding + metrics file land here only,
-                    # so N workers never produce N duplicate observations
-                    if self.db_path:
-                        env_i[ENV_DB_PATH] = self.db_path
-                    if metrics_file:
-                        env_i[ENV_METRICS_FILE] = metrics_file
-                out = open(os.path.join(hostdir, "stdout.log"), "wb")
-                outs.append(out)
-                procs.append(
-                    subprocess.Popen(
-                        cmd,
-                        stdout=out,
-                        stderr=subprocess.STDOUT,
-                        env=env_i,
-                        cwd=template.working_dir or hostdir,
-                        start_new_session=True,
+        for attempt in range(2):
+            if auto_port:
+                base_env["KATIB_TPU_COORDINATOR"] = f"127.0.0.1:{_free_port()}"
+            procs: List[subprocess.Popen] = []
+            outs = []
+            prom_logs: List[MetricLog] = []
+            try:
+                for i in range(n_hosts):
+                    hostdir = os.path.join(workdir, f"host-{i}")
+                    os.makedirs(hostdir, exist_ok=True)
+                    env_i = dict(base_env)
+                    env_i["KATIB_TPU_PROCESS_ID"] = str(i)
+                    env_i["KATIB_TPU_WORKDIR"] = hostdir
+                    if i == 0:
+                        # primary: push binding + metrics file land here only,
+                        # so N workers never produce N duplicate observations
+                        if self.db_path:
+                            env_i[ENV_DB_PATH] = self.db_path
+                        if metrics_file:
+                            env_i[ENV_METRICS_FILE] = metrics_file
+                    out = open(os.path.join(hostdir, "stdout.log"), "wb")
+                    outs.append(out)
+                    procs.append(
+                        subprocess.Popen(
+                            cmd,
+                            stdout=out,
+                            stderr=subprocess.STDOUT,
+                            env=env_i,
+                            cwd=template.working_dir or hostdir,
+                            start_new_session=True,
+                        )
                     )
+                outcome = self._wait_gang(
+                    procs, stdout0, metrics_file, monitor, spec, handle, prom_logs
                 )
-            outcome = self._wait_gang(
-                procs, stdout0, metrics_file, monitor, spec, handle, prom_logs
-            )
-        except BaseException:
-            # spawn or wait blew up: never orphan already-started workers
-            # (they would block in jax.distributed.initialize forever)
-            self._terminate_gang(procs)
-            raise
-        finally:
-            for out in outs:
-                out.close()
+            except BaseException:
+                # spawn or wait blew up: never orphan already-started workers
+                # (they would block in jax.distributed.initialize forever)
+                self._terminate_gang(procs)
+                raise
+            finally:
+                for out in outs:
+                    out.close()
+            if (
+                attempt == 0
+                and auto_port
+                and outcome is not None
+                and outcome.outcome == TrialOutcome.FAILED
+                and self._port_collision(workdir, base_env["KATIB_TPU_COORDINATOR"])
+            ):
+                # an unrelated process bound our probed port between the
+                # probe close and the coordinator bind — not the trial's
+                # fault; relaunch the whole gang once on a fresh port
+                # (worker stdout logs are truncated by the reopen above)
+                log.warning(
+                    "gang coordinator port was taken (TOCTOU); relaunching "
+                    "trial %s with a fresh port", trial.name,
+                )
+                continue
+            break
 
         if prom_logs:
             self.obs_store.report_observation_log(trial.name, prom_logs)
@@ -688,6 +715,31 @@ class MultiHostExecutor(SubprocessExecutor):
         return ExecutionResult(
             TrialOutcome.COMPLETED, exit_code=rc0, stdout_path=stdout0
         )
+
+    PORT_COLLISION_MARKERS = (
+        b"Address already in use",
+        b"EADDRINUSE",
+        b"Failed to bind",
+        b"address in use",
+    )
+
+    def _port_collision(self, workdir: str, coordinator: str) -> bool:
+        """Did the gang die on a COORDINATOR bind failure? (the TOCTOU
+        window between the _free_port probe closing and the jax.distributed
+        coordinator binding). Only host-0 binds the coordinator, and its
+        error names the endpoint — both are required, so a workload's own
+        unrelated bind failure (e.g. a metrics server on a busy fixed port)
+        is not misclassified and retried."""
+        port = coordinator.rsplit(":", 1)[-1].encode()
+        path = os.path.join(workdir, "host-0", "stdout.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - 8192))
+                tail = f.read()
+        except OSError:
+            return False
+        return port in tail and any(m in tail for m in self.PORT_COLLISION_MARKERS)
 
     def _wait_gang(
         self,

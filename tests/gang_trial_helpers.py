@@ -26,6 +26,25 @@ def report_and_exit(assignments, ctx):
     ctx.report(score=float(assignments.get("x", "0.5")) + ctx.process_id)
 
 
+def bind_fail_once(assignments, ctx):
+    """First gang launch dies with a coordinator bind-failure signature
+    (the _free_port TOCTOU); the executor must relaunch the gang on a fresh
+    port WITHOUT burning a trial restart, and the second launch succeeds."""
+    marker = os.path.join(os.path.dirname(ctx.workdir), "bind.marker")
+    if not os.path.exists(marker):
+        if ctx.process_id == 0:
+            with open(marker, "w") as f:
+                f.write("1")
+        # the real jax.distributed bind failure names the endpoint; the
+        # executor requires BOTH the marker and the coordinator port in
+        # host-0's tail before classifying it as a TOCTOU collision
+        coord = os.environ.get("KATIB_TPU_COORDINATOR", "")
+        print(f"RuntimeError: Failed to bind to {coord}; Address already in use",
+              flush=True)
+        os._exit(1)
+    ctx.report(score=1.0)
+
+
 def crashy_elastic(assignments, ctx):
     """Elastic gang worker: every rank checkpoints each epoch; worker 1 dies
     once at epoch 2, killing the gang. The retried gang must resume every

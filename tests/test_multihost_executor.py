@@ -209,3 +209,34 @@ def test_num_hosts_validation(controller):
                 **base,
             )
         )
+
+
+def test_port_collision_relaunches_gang_without_restart(controller):
+    """A worker dying on a coordinator bind-failure signature (the
+    _free_port TOCTOU: an unrelated process stole the probed port) makes
+    the executor relaunch the whole gang once on a fresh port — inside ONE
+    trial execution, with max_trial_restarts untouched (0 here)."""
+    spec = ExperimentSpec(
+        name="mh-bind",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(
+            entry_point="gang_trial_helpers:bind_fail_once",
+            env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": TESTS_DIR},
+            resources=TrialResources(num_devices=1, num_hosts=2),
+            retain=True,
+        ),
+        max_trial_count=1,
+        parallel_trial_count=1,
+    )
+    controller.create_experiment(spec)
+    exp = controller.run("mh-bind", timeout=300)
+    assert exp.status.is_succeeded, exp.status.message
+    trial = controller.state.list_trials("mh-bind")[0]
+    assert trial.condition == TrialCondition.SUCCEEDED, trial.message
+    assert float(trial.observation.metric("score").latest) == 1.0
+    # no scheduler-level restart was consumed — the relaunch was internal
+    assert not any(c.reason == "TrialRestarting" for c in trial.conditions)
