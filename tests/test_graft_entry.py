@@ -25,7 +25,8 @@ def test_dryrun_multichip_inprocess():
 
 def test_dryrun_multichip_subprocess_under_timeout():
     """The driver invocation shape: fresh interpreter, hard timeout well under
-    the driver's budget. Must finish in <150s on 8 virtual CPU devices."""
+    the driver's budget. Must finish in <240s on 8 virtual CPU devices
+    (six legs; ~126s measured on a quiet 1-core box)."""
     env = dict(os.environ)
     # Simulate the hostile round-1 environment: platform env pointing at a
     # non-CPU backend; dryrun_multichip must force CPU itself.
@@ -37,12 +38,15 @@ def test_dryrun_multichip_subprocess_under_timeout():
         env=env,
         capture_output=True,
         text=True,
-        timeout=150,
+        timeout=240,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip OK [tp/sp/ep/dp]" in proc.stdout
     assert "dryrun_multichip OK [fsdp/tp/dp]" in proc.stdout
-    assert "dryrun_multichip OK [pp/tp/dp]" in proc.stdout
+    assert "dryrun_multichip OK [pp/tp/fsdp/dp]" in proc.stdout
+    assert "dryrun_multichip OK [pp/sp/dp]" in proc.stdout
+    assert "dryrun_multichip OK [pp/ep/dp]" in proc.stdout
+    assert "dryrun_multichip OK [darts dp=8]" in proc.stdout
 
 
 def test_entry_compiles_single_device():
