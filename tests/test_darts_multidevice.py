@@ -11,6 +11,8 @@ import pytest
 from katib_tpu.models.darts_trainer import DartsSearch
 from katib_tpu.parallel.mesh import make_mesh
 
+pytestmark = pytest.mark.heavy  # multi-minute bilevel compiles
+
 PRIMS = ["max_pooling_3x3", "skip_connection", "separable_convolution_3x3"]
 SETTINGS = dict(
     num_epochs=1, batch_size=8, init_channels=4, num_nodes=2, stem_multiplier=1

@@ -13,6 +13,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.heavy  # same six compile legs as the subprocess variant
 def test_dryrun_multichip_inprocess():
     sys.path.insert(0, REPO)
     try:
@@ -23,6 +24,7 @@ def test_dryrun_multichip_inprocess():
         sys.path.remove(REPO)
 
 
+@pytest.mark.heavy  # fresh-interpreter six-leg dryrun, ~2-4 min
 def test_dryrun_multichip_subprocess_under_timeout():
     """The driver invocation shape: fresh interpreter, hard timeout well under
     the driver's budget. Must finish in <240s on 8 virtual CPU devices

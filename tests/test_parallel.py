@@ -201,6 +201,7 @@ class TestMoEExpertParallel:
         assert param_sharding_rules(("block0", "moe", "w_out")) == P("expert", "model", "fsdp")
 
 
+@pytest.mark.heavy  # one pipeline compile per composition (~4 min total)
 class TestPipelineParallel:
     """GPipe microbatch pipeline over 'pipe' (ppermute rotation, backward
     schedule via autodiff of the scanned forward)."""
