@@ -240,9 +240,9 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
     and the per-trial accuracy distribution recorded. Because DartsSearch
     traces its hyperparameters, all trials share ONE compiled search step
     (first trial compiles; the rest are persistent-cache hits). Bounded by
-    the parent's child deadline (BENCH_CHILD_DEADLINE) so an overrun degrades
-    to an error entry instead of killing the whole child and its primary
-    metrics."""
+    the parent's child deadline (BENCH_CHILD_DEADLINE): the trial count is
+    trimmed to fit, and a run that still overruns degrades to a 'partial'
+    entry carrying the completed trials' accuracies."""
     import shutil
     import tempfile
 
@@ -263,11 +263,12 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
 
     n_trials = int(os.environ.get("BENCH_E2E_TRIALS", "10" if on_tpu else "3"))
     # trim the trial count to what the envelope can fit rather than letting
-    # ctrl.run raise TimeoutError and lose the whole stage (measured: first
-    # trial ~120s TPU / ~150s CPU including the shared-step compile;
-    # cache-hit trials ~10s TPU / ~280s CPU at the scales below)
-    est_first = 120.0 if on_tpu else 150.0
-    est_trial = 10.0 if on_tpu else 280.0
+    # ctrl.run raise TimeoutError and lose the whole stage. Estimates are
+    # deliberately pessimistic: contention on the shared box varies step
+    # time ~2x run-to-run (a measured 793s budget fit only 2 of the 3
+    # trials the old optimistic estimates picked)
+    est_first = 120.0 if on_tpu else 300.0
+    est_trial = 10.0 if on_tpu else 350.0
     if run_timeout < est_first:
         return {"skipped": f"{run_timeout:.0f}s left cannot fit the first trial"}
     n_requested = n_trials
@@ -281,8 +282,9 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
     else:
         # the CPU fallback must ALSO demonstrate learning (the north-star
         # claim can't rest on a scale that scores chance): ic=4/nodes=2
-        # reaches ~0.65+ val-acc in 3 epochs on this box (~90s compile via
-        # the shared step cache + ~45s/trial)
+        # reaches ~0.65+ val-acc in 3 epochs on this box. Cost varies ~2x
+        # with contention — budget per the est_first/est_trial figures
+        # above, not best-case timings.
         scale = dict(num_epochs=3, num_train_examples=2048, batch_size=64,
                      init_channels=4, num_nodes=2, stem_multiplier=1,
                      num_layers=3)
@@ -325,12 +327,14 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
         )
         ctrl.create_experiment(spec)
         t0 = time.time()
-        exp = ctrl.run("bench-darts-hpo-e2e", timeout=run_timeout)
+        exp = timed_out = None
+        try:
+            exp = ctrl.run("bench-darts-hpo-e2e", timeout=run_timeout)
+        except TimeoutError as e:
+            # keep the distribution of the trials that DID finish — the
+            # evidence must degrade to partial, never to an error string
+            timed_out = str(e)
         wallclock = time.time() - t0
-        verify_experiment_results(ctrl, exp)
-        acc = exp.status.current_optimal_trial.observation.metric(
-            "Validation-accuracy"
-        )
         trial_accs = []
         for t in ctrl.state.list_trials("bench-darts-hpo-e2e"):
             m = t.observation.metric("Validation-accuracy") if t.observation else None
@@ -338,13 +342,17 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
                 trial_accs.append(round(float(m.max), 4))
         out = {
             "wallclock_s": round(wallclock, 2),
-            "verified": True,
             "algorithm": "tpe",
             "n_trials": n_trials,
-            "best_val_acc": float(acc.max),
             "trial_accs": trial_accs,
+            "best_val_acc": max(trial_accs) if trial_accs else None,
             "scale": scale,
         }
+        if timed_out is None:
+            verify_experiment_results(ctrl, exp)
+            out["verified"] = True
+        else:
+            out["partial"] = f"run timeout after {len(trial_accs)} trials: {timed_out}"
         if n_trials < n_requested:
             out["trimmed_from"] = n_requested  # budget, not capability
         return out
@@ -542,16 +550,30 @@ def _run_child(platform: str, timeout_s: float):
     except subprocess.TimeoutExpired:
         diag = f"{platform} child timed out after {timeout_s:.0f}s"
         return _salvage(result_file, diag), diag
+    def _stdout_json():
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(payload, dict) and payload.get("metric"):
+                    return payload  # the bench line, not a stray JSON log
+        return None
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
         diag = f"{platform} child rc={proc.returncode}: {' | '.join(tail)[-400:]}"
+        # a child may die in interpreter teardown (e.g. SIGSEGV unwinding
+        # abandoned JAX threads) AFTER printing its complete result — prefer
+        # that over the per-stage salvage file
+        full = _stdout_json()
+        if full is not None:
+            full.setdefault("extras", {})["partial"] = diag
+            return full, diag
         return _salvage(result_file, diag), diag
-    for line in reversed(proc.stdout.strip().splitlines()):
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                break
+    result = _stdout_json()
+    if result is not None:
+        return result, None
     return None, f"{platform} child produced no JSON line"
 
 
