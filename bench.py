@@ -510,6 +510,40 @@ def child_main(platform: str) -> None:
 # Parent: bounded orchestration, never initializes JAX itself
 # ---------------------------------------------------------------------------
 
+def _attach_north_star(result: dict) -> None:
+    """Surface the checked-in 50-trial north-star record (scripts/
+    run_north_star.py) in the bench artifact, so the driver-captured JSON
+    carries the experiment-protocol evidence even when the TPU phase is
+    skipped."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "records", "darts_hpo_50trials_cpu.json",
+    )
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as e:
+        # an absent/corrupt record is itself worth surfacing — same
+        # degrade-never-zero pattern as the rest of the file
+        result.setdefault("extras", {})["north_star_record"] = {
+            "error": f"{type(e).__name__}: {e}"[:200]
+        }
+        return
+    result.setdefault("extras", {})["north_star_record"] = {
+        "file": "examples/records/darts_hpo_50trials_cpu.json",
+        "n_trials": rec.get("n_trials"),
+        "n_succeeded": rec.get("n_succeeded"),
+        "wallclock_s": rec.get("wallclock_s"),
+        "platform": rec.get("platform"),
+        "best_val_acc": rec.get("best_val_acc"),
+        "median_val_acc": rec.get("median_val_acc"),
+        "derived_retrain_val_acc": (rec.get("derived_retrain") or {}).get(
+            "retrain_val_acc"
+        ),
+        "verification": rec.get("verification"),
+    }
+
+
 def _salvage(result_file: str, diag: str):
     """Recover the stages a killed child had already checkpointed — a
     deadline mid-run degrades the report to 'partial', never to nothing."""
@@ -669,6 +703,7 @@ def main() -> None:
                     extras["probe"] = probe_note
                 if errors:
                     extras["tpu_retry_errors"] = errors
+                _attach_north_star(result)
                 print(json.dumps(result))
                 return
             errors.append(err)
@@ -683,19 +718,22 @@ def main() -> None:
         result, err = _run_child("cpu", cpu_budget)
         if result is not None:
             result.setdefault("extras", {})["tpu_init_errors"] = errors
+            _attach_north_star(result)
             print(json.dumps(result))
             return
         errors.append(err)
     else:
         errors.append(f"cpu child skipped: only {cpu_budget:.0f}s left")
     # final fallback: still one parseable JSON line, value = sentinel
-    print(json.dumps({
+    sentinel = {
         "metric": "darts_cifar10_e2e_projected_wallclock",
         "value": -1.0,
         "unit": "seconds (BENCH FAILED — see extras.errors)",
         "vs_baseline": 0.0,
         "extras": {"errors": errors},
-    }))
+    }
+    _attach_north_star(sentinel)
+    print(json.dumps(sentinel))
 
 
 if __name__ == "__main__":
