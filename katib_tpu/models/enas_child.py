@@ -151,6 +151,30 @@ def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
     tx = optax.adam(lr)
     opt_state = tx.init(params)
 
+    # Data-parallel child training over the trial's gang-allocated devices
+    # (same contract as run_darts_hpo_trial): params/optimizer replicate,
+    # batches shard over 'data', GSPMD all-reduces the grads. Only engaged
+    # when the fixed batch size divides the device count so every jitted
+    # shape stays static.
+    batch_sharding = replicated = None
+    devices = ctx.jax_devices() if ctx is not None else []
+    if len(devices) > 1:
+        if batch_size % len(devices) == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = ctx.mesh(axis_names=("data",))
+            replicated = NamedSharding(mesh, P())
+            batch_sharding = NamedSharding(mesh, P("data"))
+            params, opt_state = jax.device_put((params, opt_state), replicated)
+        else:
+            # visible, not silent: the gang allocated chips this trial
+            # can't use at this batch size
+            print(
+                f"enas-child: batch_size {batch_size} not divisible by "
+                f"{len(devices)} gang devices; training single-device",
+                flush=True,
+            )
+
     @jax.jit
     def train_step(params, opt_state, key, bx, by):
         def loss_fn(p):
@@ -171,18 +195,30 @@ def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
     rng = np.random.default_rng(0)
     loss = jnp.array(float("nan"))
     for epoch in range(num_epochs):
+        whole_set = len(x_t) < batch_size
         train_iter = prefetch_to_device(
-            [(x_t, y_t)] if len(x_t) < batch_size else batches(x_t, y_t, batch_size, rng)
+            [(x_t, y_t)] if whole_set else batches(x_t, y_t, batch_size, rng),
+            # the whole-set fallback has an arbitrary length: keep it
+            # replicated (params already are) instead of risking a ragged
+            # 'data' split
+            sharding=replicated if whole_set else batch_sharding,
         )
         for bx, by in train_iter:
             key, sub = jax.random.split(key)
             params, opt_state, loss = train_step(params, opt_state, sub, bx, by)
         accs = [
             eval_step(params, bx, by)
-            for bx, by in prefetch_to_device(batches(x_v, y_v, batch_size, rng))
+            for bx, by in prefetch_to_device(
+                batches(x_v, y_v, batch_size, rng), sharding=batch_sharding
+            )
         ]
         if not accs and len(x_v):  # val split smaller than one batch
-            accs = [eval_step(params, x_v, y_v)]
+            x_vd, y_vd = (
+                jax.device_put((x_v, y_v), replicated)
+                if replicated is not None
+                else (x_v, y_v)
+            )
+            accs = [eval_step(params, x_vd, y_vd)]
         acc = float(jnp.stack(accs).mean()) if accs else 0.0
         if ctx is not None:
             ctx.report(**{"Validation-accuracy": acc, "Train-loss": float(loss)})

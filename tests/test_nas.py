@@ -258,6 +258,49 @@ class TestEnasChildNet:
         assert bool(jnp.isfinite(logits).all())
 
 
+class TestEnasChildDataParallel:
+    @pytest.mark.heavy
+    def test_child_training_parity_across_devices(self):
+        """run_enas_trial over a 2-device 'data' mesh (the gang-allocated
+        trial contract, like run_darts_hpo_trial) must reproduce the
+        single-device per-epoch accuracies exactly."""
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >=2 devices")
+        spec = nas_experiment("enas", enas_nas_config(),
+                              settings={"controller_train_steps": 1})
+        s = create("enas")
+        reply = s.get_suggestions(SuggestionRequest(spec, [], 1))
+        d = dict(reply.assignments[0].assignments_dict())
+        d.update({"num_epochs": "2", "batch_size": "16",
+                  "num_train_examples": "160"})
+
+        from katib_tpu.models.enas_child import run_enas_trial
+
+        class Ctx:
+            def __init__(self, devs):
+                self.devs = list(devs)
+                self.accs = []
+
+            def jax_devices(self):
+                return self.devs
+
+            def mesh(self, axis_names=("data",), shape=None):
+                import numpy as np
+                from jax.sharding import Mesh
+
+                return Mesh(np.array(self.devs), axis_names)
+
+            def report(self, **m):
+                self.accs.append(round(m["Validation-accuracy"], 6))
+
+        c1 = Ctx(jax.devices()[:1])
+        run_enas_trial(d, c1)
+        c2 = Ctx(jax.devices()[:2])
+        run_enas_trial(d, c2)
+        assert len(c1.accs) == 2
+        assert c1.accs == pytest.approx(c2.accs, abs=1e-5)
+
+
 class TestMatmulConv:
     """MatmulConv must match nn.Conv exactly (same param shape/layout) —
     it exists purely as a compile-time optimization on TPU."""
