@@ -232,7 +232,74 @@ def _bench_lm(jax, np, on_tpu: bool, size: str = "small"):
     }
 
 
-def _bench_e2e_experiment(jax, np, on_tpu: bool):
+# Uncontended darts-stage step latency on the two backends this box runs
+# (calibrated in-repo; env-overridable). The e2e stage divides the measured
+# step time by this pin to estimate how contended the box is RIGHT NOW and
+# inflates its trial-cost estimates accordingly — round-4 lesson: a fixed
+# estimate calibrated on a quiet box fit 0 trials when three suites shared
+# the machine and every step ran ~2.5x slower.
+NOMINAL_DARTS_STEP_MS = {"cpu": 1700.0, "tpu": 25.0}
+
+
+def _e2e_plan(on_tpu: bool, run_timeout: float, darts, n_trials: int):
+    """Pick (scale, n_trials, contention) for the e2e stage, or None if even
+    the cheapest rung cannot fit one trial. Pure so the budget tests can pin
+    the ladder/contention arithmetic without running trials."""
+    backend = "tpu" if on_tpu else "cpu"
+    # per-backend override first: one bench run can execute BOTH children
+    # (TPU then CPU fallback) under the same environment, so a shared pin
+    # calibrated for one backend would corrupt the other's estimate
+    nominal = float(
+        os.environ.get(f"BENCH_NOMINAL_DARTS_STEP_MS_{backend.upper()}")
+        or os.environ.get("BENCH_NOMINAL_DARTS_STEP_MS")
+        or NOMINAL_DARTS_STEP_MS[backend]
+    )
+    contention = 1.0
+    if darts and darts.get("step_ms"):
+        contention = max(1.0, float(darts["step_ms"]) / nominal)
+    if on_tpu:
+        # model scale at which the synthetic CIFAR stand-in is demonstrably
+        # learnable (>=0.9 val-acc in 3 epochs at good hyperparameters)
+        ladder = [(
+            dict(num_epochs=3, num_train_examples=2048, batch_size=64,
+                 init_channels=8, num_nodes=2, stem_multiplier=3,
+                 num_layers=3),
+            120.0, 10.0,
+        )]
+    else:
+        # Rung 1 demonstrates learning (ic=4/nodes=2 reaches ~0.65+ val-acc
+        # in 3 epochs uncontended on this box) but pays a fresh multi-minute
+        # cold bilevel compile — XLA:CPU gets no persistent cache
+        # (utils/compilation.py SIGILL note), so its first trial is honest
+        # at ~650s uncontended. Rung 2 is the WARM-CACHE rung: the exact
+        # darts-cpu headline config _bench_darts already compiled in this
+        # process (same primitives order, shapes, and schedule_horizon=390
+        # → _compiled_search_step lru hit), so its first trial pays only
+        # the forward-only eval compile plus a handful of steps. It also
+        # matches the reference CI's own e2e scale (darts-cpu.yaml:
+        # 1 epoch, 1 node, 1 channel, batch 128).
+        ladder = [
+            (dict(num_epochs=3, num_train_examples=2048, batch_size=64,
+                  init_channels=4, num_nodes=2, stem_multiplier=1,
+                  num_layers=3),
+             650.0, 350.0),
+            (dict(num_epochs=2, num_train_examples=1024, batch_size=128,
+                  init_channels=1, num_nodes=1, stem_multiplier=3,
+                  num_layers=3,
+                  primitives=["max_pooling_3x3", "skip_connection",
+                              "separable_convolution_3x3"],
+                  schedule_horizon=STEPS_PER_EPOCH),
+             150.0, 40.0),
+        ]
+    for cand_scale, base_first, base_trial in ladder:
+        est_first = base_first * contention
+        if run_timeout >= est_first:
+            fit = 1 + int((run_timeout - est_first) / (base_trial * contention))
+            return cand_scale, max(1, min(n_trials, fit)), contention
+    return None
+
+
+def _bench_e2e_experiment(jax, np, on_tpu: bool, darts=None):
     """The north-star experiment THROUGH the framework: a multi-trial DARTS
     HPO experiment (TPE over the bilevel search's optimizer hyperparameters)
     driven by ExperimentController.run() — suggestion protocol, collectors,
@@ -261,33 +328,17 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
         if run_timeout < 60.0:
             return {"skipped": f"only {run_timeout:.0f}s left in child budget"}
 
-    n_trials = int(os.environ.get("BENCH_E2E_TRIALS", "10" if on_tpu else "3"))
-    # trim the trial count to what the envelope can fit rather than letting
-    # ctrl.run raise TimeoutError and lose the whole stage. Estimates are
-    # deliberately pessimistic: contention on the shared box varies step
-    # time ~2x run-to-run (a measured 793s budget fit only 2 of the 3
-    # trials the old optimistic estimates picked)
-    est_first = 120.0 if on_tpu else 300.0
-    est_trial = 10.0 if on_tpu else 350.0
-    if run_timeout < est_first:
-        return {"skipped": f"{run_timeout:.0f}s left cannot fit the first trial"}
-    n_requested = n_trials
-    n_trials = max(1, min(n_trials, 1 + int((run_timeout - est_first) / est_trial)))
-    if on_tpu:
-        # model scale at which the synthetic CIFAR stand-in is demonstrably
-        # learnable (>=0.9 val-acc in 3 epochs at good hyperparameters)
-        scale = dict(num_epochs=3, num_train_examples=2048, batch_size=64,
-                     init_channels=8, num_nodes=2, stem_multiplier=3,
-                     num_layers=3)
-    else:
-        # the CPU fallback must ALSO demonstrate learning (the north-star
-        # claim can't rest on a scale that scores chance): ic=4/nodes=2
-        # reaches ~0.65+ val-acc in 3 epochs on this box. Cost varies ~2x
-        # with contention — budget per the est_first/est_trial figures
-        # above, not best-case timings.
-        scale = dict(num_epochs=3, num_train_examples=2048, batch_size=64,
-                     init_channels=4, num_nodes=2, stem_multiplier=1,
-                     num_layers=3)
+    n_requested = int(os.environ.get("BENCH_E2E_TRIALS", "10" if on_tpu else "3"))
+    # Trial-cost estimates are scaled by the contention the darts stage just
+    # measured in THIS child (measured step ms / uncontended pin) — a fixed
+    # estimate fit 0 trials when the box ran ~2.5x slow under three
+    # concurrent suites. The ladder degrades to the north-star scale (~3x
+    # chance val-acc, warm-cache trials) before giving up entirely.
+    plan = _e2e_plan(on_tpu, run_timeout, darts, n_requested)
+    if plan is None:
+        return {"skipped": (
+            f"{run_timeout:.0f}s left cannot fit a first trial at any scale")}
+    scale, n_trials, contention = plan
 
     def darts_hpo_trial(assignments, ctx):
         from katib_tpu.models.darts_trainer import run_darts_hpo_trial
@@ -347,6 +398,7 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool):
             "trial_accs": trial_accs,
             "best_val_acc": max(trial_accs) if trial_accs else None,
             "scale": scale,
+            "contention_factor": round(contention, 2),
         }
         if timed_out is None:
             verify_experiment_results(ctrl, exp)
@@ -498,12 +550,18 @@ def child_main(platform: str) -> None:
 
     if os.environ.get("BENCH_SKIP_E2E") != "1":
         try:
-            extras["e2e_experiment"] = _bench_e2e_experiment(jax, np, on_tpu)
+            extras["e2e_experiment"] = _bench_e2e_experiment(jax, np, on_tpu, darts)
         except Exception as e:  # keep the primary metric even if e2e breaks
             extras["e2e_experiment"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
     print(json.dumps(payload))
+    sys.stdout.flush()
+    # Skip interpreter teardown: an e2e run timeout can leave executor
+    # threads mid-XLA-call, and finalizing the runtime under them has
+    # segfaulted (rc=-11) AFTER every result was already written — exit
+    # hard with the success code the parent expects.
+    os._exit(0)
 
 
 # ---------------------------------------------------------------------------

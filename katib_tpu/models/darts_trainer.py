@@ -213,6 +213,13 @@ class DartsSearch:
         self.num_nodes = int(s.get("num_nodes", 4))
         self.stem_multiplier = int(s.get("stem_multiplier", 3))
         self.print_step = int(s.get("print_step", 50))
+        # Cosine-schedule horizon override: decouples the lr schedule (and
+        # with it the _compiled_search_step cache key, which is static in
+        # total_steps) from the actual demo length — a short evidence run
+        # pinned to a reference horizon reuses the exact compiled program of
+        # a full-length run instead of paying a fresh multi-minute XLA
+        # compile for a different schedule constant.
+        self.schedule_horizon = int(s.get("schedule_horizon", 0) or 0)
         # settings arrive as strings from HPO assignments: explicit opt-in
         remat = str(s.get("remat_cells", "")).strip().lower() in ("1", "true", "yes", "on")
 
@@ -243,7 +250,7 @@ class DartsSearch:
         params = jitted_init(self.model, key, jnp.zeros((2,) + tuple(sample_shape)))
         self.weights, self.alphas = split_params(params)
 
-        self.total_steps = max(total_steps, 1)
+        self.total_steps = max(self.schedule_horizon or total_steps, 1)
         self.w_opt_state = _make_w_tx(
             self.w_weight_decay, self.w_momentum, self.w_lr, self.w_grad_clip
         ).init(self.weights)

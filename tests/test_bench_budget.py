@@ -31,6 +31,8 @@ def bench(monkeypatch):
         "BENCH_TOTAL_BUDGET", "BENCH_TPU_TIMEOUT", "BENCH_CPU_TIMEOUT",
         "BENCH_FORCE_CPU", "BENCH_TPU_ATTEMPTS", "BENCH_PROBE_TIMEOUT",
         "BENCH_CPU_RESERVE", "BENCH_RESULT_FILE", "BENCH_CHILD_DEADLINE",
+        "BENCH_NOMINAL_DARTS_STEP_MS", "BENCH_NOMINAL_DARTS_STEP_MS_CPU",
+        "BENCH_NOMINAL_DARTS_STEP_MS_TPU",
     ):
         monkeypatch.delenv(var, raising=False)
     return mod
@@ -175,3 +177,82 @@ def test_sentinel_via_real_subprocess():
     assert len(lines) == 1
     parsed = json.loads(lines[0])
     assert parsed["metric"] == "darts_cifar10_e2e_projected_wallclock"
+
+
+def test_e2e_plan_contention_inflates_estimates(bench, monkeypatch):
+    """Round-4 regression: fixed estimates calibrated on a quiet box fit 0
+    trials when the box ran ~2.6x slow under three concurrent suites. The
+    plan must divide the darts stage's measured step time by the nominal pin
+    and inflate per-trial estimates by that factor."""
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
+    # uncontended: 900s fits the learnable scale's cold compile (650s)
+    scale, n, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1700.0}, 3)
+    assert contention == 1.0
+    assert scale["init_channels"] == 4 and n == 1
+    # 2.6x contention: learnable first trial alone would cost 1690s of 620
+    # — must degrade to the warm-cache headline rung, not time out at the
+    # learnable scale
+    scale, n, contention = bench._e2e_plan(False, 620.0, {"step_ms": 4420.0}, 3)
+    assert contention == pytest.approx(2.6)
+    assert scale["init_channels"] == 1 and scale["num_nodes"] == 1
+    assert scale["schedule_horizon"] == bench.STEPS_PER_EPOCH
+    assert n == 3  # warm rung fits all requested trials
+
+
+def test_e2e_plan_faster_than_pin_keeps_margin(bench, monkeypatch):
+    """A box faster than the nominal pin must NOT deflate the estimates
+    (contention clamps at 1.0) — the margin absorbs run-to-run variance."""
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
+    fast, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 300.0}, 3)
+    assert contention == 1.0
+    assert fast["init_channels"] == 4  # 900 >= 650: learnable rung fits
+
+
+def test_e2e_plan_no_rung_fits(bench, monkeypatch):
+    """When even the cheapest rung cannot fit one trial, the stage is
+    skipped with a reason instead of burning the child's whole envelope."""
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
+    assert bench._e2e_plan(False, 50.0, {"step_ms": 1200.0}, 3) is None
+    # missing darts measurement degrades gracefully to contention=1; 400s
+    # cannot fit the learnable cold compile but fits the warm rung
+    scale, n, contention = bench._e2e_plan(False, 400.0, None, 3)
+    assert contention == 1.0 and scale["init_channels"] == 1 and n == 3
+
+
+def test_e2e_plan_per_backend_nominal_override(bench, monkeypatch):
+    """One run can execute BOTH children under the same env: a TPU-side
+    recalibration must not corrupt the CPU fallback's contention estimate."""
+    monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS_TPU", "25")
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
+    _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1200.0}, 3)
+    assert contention == 1.0  # CPU still uses the CPU pin, not 1200/25=48x
+    monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS", "600")
+    _, _, contention = bench._e2e_plan(False, 9000.0, {"step_ms": 1200.0}, 3)
+    assert contention == 2.0  # shared name is the fallback for CPU
+
+
+def test_warm_rung_shares_compiled_step_with_darts_stage(bench):
+    """The warm-cache rung only earns its cheap estimates if an e2e trial's
+    DartsSearch resolves to the SAME compiled search step _bench_darts
+    already built in this process: equal module config + schedule_horizon
+    pinned to the stage's total_steps must be an lru hit, and a different
+    horizon must miss."""
+    from katib_tpu.models.darts_trainer import DartsSearch
+
+    rung = bench._e2e_plan(False, 500.0, {"step_ms": 3120.0}, 3)[0]
+    prims = rung["primitives"]
+    stage = DartsSearch(
+        primitives=prims, num_layers=3,
+        settings={"num_epochs": 1, "num_nodes": 1, "init_channels": 1,
+                  "batch_size": 128, "stem_multiplier": 3},
+    )
+    stage.build((8, 8, 3), bench.STEPS_PER_EPOCH)
+    trial_settings = {k: v for k, v in rung.items()
+                      if k not in ("primitives", "num_train_examples", "num_layers")}
+    trial = DartsSearch(primitives=prims, num_layers=3, settings=trial_settings)
+    trial.build((8, 8, 3), 8)  # data-derived steps differ; horizon pins the key
+    assert trial._search_step is stage._search_step
+    cold = DartsSearch(primitives=prims, num_layers=3,
+                       settings=dict(trial_settings, schedule_horizon=0))
+    cold.build((8, 8, 3), 8)
+    assert cold._search_step is not stage._search_step
