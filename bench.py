@@ -249,47 +249,54 @@ def _e2e_plan(on_tpu: bool, run_timeout: float, darts, n_trials: int):
     # per-backend override first: one bench run can execute BOTH children
     # (TPU then CPU fallback) under the same environment, so a shared pin
     # calibrated for one backend would corrupt the other's estimate
-    nominal = float(
-        os.environ.get(f"BENCH_NOMINAL_DARTS_STEP_MS_{backend.upper()}")
-        or os.environ.get("BENCH_NOMINAL_DARTS_STEP_MS")
-        or NOMINAL_DARTS_STEP_MS[backend]
-    )
+    try:
+        nominal = float(
+            os.environ.get(f"BENCH_NOMINAL_DARTS_STEP_MS_{backend.upper()}")
+            or os.environ.get("BENCH_NOMINAL_DARTS_STEP_MS")
+            or NOMINAL_DARTS_STEP_MS[backend]
+        )
+    except ValueError:
+        nominal = 0.0
+    if nominal <= 0:  # zero/garbage override must not kill the e2e stage
+        nominal = NOMINAL_DARTS_STEP_MS[backend]
     contention = 1.0
     if darts and darts.get("step_ms"):
         contention = max(1.0, float(darts["step_ms"]) / nominal)
+    # The warm-cache rung: the exact darts-cpu headline config _bench_darts
+    # already compiled in this process (same primitives order, shapes, and
+    # schedule_horizon=390 → _compiled_search_step lru hit), so its first
+    # trial pays only the forward-only eval compile plus a handful of
+    # steps. It also matches the reference CI's own e2e scale
+    # (darts-cpu.yaml: 1 epoch, 1 node, 1 channel, batch 128).
+    warm_rung = dict(num_epochs=2, num_train_examples=1024, batch_size=128,
+                     init_channels=1, num_nodes=1, stem_multiplier=3,
+                     num_layers=3,
+                     primitives=["max_pooling_3x3", "skip_connection",
+                                 "separable_convolution_3x3"],
+                     schedule_horizon=STEPS_PER_EPOCH)
     if on_tpu:
         # model scale at which the synthetic CIFAR stand-in is demonstrably
-        # learnable (>=0.9 val-acc in 3 epochs at good hyperparameters)
-        ladder = [(
-            dict(num_epochs=3, num_train_examples=2048, batch_size=64,
-                 init_channels=8, num_nodes=2, stem_multiplier=3,
-                 num_layers=3),
-            120.0, 10.0,
-        )]
+        # learnable (>=0.9 val-acc in 3 epochs at good hyperparameters);
+        # a squeezed budget degrades to the warm rung instead of skipping
+        ladder = [
+            (dict(num_epochs=3, num_train_examples=2048, batch_size=64,
+                  init_channels=8, num_nodes=2, stem_multiplier=3,
+                  num_layers=3),
+             120.0, 10.0),
+            (warm_rung, 45.0, 8.0),
+        ]
     else:
         # Rung 1 demonstrates learning (ic=4/nodes=2 reaches ~0.65+ val-acc
         # in 3 epochs uncontended on this box) but pays a fresh multi-minute
         # cold bilevel compile — XLA:CPU gets no persistent cache
         # (utils/compilation.py SIGILL note), so its first trial is honest
-        # at ~650s uncontended. Rung 2 is the WARM-CACHE rung: the exact
-        # darts-cpu headline config _bench_darts already compiled in this
-        # process (same primitives order, shapes, and schedule_horizon=390
-        # → _compiled_search_step lru hit), so its first trial pays only
-        # the forward-only eval compile plus a handful of steps. It also
-        # matches the reference CI's own e2e scale (darts-cpu.yaml:
-        # 1 epoch, 1 node, 1 channel, batch 128).
+        # at ~650s uncontended.
         ladder = [
             (dict(num_epochs=3, num_train_examples=2048, batch_size=64,
                   init_channels=4, num_nodes=2, stem_multiplier=1,
                   num_layers=3),
              650.0, 350.0),
-            (dict(num_epochs=2, num_train_examples=1024, batch_size=128,
-                  init_channels=1, num_nodes=1, stem_multiplier=3,
-                  num_layers=3,
-                  primitives=["max_pooling_3x3", "skip_connection",
-                              "separable_convolution_3x3"],
-                  schedule_horizon=STEPS_PER_EPOCH),
-             150.0, 40.0),
+            (warm_rung, 150.0, 40.0),
         ]
     for cand_scale, base_first, base_trial in ladder:
         est_first = base_first * contention

@@ -256,3 +256,24 @@ def test_warm_rung_shares_compiled_step_with_darts_stage(bench):
                        settings=dict(trial_settings, schedule_horizon=0))
     cold.build((8, 8, 3), 8)
     assert cold._search_step is not stage._search_step
+
+
+def test_e2e_plan_tpu_ladder_degrades_to_warm_rung(bench, monkeypatch):
+    """A squeezed TPU child budget must fall back to the warm-cache headline
+    rung rather than skip the e2e stage outright."""
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
+    monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS_TPU", raising=False)
+    scale, n, _ = bench._e2e_plan(True, 300.0, {"step_ms": 25.0}, 10)
+    assert scale["init_channels"] == 8 and n == 10  # plenty: learnable rung
+    scale, n, _ = bench._e2e_plan(True, 60.0, {"step_ms": 25.0}, 10)
+    assert scale["init_channels"] == 1 and scale["schedule_horizon"] == 390
+    assert bench._e2e_plan(True, 30.0, {"step_ms": 25.0}, 10) is None
+
+
+def test_e2e_plan_garbage_nominal_override_falls_back(bench, monkeypatch):
+    """A zero or non-numeric pin override must fall back to the built-in
+    nominal, not crash the e2e stage with ZeroDivisionError/ValueError."""
+    for bad in ("0", "banana"):
+        monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS", bad)
+        _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 3400.0}, 3)
+        assert contention == pytest.approx(2.0)  # 3400 / builtin 1700
