@@ -36,7 +36,7 @@ from ..api.spec import (
     TrialResources,
     TrialTemplate,
 )
-from ..api.status import Experiment, Trial
+from ..api.status import Condition, Experiment, SuggestionState, Trial
 from ..controller.experiment import ExperimentController
 from ..db.store import MetricLog
 
@@ -93,11 +93,60 @@ class KatibClient:
             time.sleep(polling_interval)
         raise TimeoutError(f"experiment {name!r} not {expected_condition} within {timeout}s")
 
+    def get_experiment_conditions(self, name: str) -> List[Condition]:
+        """katib_client.py get_experiment_conditions: a snapshot of the
+        condition history (type/status/reason/message/lastTransitionTime);
+        copied so later controller transitions don't mutate it under the
+        caller."""
+        exp = self.get_experiment(name)
+        if exp is None:
+            return []
+        return [Condition.from_dict(c.to_dict()) for c in exp.status.conditions]
+
+    def is_experiment_created(self, name: str) -> bool:
+        """True once the experiment exists in the state store. The reference
+        checks for a Created condition with status True
+        (katib_client.py:568-597); here creation is synchronous, so existence
+        is the same signal."""
+        return self.get_experiment(name) is not None
+
+    def is_experiment_running(self, name: str) -> bool:
+        exp = self.get_experiment(name)
+        return bool(exp and exp.status.condition.value == "Running")
+
+    def is_experiment_restarting(self, name: str) -> bool:
+        exp = self.get_experiment(name)
+        return bool(exp and exp.status.condition.value == "Restarting")
+
     def is_experiment_succeeded(self, name: str) -> bool:
         exp = self.get_experiment(name)
         return bool(exp and exp.status.is_succeeded)
 
+    def is_experiment_failed(self, name: str) -> bool:
+        exp = self.get_experiment(name)
+        return bool(exp and exp.status.condition.value == "Failed")
+
+    # -- suggestions (katib_client.py get_suggestion/list_suggestions) -------
+
+    def get_suggestion(self, name: str) -> Optional[SuggestionState]:
+        """The per-experiment suggestion state: demand counter, produced
+        assignments, algorithm-settings feedback (suggestion_types.go:29-150)."""
+        return self.controller.state.get_suggestion(name)
+
+    def list_suggestions(self) -> List[SuggestionState]:
+        """One SuggestionState per experiment that has requested assignments."""
+        out = []
+        for exp in self.list_experiments():
+            s = self.controller.state.get_suggestion(exp.name)
+            if s is not None:
+                out.append(s)
+        return out
+
     # -- results -------------------------------------------------------------
+
+    def get_trial(self, experiment_name: str, trial_name: str) -> Optional[Trial]:
+        """katib_client.py get_trial."""
+        return self.controller.state.get_trial(experiment_name, trial_name)
 
     def list_trials(self, name: str) -> List[Trial]:
         return self.controller.state.list_trials(name)

@@ -144,6 +144,39 @@ class TestTune:
         assert exp.status.is_succeeded
         assert client.is_experiment_succeeded("tune-wait")
 
+    def test_condition_and_state_getters(self, client):
+        """The reference SDK's condition/suggestion/trial getter family
+        (katib_client.py:526-1075)."""
+        assert not client.is_experiment_created("tune-getters")
+        client.tune(
+            name="tune-getters",
+            objective=objective_inprocess,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=2,
+            parallel_trial_count=1,
+        )
+        assert client.is_experiment_created("tune-getters")
+        assert not client.is_experiment_running("tune-getters")
+        assert not client.is_experiment_failed("tune-getters")
+        client.run("tune-getters", timeout=60)
+
+        conds = client.get_experiment_conditions("tune-getters")
+        assert [c.type for c in conds if c.status] == ["Succeeded"]
+        assert {c.type for c in conds} >= {"Created", "Running", "Succeeded"}
+        assert not client.is_experiment_running("tune-getters")
+        assert not client.is_experiment_restarting("tune-getters")
+        assert not client.is_experiment_failed("tune-getters")
+
+        sugg = client.get_suggestion("tune-getters")
+        assert sugg is not None and sugg.suggestion_count == 2
+        assert any(s.experiment_name == "tune-getters" for s in client.list_suggestions())
+
+        trials = client.list_trials("tune-getters")
+        t = client.get_trial("tune-getters", trials[0].name)
+        assert t is not None and t.name == trials[0].name
+        assert client.get_trial("tune-getters", "no-such-trial") is None
+
 
 class TestSearchBuilders:
     def test_builders(self):
