@@ -84,18 +84,30 @@ def main() -> int:
                     help="seconds between probes")
     ap.add_argument("--budget", type=float, default=1140.0)
     ap.add_argument("--max-rt-ms", type=float, default=40.0)
+    ap.add_argument("--degraded-after", type=float, default=3600.0,
+                    help="after this many seconds without a healthy window, "
+                    "accept a degraded tunnel (rt up to 250ms) — bench.py "
+                    "lengthens its timed loops to keep the numbers honest")
     args = ap.parse_args()
 
-    deadline = time.time() + args.max_hours * 3600
+    start = time.time()
+    deadline = start + args.max_hours * 3600
     attempt = 0
     while time.time() < deadline:
         attempt += 1
         rt, diag = probe()
         stamp = datetime.datetime.now().strftime("%H:%M:%S")
+        settle_for_degraded = time.time() - start > args.degraded_after
+        degraded_ceiling = max(
+            args.max_rt_ms,
+            float(os.environ.get("BENCH_PROBE_DEGRADED_RT_MS", "250")),
+        )
         if rt is None:
             print(f"[{stamp}] tunnel wedged: {diag}", flush=True)
-        elif rt > args.max_rt_ms:
-            print(f"[{stamp}] tunnel degraded: rt {rt}ms on {diag}", flush=True)
+        elif rt > (degraded_ceiling if settle_for_degraded else args.max_rt_ms):
+            print(f"[{stamp}] tunnel degraded: rt {rt}ms on {diag}"
+                  + (" (past even the degraded ceiling)" if settle_for_degraded
+                     else ""), flush=True)
         elif not box_quiet():
             print(f"[{stamp}] tunnel healthy (rt {rt}ms) but box busy "
                   f"(load {os.getloadavg()[0]:.2f}); waiting", flush=True)

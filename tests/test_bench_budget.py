@@ -32,7 +32,8 @@ def bench(monkeypatch):
         "BENCH_FORCE_CPU", "BENCH_TPU_ATTEMPTS", "BENCH_PROBE_TIMEOUT",
         "BENCH_CPU_RESERVE", "BENCH_RESULT_FILE", "BENCH_CHILD_DEADLINE",
         "BENCH_NOMINAL_DARTS_STEP_MS", "BENCH_NOMINAL_DARTS_STEP_MS_CPU",
-        "BENCH_NOMINAL_DARTS_STEP_MS_TPU",
+        "BENCH_NOMINAL_DARTS_STEP_MS_TPU", "BENCH_STEPS",
+        "BENCH_PROBE_MAX_RT_MS", "BENCH_PROBE_DEGRADED_RT_MS",
     ):
         monkeypatch.delenv(var, raising=False)
     return mod
@@ -49,9 +50,9 @@ def test_wedged_probe_skips_to_cpu(bench, monkeypatch, capsys):
     """A wedged tunnel (probe failure) must hand the CPU child the whole
     remaining envelope and attach the probe diagnostic to the result."""
     calls = []
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (False, "probe timed out after 42s"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("dead", "probe timed out after 42s", None))
 
-    def fake_child(platform, timeout_s):
+    def fake_child(platform, timeout_s, extra_env=None):
         calls.append((platform, timeout_s))
         assert platform == "cpu"
         return {"metric": "m", "value": 1.0, "extras": {}}, None
@@ -65,9 +66,9 @@ def test_wedged_probe_skips_to_cpu(bench, monkeypatch, capsys):
 
 
 def test_healthy_probe_runs_tpu_child(bench, monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, "rt 2.1ms on TPU v5 lite"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2.1ms on TPU v5 lite", 2.1))
 
-    def fake_child(platform, timeout_s):
+    def fake_child(platform, timeout_s, extra_env=None):
         assert platform == "tpu"
         # TPU child budget = total - probe - cpu_reserve - margin
         assert 500 < timeout_s < 1140
@@ -76,13 +77,85 @@ def test_healthy_probe_runs_tpu_child(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     result = _run_main(bench, capsys)
     assert result["extras"]["probe"].startswith("rt 2.1ms")
+    # healthy tunnel: the timed-loop length is left alone
+    import os
+
+    assert "BENCH_STEPS" not in os.environ
+
+
+def test_degraded_probe_still_benches_tpu_with_longer_loops(
+    bench, monkeypatch, capsys
+):
+    """rt between the healthy threshold and the ceiling: run the TPU child
+    anyway (the chained loops subtract the round-trip, so a slow tunnel adds
+    noise, not bias) but lengthen ITS timed loops to amortize it — the CPU
+    fallback child must not inherit the override (no tunnel there)."""
+    monkeypatch.setattr(
+        bench,
+        "_probe_tpu",
+        lambda t: ("degraded", "rt 98.1ms on TPU v5 lite (> 40ms ...)", 98.1),
+    )
+    seen = []
+
+    def fake_child(platform, timeout_s, extra_env=None):
+        seen.append((platform, (extra_env or {}).get("BENCH_STEPS")))
+        if platform == "tpu":
+            return None, "tpu child rc=1: boom"
+        return {"metric": "m", "value": 1.0, "extras": {}}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    monkeypatch.setenv("BENCH_TPU_ATTEMPTS", "1")
+    result = _run_main(bench, capsys)
+    assert seen[0] == ("tpu", str(int(98.1 * 0.9)))
+    assert seen[-1] == ("cpu", None)
+    assert result["extras"]["tpu_init_errors"] == ["tpu child rc=1: boom"]
+
+
+def test_degraded_probe_respects_pinned_steps(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_STEPS", "12")
+    monkeypatch.setattr(
+        bench, "_probe_tpu", lambda t: ("degraded", "rt 120ms", 120.0)
+    )
+    seen = {}
+
+    def fake_child(platform, timeout_s, extra_env=None):
+        seen["extra"] = extra_env
+        return {"metric": "m", "value": 1.0, "extras": {}}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    _run_main(bench, capsys)
+    assert not seen["extra"]  # pinned BENCH_STEPS wins; no override injected
+
+
+def test_probe_tpu_classifies_roundtrip(bench, monkeypatch):
+    """Real _probe_tpu over a stubbed subprocess: healthy / degraded / dead
+    by round-trip alone."""
+    import json as _json
+
+    class FakeProc:
+        returncode = 0
+
+        def __init__(self, rt):
+            self.stdout = _json.dumps({"rt_ms": rt, "device_kind": "TPU v5 lite"})
+            self.stderr = ""
+
+    for rt, expected in ((5.0, "healthy"), (98.0, "degraded"), (400.0, "dead")):
+        monkeypatch.setattr(
+            bench.subprocess, "run", lambda *a, _rt=rt, **k: FakeProc(_rt)
+        )
+        verdict, diag, got_rt = bench._probe_tpu(30.0)
+        assert verdict == expected, (rt, verdict, diag)
+        if expected == "dead":
+            assert got_rt is None
+        else:
+            assert got_rt == rt
 
 
 def test_tpu_timeout_salvage_reports_partial(bench, monkeypatch, capsys):
     """A TPU child killed mid-run still reports its checkpointed stages."""
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, "rt 2ms"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2ms", 2.0))
 
-    def fake_child(platform, timeout_s):
+    def fake_child(platform, timeout_s, extra_env=None):
         if platform == "tpu":
             return (
                 {"metric": "m", "value": 9.0,
@@ -99,8 +172,8 @@ def test_tpu_timeout_salvage_reports_partial(bench, monkeypatch, capsys):
 
 
 def test_all_arms_fail_prints_sentinel(bench, monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, "rt 2ms"))
-    monkeypatch.setattr(bench, "_run_child", lambda p, t: (None, f"{p} child rc=1: boom"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2ms", 2.0))
+    monkeypatch.setattr(bench, "_run_child", lambda p, t, extra_env=None: (None, f"{p} child rc=1: boom"))
     result = _run_main(bench, capsys)
     assert result["value"] == -1.0
     assert any("boom" in e for e in result["extras"]["errors"])
@@ -118,10 +191,10 @@ def test_tiny_budget_prints_sentinel_fast(bench, monkeypatch, capsys):
 
 
 def test_tpu_fast_failure_retries_then_cpu(bench, monkeypatch, capsys):
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, "rt 2ms"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2ms", 2.0))
     calls = []
 
-    def fake_child(platform, timeout_s):
+    def fake_child(platform, timeout_s, extra_env=None):
         calls.append(platform)
         if platform == "tpu":
             return None, "tpu child rc=1: init error"
@@ -136,10 +209,10 @@ def test_tpu_fast_failure_retries_then_cpu(bench, monkeypatch, capsys):
 def test_tpu_timeout_does_not_retry(bench, monkeypatch, capsys):
     """A timed-out (wedged) TPU child must not be re-queued — the CPU
     fallback gets the remaining budget instead."""
-    monkeypatch.setattr(bench, "_probe_tpu", lambda t: (True, "rt 2ms"))
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2ms", 2.0))
     calls = []
 
-    def fake_child(platform, timeout_s):
+    def fake_child(platform, timeout_s, extra_env=None):
         calls.append(platform)
         if platform == "tpu":
             return None, "tpu child timed out after 700s"
