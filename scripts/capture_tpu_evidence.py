@@ -75,6 +75,28 @@ def run_bench(budget_s: float):
     return None
 
 
+def run_north_star(budget_s: float):
+    """After a bench capture, spend the rest of the healthy window on the
+    literal 50-trial DARTS HPO (BASELINE.json configs[4]) at TPU scale.
+    run_north_star.py writes examples/records/darts_hpo_50trials_tpu.json
+    itself (including partial artifacts on its internal timeout). Its
+    --timeout clock starts at ctrl.run(), AFTER backend init — so the
+    outer kill-switch leaves generous slack (init on a flaky tunnel can
+    take minutes) to let the internal partial-artifact path win."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "run_north_star.py"),
+             "--tpu", "--timeout", str(int(budget_s))],
+            capture_output=True, text=True, timeout=budget_s + 900, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return f"north star hung past {budget_s + 900:.0f}s"
+    tail = proc.stdout.strip().splitlines()[-1:]
+    if not tail:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["(no output)"]
+    return f"north star rc={proc.returncode}: {tail[0][:200]}"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--once", action="store_true",
@@ -84,6 +106,10 @@ def main() -> int:
                     help="seconds between probes")
     ap.add_argument("--budget", type=float, default=1140.0)
     ap.add_argument("--max-rt-ms", type=float, default=40.0)
+    ap.add_argument("--north-star-budget", type=float, default=2400.0,
+                    help="after a successful bench capture, run the 50-trial "
+                    "north star on the TPU with this wall-clock budget "
+                    "(0 disables)")
     ap.add_argument("--degraded-after", type=float, default=3600.0,
                     help="after this many seconds without a healthy window, "
                     "accept a degraded tunnel (rt up to 250ms) — bench.py "
@@ -128,6 +154,19 @@ def main() -> int:
                         "result": result,
                     }, f, indent=1)
                 print(f"TPU evidence captured -> {path}", flush=True)
+                # clamp to the operator's wall-clock cap (minus the outer
+                # kill-switch slack); a sliver of window isn't worth a
+                # partial 50-trial artifact
+                ns_budget = min(
+                    args.north_star_budget, deadline - time.time() - 900
+                )
+                if ns_budget >= 300:
+                    print(run_north_star(ns_budget), flush=True)
+                elif args.north_star_budget > 0:
+                    print(
+                        f"north star skipped: {ns_budget:.0f}s left under "
+                        "--max-hours", flush=True,
+                    )
                 return 0
             print(f"[{stamp}] bench ran but no TPU numbers "
                   f"(platform={platform}); will retry", flush=True)

@@ -68,19 +68,20 @@ def test_wedged_probe_skips_to_cpu(bench, monkeypatch, capsys):
 def test_healthy_probe_runs_tpu_child(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2.1ms on TPU v5 lite", 2.1))
 
+    seen = {}
+
     def fake_child(platform, timeout_s, extra_env=None):
         assert platform == "tpu"
         # TPU child budget = total - probe - cpu_reserve - margin
         assert 500 < timeout_s < 1140
+        seen["extra"] = extra_env
         return {"metric": "m", "value": 1.0, "extras": {}}, None
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     result = _run_main(bench, capsys)
     assert result["extras"]["probe"].startswith("rt 2.1ms")
-    # healthy tunnel: the timed-loop length is left alone
-    import os
-
-    assert "BENCH_STEPS" not in os.environ
+    # healthy tunnel: no timed-loop override is injected into the child
+    assert not seen["extra"]
 
 
 def test_degraded_probe_still_benches_tpu_with_longer_loops(
