@@ -167,7 +167,7 @@ def _load_all(ctrl, root: Optional[str]) -> None:
     if not state_root or not os.path.isdir(state_root):
         return
     for name in sorted(os.listdir(state_root)):
-        if os.path.exists(os.path.join(state_root, name, "state.json")):
+        if ctrl.state.has_state(name):
             ctrl.state.load(name)
 
 

@@ -314,6 +314,13 @@ class ExperimentController:
             for t in self.state.list_trials(name):
                 if not t.is_terminal:
                     self.scheduler.kill(t.name)
+            # settle the allocator before handing control back: a trial's
+            # terminal status is persisted a beat before its worker thread
+            # releases the gang allocation, so without this a caller that
+            # immediately reuses the chips (or asserts free_count) races the
+            # last release. Bounded: a zombie trial in its kill-grace window
+            # stops the wait at the deadline rather than hanging the caller.
+            self.scheduler.quiesce(name, timeout=10.0)
         return exp
 
     def load_experiment(self, name: str) -> Experiment:

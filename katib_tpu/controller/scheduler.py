@@ -186,6 +186,28 @@ class TrialScheduler:
             remaining = None if deadline is None else max(0.0, deadline - time.time())
             t.join(timeout=remaining)
 
+    def quiesce(self, experiment_name: str, timeout: float = 10.0) -> bool:
+        """Wait until no trial of this experiment is queued or holds a worker
+        slot (and hence a gang allocation). A trial's terminal condition is
+        persisted BEFORE its worker's finally-block releases the devices, so
+        an observer that saw the experiment complete can be a few hundred
+        microseconds ahead of the allocator; callers that are about to hand
+        the chips to something else wait here instead of racing. Returns
+        False on timeout (e.g. an abandoned zombie trial being reaped)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                busy = any(
+                    self.state.get_trial(experiment_name, n) is not None
+                    for n in self._handles
+                ) or any(
+                    t.experiment_name == experiment_name for _, t in self._waiting
+                )
+            if not busy:
+                return True
+            time.sleep(0.005)
+        return False
+
     # -- dispatch loop -------------------------------------------------------
 
     def _dispatch(self) -> None:

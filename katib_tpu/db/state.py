@@ -2,9 +2,16 @@
 
 The reference persists Experiment/Suggestion/Trial objects as CRs in etcd and
 controllers watch them. Here the orchestrator is a single process, so state is
-a thread-safe registry with optional JSON persistence per experiment under
-``<root>/<experiment>/state.json`` (FromVolume resume policy restores from it —
-reference composer.go:121-133 PVC semantics).
+a thread-safe registry with optional JSON persistence per experiment
+(FromVolume resume policy restores from it — reference composer.go:121-133
+PVC semantics).
+
+Layout mirrors etcd's one-object-per-key: each record persists to its own
+file under ``<root>/<experiment>/state/`` (``experiment.json``,
+``suggestion.json``, ``trials/<trial>.json``), so a mutation rewrites only
+the changed record — a trial status flip is O(1), not O(#trials) — while
+every write stays individually atomic (tmp + rename). The pre-round-4
+single-file ``state.json`` snapshot is still readable for resume.
 """
 
 from __future__ import annotations
@@ -27,6 +34,11 @@ class ExperimentStateStore:
         self._trials: Dict[str, Dict[str, Trial]] = {}
         self._suggestions: Dict[str, SuggestionState] = {}
         self._templates: Dict[str, dict] = {}
+        # creation-order bookkeeping for the per-record layout: a monotonic
+        # per-experiment counter (never reused after deletes) and the seq
+        # assigned to each live trial
+        self._next_seq: Dict[str, int] = {}
+        self._trial_seq: Dict[str, Dict[str, int]] = {}
         if root:
             os.makedirs(root, exist_ok=True)
             self._load_templates()
@@ -56,14 +68,19 @@ class ExperimentStateStore:
             self._persist(exp.name)
 
     def delete_experiment(self, name: str) -> None:
+        import shutil
+
         with self._lock:
             self._experiments.pop(name, None)
             self._trials.pop(name, None)
             self._suggestions.pop(name, None)
+            self._trial_seq.pop(name, None)
+            self._next_seq.pop(name, None)
             if self.root:
                 p = self._path(name)
                 if os.path.exists(p):
                     os.remove(p)
+                shutil.rmtree(self._state_dir(name), ignore_errors=True)
 
     # -- trials -------------------------------------------------------------
 
@@ -73,7 +90,10 @@ class ExperimentStateStore:
             if trial.name in exp_trials:
                 raise ValueError(f"trial {trial.name!r} already exists")
             exp_trials[trial.name] = trial
-            self._persist(trial.experiment_name)
+            nxt = self._next_seq.get(trial.experiment_name, 0)
+            self._trial_seq.setdefault(trial.experiment_name, {})[trial.name] = nxt
+            self._next_seq[trial.experiment_name] = nxt + 1
+            self._persist_trial(trial)
             return trial
 
     def get_trial(self, experiment_name: str, trial_name: str) -> Optional[Trial]:
@@ -89,12 +109,13 @@ class ExperimentStateStore:
     def update_trial(self, trial: Trial) -> None:
         with self._lock:
             self._trials.setdefault(trial.experiment_name, {})[trial.name] = trial
-            self._persist(trial.experiment_name)
+            self._persist_trial(trial)
 
     def delete_trial(self, experiment_name: str, trial_name: str) -> None:
         with self._lock:
             self._trials.get(experiment_name, {}).pop(trial_name, None)
-            self._persist(experiment_name)
+            self._trial_seq.get(experiment_name, {}).pop(trial_name, None)
+            self._unlink_trial(experiment_name, trial_name)
 
     # -- suggestion state ----------------------------------------------------
 
@@ -105,12 +126,12 @@ class ExperimentStateStore:
     def put_suggestion(self, s: SuggestionState) -> None:
         with self._lock:
             self._suggestions[s.experiment_name] = s
-            self._persist(s.experiment_name)
+            self._persist_suggestion(s.experiment_name)
 
     def delete_suggestion(self, experiment_name: str) -> None:
         with self._lock:
             self._suggestions.pop(experiment_name, None)
-            self._persist(experiment_name)
+            self._persist_suggestion(experiment_name)
 
     # -- trial templates ------------------------------------------------------
     # Reference: the UI's trial-template configmap CRUD
@@ -161,32 +182,128 @@ class ExperimentStateStore:
     # -- persistence ---------------------------------------------------------
 
     def _path(self, name: str) -> str:
+        """Legacy (pre-round-4) single-file snapshot, read-only now."""
         assert self.root is not None
         return os.path.join(self.root, name, "state.json")
 
+    def _state_dir(self, name: str) -> str:
+        assert self.root is not None
+        return os.path.join(self.root, name, "state")
+
+    @staticmethod
+    def _write_record(path: str, payload: dict) -> None:
+        """One atomic record write: a single buffered write of the serialized
+        form (json.dump's many tiny stream writes dominate the profile),
+        then rename."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload))
+        os.replace(tmp, path)
+
     def _persist(self, name: str) -> None:
+        """Persist the experiment record (and the save stamp). Trial and
+        suggestion records have their own writers; this no longer rewrites
+        them."""
         if not self.root:
             return
         exp = self._experiments.get(name)
         if exp is None:
             return
-        payload = {
-            "experiment": exp.to_dict(),
-            "trials": [t.to_dict() for t in self._trials.get(name, {}).values()],
-            "suggestion": self._suggestions[name].to_dict() if name in self._suggestions else None,
-            "savedAt": time.time(),
-        }
-        p = self._path(name)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, p)
+        d = self._state_dir(name)
+        os.makedirs(d, exist_ok=True)
+        payload = exp.to_dict()
+        payload["savedAt"] = time.time()
+        self._write_record(os.path.join(d, "experiment.json"), payload)
+
+    def _persist_trial(self, trial: Trial) -> None:
+        if not self.root or trial.experiment_name not in self._experiments:
+            return
+        d = os.path.join(self._state_dir(trial.experiment_name), "trials")
+        os.makedirs(d, exist_ok=True)
+        payload = trial.to_dict()
+        # creation order matters (list_trials contract) but isn't a Trial
+        # field; stamp the store's monotonic per-experiment counter into the
+        # record for load() to sort by (filenames sort by the random name
+        # suffix, and a live dict index would be reused after deletes)
+        payload["_seq"] = self._trial_seq.get(trial.experiment_name, {}).get(
+            trial.name, len(self._trials.get(trial.experiment_name, {}))
+        )
+        self._write_record(os.path.join(d, trial.name + ".json"), payload)
+
+    def _unlink_trial(self, experiment_name: str, trial_name: str) -> None:
+        if not self.root:
+            return
+        p = os.path.join(self._state_dir(experiment_name), "trials", trial_name + ".json")
+        if os.path.exists(p):
+            os.remove(p)
+
+    def _persist_suggestion(self, experiment_name: str) -> None:
+        if not self.root or experiment_name not in self._experiments:
+            return
+        d = self._state_dir(experiment_name)
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, "suggestion.json")
+        s = self._suggestions.get(experiment_name)
+        if s is None:
+            if os.path.exists(p):
+                os.remove(p)
+            return
+        self._write_record(p, s.to_dict())
+
+    def has_state(self, name: str) -> bool:
+        """True when a persisted snapshot (either layout) exists for load()."""
+        if not self.root:
+            return False
+        return (
+            os.path.exists(os.path.join(self._state_dir(name), "experiment.json"))
+            or os.path.exists(self._path(name))
+        )
 
     def load(self, name: str) -> Optional[Experiment]:
-        """FromVolume resume: restore experiment + trials + suggestion state."""
+        """FromVolume resume: restore experiment + trials + suggestion state.
+
+        Prefers the per-record layout; falls back to the legacy single-file
+        snapshot so stores written by earlier rounds still resume.
+        """
         if not self.root:
             return None
+        d = self._state_dir(name)
+        exp_p = os.path.join(d, "experiment.json")
+        if os.path.exists(exp_p):
+            with open(exp_p) as f:
+                exp_d = json.load(f)
+            loaded = []
+            tdir = os.path.join(d, "trials")
+            if os.path.isdir(tdir):
+                for fn in os.listdir(tdir):
+                    if not fn.endswith(".json"):
+                        continue
+                    try:
+                        with open(os.path.join(tdir, fn)) as f:
+                            rec = json.load(f)
+                        loaded.append((rec.pop("_seq", 1 << 30), Trial.from_dict(rec)))
+                    except (OSError, ValueError, KeyError):
+                        continue  # a torn record loses one trial, not the run
+            loaded.sort(key=lambda st: (st[0], st[1].name))
+            trials: Dict[str, Trial] = {t.name: t for _, t in loaded}
+            seqs = {t.name: s for s, t in loaded if s < (1 << 30)}
+            suggestion = None
+            sp = os.path.join(d, "suggestion.json")
+            if os.path.exists(sp):
+                try:
+                    with open(sp) as f:
+                        suggestion = SuggestionState.from_dict(json.load(f))
+                except (OSError, ValueError, KeyError):
+                    suggestion = None
+            with self._lock:
+                exp = Experiment.from_dict(exp_d)
+                self._experiments[name] = exp
+                self._trials[name] = trials
+                self._trial_seq[name] = seqs
+                self._next_seq[name] = max(seqs.values(), default=-1) + 1
+                if suggestion is not None:
+                    self._suggestions[name] = suggestion
+                return exp
         p = self._path(name)
         if not os.path.exists(p):
             return None
@@ -196,8 +313,19 @@ class ExperimentStateStore:
             exp = Experiment.from_dict(payload["experiment"])
             self._experiments[name] = exp
             self._trials[name] = {t["name"]: Trial.from_dict(t) for t in payload.get("trials", [])}
+            self._trial_seq[name] = {
+                tn: i for i, tn in enumerate(self._trials[name])
+            }
+            self._next_seq[name] = len(self._trials[name])
             if payload.get("suggestion"):
                 self._suggestions[name] = SuggestionState.from_dict(payload["suggestion"])
+            # migrate: a legacy monolith loads once; without re-persisting,
+            # the next process would prefer the (trial-less) per-record dir
+            # the first reconcile creates and silently drop completed work
+            self._persist(name)
+            for t in self._trials[name].values():
+                self._persist_trial(t)
+            self._persist_suggestion(name)
             return exp
 
     def experiment_dir(self, name: str) -> Optional[str]:

@@ -426,6 +426,103 @@ def test_elastic_gang_restart_resumes_from_checkpoint(tmp_path):
         ctrl.close()
 
 
+def test_state_store_per_record_layout_and_order(tmp_path):
+    """Round-4 persistence layout: one file per record (a trial update no
+    longer rewrites every trial), creation order survives a reload even
+    though filenames carry random suffixes, and deletes unlink the record."""
+    from katib_tpu.api.status import Experiment, Trial
+    from katib_tpu.db.state import ExperimentStateStore
+
+    store = ExperimentStateStore(str(tmp_path))
+    spec = ExperimentSpec(
+        name="layout",
+        parameters=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="m"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=_slow_quadratic_template(0.0),
+        max_trial_count=3,
+    )
+    store.create_experiment(Experiment(spec=spec))
+    # creation order deliberately not lexicographic
+    names = ["layout-zz1", "layout-aa2", "layout-mm3"]
+    for n in names:
+        store.create_trial(Trial(name=n, experiment_name="layout"))
+    sdir = tmp_path / "layout" / "state"
+    assert (sdir / "experiment.json").exists()
+    assert sorted(p.name for p in (sdir / "trials").iterdir()) == sorted(
+        n + ".json" for n in names
+    )
+    # a single-trial update touches only that record (content-compared —
+    # mtime granularity is too coarse for back-to-back writes)
+    t = store.get_trial("layout", "layout-aa2")
+    before = {p.name: p.read_bytes() for p in (sdir / "trials").iterdir()}
+    t.message = "updated"
+    store.update_trial(t)
+    after = {p.name: p.read_bytes() for p in (sdir / "trials").iterdir()}
+    changed = [n for n in sorted(before) if before[n] != after[n]]
+    assert changed == ["layout-aa2.json"]
+
+    fresh = ExperimentStateStore(str(tmp_path))
+    assert fresh.load("layout") is not None
+    assert [t.name for t in fresh.list_trials("layout")] == names
+    assert fresh.get_trial("layout", "layout-aa2").message == "updated"
+
+    # delete + create must not reuse sequence numbers: order stays stable
+    # across a reload even when a new trial fills a deleted slot
+    store.delete_trial("layout", "layout-zz1")
+    assert not (sdir / "trials" / "layout-zz1.json").exists()
+    store.create_trial(Trial(name="layout-bb4", experiment_name="layout"))
+    reload2 = ExperimentStateStore(str(tmp_path))
+    reload2.load("layout")
+    assert [t.name for t in reload2.list_trials("layout")] == [
+        "layout-aa2", "layout-mm3", "layout-bb4"
+    ]
+
+    store.delete_experiment("layout")
+    assert not sdir.exists()
+
+
+def test_state_store_loads_legacy_single_file_snapshot(tmp_path):
+    """Stores written by earlier rounds (<exp>/state.json monoliths) still
+    resume."""
+    import json
+
+    from katib_tpu.api.status import Experiment, Trial
+    from katib_tpu.db.state import ExperimentStateStore
+
+    spec = ExperimentSpec(
+        name="legacy",
+        parameters=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="m"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=_slow_quadratic_template(0.0),
+        max_trial_count=2,
+    )
+    payload = {
+        "experiment": Experiment(spec=spec).to_dict(),
+        "trials": [
+            Trial(name="legacy-b", experiment_name="legacy").to_dict(),
+            Trial(name="legacy-a", experiment_name="legacy").to_dict(),
+        ],
+        "suggestion": None,
+    }
+    (tmp_path / "legacy").mkdir()
+    (tmp_path / "legacy" / "state.json").write_text(json.dumps(payload))
+
+    store = ExperimentStateStore(str(tmp_path))
+    assert store.has_state("legacy")
+    exp = store.load("legacy")
+    assert exp is not None and exp.name == "legacy"
+    assert [t.name for t in store.list_trials("legacy")] == ["legacy-b", "legacy-a"]
+
+    # loading a monolith migrates it to per-record files, so a SECOND fresh
+    # process (which prefers the per-record layout) still sees every trial
+    assert (tmp_path / "legacy" / "state" / "trials" / "legacy-a.json").exists()
+    again = ExperimentStateStore(str(tmp_path))
+    again.load("legacy")
+    assert [t.name for t in again.list_trials("legacy")] == ["legacy-b", "legacy-a"]
+
+
 def test_load_unknown_experiment_raises(tmp_path):
     ctrl = ExperimentController(root_dir=str(tmp_path))
     try:
