@@ -197,12 +197,14 @@ class TrialScheduler:
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
-                busy = any(
-                    self.state.get_trial(experiment_name, n) is not None
-                    for n in self._handles
-                ) or any(
-                    t.experiment_name == experiment_name for _, t in self._waiting
-                )
+                # snapshot: _run_trial's finally pops _handles without the
+                # lock, and get_trial yields the GIL mid-generator
+                handle_names = list(self._handles)
+                waiting = [t.experiment_name for _, t in self._waiting]
+            busy = any(
+                self.state.get_trial(experiment_name, n) is not None
+                for n in handle_names
+            ) or experiment_name in waiting
             if not busy:
                 return True
             time.sleep(0.005)

@@ -321,11 +321,14 @@ class ExperimentStateStore:
                 self._suggestions[name] = SuggestionState.from_dict(payload["suggestion"])
             # migrate: a legacy monolith loads once; without re-persisting,
             # the next process would prefer the (trial-less) per-record dir
-            # the first reconcile creates and silently drop completed work
-            self._persist(name)
+            # the first reconcile creates and silently drop completed work.
+            # experiment.json goes LAST — its presence is what makes load()
+            # prefer the per-record dir, so a crash mid-migration leaves the
+            # monolith authoritative instead of a half-written record set.
             for t in self._trials[name].values():
                 self._persist_trial(t)
             self._persist_suggestion(name)
+            self._persist(name)
             return exp
 
     def experiment_dir(self, name: str) -> Optional[str]:

@@ -167,6 +167,21 @@ def main() -> int:
                         f"north star skipped: {ns_budget:.0f}s left under "
                         "--max-hours", flush=True,
                     )
+                # third rung: on-chip tuning sweep (block sizes / batch
+                # knee) while the window lasts — writes its own record
+                if deadline - time.time() > 1500:
+                    try:
+                        proc = subprocess.run(
+                            [sys.executable,
+                             os.path.join(REPO, "scripts", "tune_tpu.py")],
+                            capture_output=True, text=True, timeout=1200,
+                            cwd=REPO,
+                        )
+                        tail = (proc.stdout or proc.stderr).strip().splitlines()[-1:]
+                        print(f"tuning rc={proc.returncode}: "
+                              f"{(tail or ['?'])[0][:160]}", flush=True)
+                    except subprocess.TimeoutExpired:
+                        print("tuning sweep hung past 1200s", flush=True)
                 return 0
             print(f"[{stamp}] bench ran but no TPU numbers "
                   f"(platform={platform}); will retry", flush=True)
