@@ -298,11 +298,20 @@ def _e2e_plan(on_tpu: bool, run_timeout: float, darts, n_trials: int):
              650.0, 350.0),
             (warm_rung, 150.0, 40.0),
         ]
-    for cand_scale, base_first, base_trial in ladder:
-        est_first = base_first * contention
-        if run_timeout >= est_first:
-            fit = 1 + int((run_timeout - est_first) / (base_trial * contention))
-            return cand_scale, max(1, min(n_trials, fit)), contention
+    # Prefer a rung that yields a DISTRIBUTION (≥3 trials) over a bigger
+    # model with a single accuracy point — the e2e stage's evidence value is
+    # the spread; fall back to the best single-trial rung only when no rung
+    # fits three.
+    want = min(3, n_trials)
+    for min_fit in (want, 1):
+        for cand_scale, base_first, base_trial in ladder:
+            est_first = base_first * contention
+            if run_timeout >= est_first:
+                fit = 1 + int(
+                    (run_timeout - est_first) / (base_trial * contention)
+                )
+                if fit >= min_fit:
+                    return cand_scale, max(1, min(n_trials, fit)), contention
     return None
 
 

@@ -259,10 +259,15 @@ def test_e2e_plan_contention_inflates_estimates(bench, monkeypatch):
     plan must divide the darts stage's measured step time by the nominal pin
     and inflate per-trial estimates by that factor."""
     monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
-    # uncontended: 900s fits the learnable scale's cold compile (650s)
+    # uncontended: 900s fits the learnable rung's cold compile (650s) but
+    # only ONE trial there — distribution-first degrades to the warm rung
+    # (>=3 accuracies beat a single bigger-model point)
     scale, n, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1700.0}, 3)
     assert contention == 1.0
-    assert scale["init_channels"] == 4 and n == 1
+    assert scale["init_channels"] == 1 and n == 3
+    # with room for 3 learnable trials (650 + 2*350), the bigger rung wins
+    scale, n, contention = bench._e2e_plan(False, 1400.0, {"step_ms": 1700.0}, 3)
+    assert scale["init_channels"] == 4 and n == 3
     # 2.6x contention: learnable first trial alone would cost 1690s of 620
     # — must degrade to the warm-cache headline rung, not time out at the
     # learnable scale
@@ -277,9 +282,10 @@ def test_e2e_plan_faster_than_pin_keeps_margin(bench, monkeypatch):
     """A box faster than the nominal pin must NOT deflate the estimates
     (contention clamps at 1.0) — the margin absorbs run-to-run variance."""
     monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
-    fast, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 300.0}, 3)
+    fast, n, contention = bench._e2e_plan(False, 1400.0, {"step_ms": 300.0}, 3)
     assert contention == 1.0
-    assert fast["init_channels"] == 4  # 900 >= 650: learnable rung fits
+    # 1400 >= 650 + 2*350 at UN-deflated estimates: learnable rung, 3 trials
+    assert fast["init_channels"] == 4 and n == 3
 
 
 def test_e2e_plan_no_rung_fits(bench, monkeypatch):
