@@ -811,7 +811,7 @@ def main() -> None:
             errors.append(err)
             if "timed out" in (err or ""):
                 break  # the tunnel burned its whole leash; don't re-queue it
-            time.sleep(5)
+            time.sleep(float(os.environ.get("BENCH_RETRY_SLEEP", "5")))
     cpu_budget = remaining() - margin
     cap = os.environ.get("BENCH_CPU_TIMEOUT")
     if cap:

@@ -36,6 +36,7 @@ def bench(monkeypatch):
         "BENCH_PROBE_MAX_RT_MS", "BENCH_PROBE_DEGRADED_RT_MS",
     ):
         monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_RETRY_SLEEP", "0")  # stubbed children: no backoff
     return mod
 
 
