@@ -532,6 +532,15 @@ class TrialScheduler:
         point); mirrors trial_controller_util.go:42-122."""
         spec = exp.spec
         obj_metric = observation.metric(spec.objective.objective_metric_name)
+        # "available" deliberately accepts NON-numeric latest values: the
+        # reference's darts flow collects a string objective
+        # (examples/v1beta1/nas/darts-cpu.yaml objectiveMetricName
+        # Best-Genotype, custom filter "(Genotype.*)") and such trials
+        # Succeed. Numeric garbage can't arrive via the push SDK
+        # (validate_metric_value raises, failing the trial) or the TEXT
+        # default filter (numeric regex); a custom filter admitting strings
+        # is, as in the reference, the experiment author's declaration that
+        # the objective isn't rankable.
         metrics_available = (
             obj_metric is not None and obj_metric.latest != UNAVAILABLE_METRIC_VALUE
         )

@@ -126,20 +126,18 @@ class MetricsReporter:
     _stopped: bool = False
 
     def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
+        fvals = {k: validate_metric_value(k, v) for k, v in metrics.items()}
         ts = timestamp if timestamp is not None else time.time()
         logs = [
-            MetricLog(timestamp=ts, metric_name=k, value=str(v)) for k, v in metrics.items()
+            MetricLog(timestamp=ts, metric_name=k, value=str(f))
+            for k, f in fvals.items()
         ]
         self.store.report_observation_log(self.trial_name, logs)
         # after the write, so a killed trial's final metrics are not lost
         if self.kill_event is not None and self.kill_event.is_set():
             raise TrialKilled(f"trial {self.trial_name} killed")
         if self.monitor is not None:
-            for k, v in metrics.items():
-                try:
-                    fv = float(v)
-                except (TypeError, ValueError):
-                    continue
+            for k, fv in fvals.items():
                 if self.monitor.observe(k, fv):
                     self._stopped = True
             if self._stopped and self.raise_on_stop:
@@ -161,6 +159,22 @@ def set_current_reporter(r: Optional[MetricsReporter]):
     return _current_reporter.set(r)
 
 
+def validate_metric_value(name: str, value) -> float:
+    """Normalize a pushed value to float or reject it — reference sdk
+    utils.validate_metrics_value (utils.py:75-84) raises before the push
+    RPC; a typo'd value must fail the trial loudly, not sail into the DB and
+    surface as a Succeeded trial with an unusable objective. Returning the
+    float (the stored form is str(float(v))) also keeps objects whose
+    ``float()`` succeeds but whose ``str()`` is non-numeric — numpy/jax
+    0-d arrays, bools, tensors — rankable once folded."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"metric {name!r} value {value!r} is not convertible to float"
+        ) from None
+
+
 def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> None:
     """SDK push entry point, reference sdk report_metrics.py:24+.
 
@@ -173,7 +187,7 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
     merged.update(kw)
     r = _current_reporter.get()
     if r is not None:
-        r.report(**merged)
+        r.report(**merged)  # MetricsReporter.report validates + normalizes
         return
     trial = os.environ.get(ENV_TRIAL_NAME)
     db = os.environ.get(ENV_DB_PATH)
@@ -187,7 +201,8 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
             store.close()
         return
     for k, v in merged.items():
-        print(f"{k}={v}", flush=True)
+        # normalized so the stdout collector's numeric TEXT filter matches
+        print(f"{k}={validate_metric_value(k, v)}", flush=True)
 
 
 # -- pull parsers for subprocess output -------------------------------------

@@ -113,6 +113,72 @@ class TestLineParsers:
         assert logs[1].timestamp == 42.0
 
 
+class TestPushValidation:
+    """Reference sdk utils.validate_metrics_value (utils.py:75-84): the push
+    path is numeric-only; strings arrive only via collector filters (the
+    darts Best-Genotype flow)."""
+
+    def test_validate_metric_value(self):
+        import math
+
+        from katib_tpu.runtime.metrics import validate_metric_value
+
+        # returns the normalized float — the stored form is str(float(v)),
+        # so float()-able objects with non-numeric str() stay rankable
+        assert validate_metric_value("m", "0.99") == 0.99
+        assert validate_metric_value("m", True) == 1.0
+        assert validate_metric_value("m", "-3e-4") == -3e-4
+        import numpy as np
+
+        assert validate_metric_value("m", np.float32(0.5)) == 0.5
+        assert math.isnan(validate_metric_value("m", math.nan))
+        for bad in (None, "not-a-number", {}, [0.5], "Genotype(normal=[])"):
+            with pytest.raises(ValueError, match="not convertible"):
+                validate_metric_value("m", bad)
+
+    def test_report_normalizes_stored_values(self, tmp_path):
+        from katib_tpu.db.store import open_store
+        from katib_tpu.runtime.metrics import MetricsReporter
+
+        store = open_store(str(tmp_path / "obs.db"), backend="sqlite")
+        try:
+            MetricsReporter(store=store, trial_name="t1").report(
+                **{"acc": "0.25", "flag": True}
+            )
+            logs = {l.metric_name: l.value for l in store.get_observation_log("t1")}
+            assert logs == {"acc": "0.25", "flag": "1.0"}
+        finally:
+            store.close()
+
+    def test_garbage_push_fails_the_trial(self, tmp_path):
+        """A typo'd push value raises inside the trial and the trial FAILS
+        with the reason in its message — it must not surface as Succeeded
+        with an unrankable objective."""
+        from katib_tpu.client import KatibClient, search
+
+        def objective(params):
+            import katib_tpu
+
+            katib_tpu.report_metrics({"score": "not-a-number"})
+
+        c = KatibClient(root_dir=str(tmp_path), devices=[0])
+        c.tune(
+            name="badmetric",
+            objective=objective,
+            parameters={"x": search.double(min=0.0, max=1.0)},
+            objective_metric_name="score",
+            max_trial_count=1,
+            parallel_trial_count=1,
+            max_failed_trial_count=0,
+        )
+        exp = c.run("badmetric", timeout=60)
+        t = c.list_trials("badmetric")[0]
+        assert t.condition.value == "Failed"
+        assert "not convertible" in t.message
+        assert exp.status.condition.value == "Failed"  # maxFailed=0 budget
+        c.controller.close()
+
+
 class TestCheckpointStore:
     @pytest.mark.parametrize("use_orbax", [False, True])
     def test_roundtrip(self, tmp_path, use_orbax):
