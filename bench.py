@@ -238,7 +238,7 @@ def _bench_lm(jax, np, on_tpu: bool, size: str = "small"):
 # inflates its trial-cost estimates accordingly — round-4 lesson: a fixed
 # estimate calibrated on a quiet box fit 0 trials when three suites shared
 # the machine and every step ran ~2.5x slower.
-NOMINAL_DARTS_STEP_MS = {"cpu": 1700.0, "tpu": 25.0}
+NOMINAL_DARTS_STEP_MS = {"cpu": 1100.0, "tpu": 25.0}
 
 
 def _e2e_plan(on_tpu: bool, run_timeout: float, darts, n_trials: int):

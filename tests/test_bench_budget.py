@@ -263,16 +263,16 @@ def test_e2e_plan_contention_inflates_estimates(bench, monkeypatch):
     # uncontended: 900s fits the learnable rung's cold compile (650s) but
     # only ONE trial there — distribution-first degrades to the warm rung
     # (>=3 accuracies beat a single bigger-model point)
-    scale, n, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1700.0}, 3)
+    scale, n, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1100.0}, 3)
     assert contention == 1.0
     assert scale["init_channels"] == 1 and n == 3
     # with room for 3 learnable trials (650 + 2*350), the bigger rung wins
-    scale, n, contention = bench._e2e_plan(False, 1400.0, {"step_ms": 1700.0}, 3)
+    scale, n, contention = bench._e2e_plan(False, 1400.0, {"step_ms": 1100.0}, 3)
     assert scale["init_channels"] == 4 and n == 3
     # 2.6x contention: learnable first trial alone would cost 1690s of 620
     # — must degrade to the warm-cache headline rung, not time out at the
     # learnable scale
-    scale, n, contention = bench._e2e_plan(False, 620.0, {"step_ms": 4420.0}, 3)
+    scale, n, contention = bench._e2e_plan(False, 620.0, {"step_ms": 2860.0}, 3)
     assert contention == pytest.approx(2.6)
     assert scale["init_channels"] == 1 and scale["num_nodes"] == 1
     assert scale["schedule_horizon"] == bench.STEPS_PER_EPOCH
@@ -305,8 +305,8 @@ def test_e2e_plan_per_backend_nominal_override(bench, monkeypatch):
     recalibration must not corrupt the CPU fallback's contention estimate."""
     monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS_TPU", "25")
     monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
-    _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1200.0}, 3)
-    assert contention == 1.0  # CPU still uses the CPU pin, not 1200/25=48x
+    _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 1100.0}, 3)
+    assert contention == 1.0  # CPU still uses the CPU pin, not 1100/25=44x
     monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS", "600")
     _, _, contention = bench._e2e_plan(False, 9000.0, {"step_ms": 1200.0}, 3)
     assert contention == 2.0  # shared name is the fallback for CPU
@@ -356,5 +356,5 @@ def test_e2e_plan_garbage_nominal_override_falls_back(bench, monkeypatch):
     nominal, not crash the e2e stage with ZeroDivisionError/ValueError."""
     for bad in ("0", "banana"):
         monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS", bad)
-        _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 3400.0}, 3)
-        assert contention == pytest.approx(2.0)  # 3400 / builtin 1700
+        _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 2200.0}, 3)
+        assert contention == pytest.approx(2.0)  # 2200 / builtin 1100
