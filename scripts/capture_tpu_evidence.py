@@ -109,7 +109,9 @@ def run_north_star(budget_s: float, deadline: float):
             )
         except (OSError, ValueError):
             searched_ok = False
-    retrain_budget = min(1500.0, deadline - time.time())
+    # reserve the same slack main() keeps, so the retrain cannot starve the
+    # tuning rung that follows without at least leaving a log line behind
+    retrain_budget = min(1500.0, deadline - time.time() - 900)
     if searched_ok and retrain_budget >= 300:
         # stage 2 of the DARTS contract: retrain the searched genotype as a
         # discrete network and append the result to the same record
@@ -208,7 +210,13 @@ def main() -> int:
                     )
                 # third rung: on-chip tuning sweep (block sizes / batch
                 # knee) while the window lasts — writes its own record
-                if deadline - time.time() > 1500:
+                tune_left = deadline - time.time()
+                if tune_left <= 1500:
+                    print(
+                        f"tuning sweep skipped: {tune_left:.0f}s left "
+                        "under --max-hours", flush=True,
+                    )
+                else:
                     try:
                         proc = subprocess.run(
                             [sys.executable,

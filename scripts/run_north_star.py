@@ -154,6 +154,9 @@ def main() -> None:
                 "assignments": t.assignments_dict(),
             })
         opt = exp.status.current_optimal_trial
+        # "verification" and "optimal_assignments" are a stable contract:
+        # capture_tpu_evidence.py gates the stage-2 derived retrain on
+        # verification == "ok" and a non-null optimal_assignments
         record = {
             "experiment": name,
             "algorithm": "tpe",
