@@ -584,27 +584,18 @@ def child_main(platform: str) -> None:
 # Parent: bounded orchestration, never initializes JAX itself
 # ---------------------------------------------------------------------------
 
-def _attach_north_star(result: dict) -> None:
-    """Surface the checked-in 50-trial north-star record (scripts/
-    run_north_star.py) in the bench artifact, so the driver-captured JSON
-    carries the experiment-protocol evidence even when the TPU phase is
-    skipped."""
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "examples", "records", "darts_hpo_50trials_cpu.json",
-    )
+def _north_star_summary(relpath: str):
+    """Load one checked-in north-star record into the compact form the
+    bench artifact carries; an absent/corrupt record degrades to an error
+    entry — same degrade-never-zero pattern as the rest of the file."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), relpath)
     try:
         with open(path) as f:
             rec = json.load(f)
     except (OSError, ValueError) as e:
-        # an absent/corrupt record is itself worth surfacing — same
-        # degrade-never-zero pattern as the rest of the file
-        result.setdefault("extras", {})["north_star_record"] = {
-            "error": f"{type(e).__name__}: {e}"[:200]
-        }
-        return
-    result.setdefault("extras", {})["north_star_record"] = {
-        "file": "examples/records/darts_hpo_50trials_cpu.json",
+        return {"file": relpath, "error": f"{type(e).__name__}: {e}"[:200]}
+    return {
+        "file": relpath,
         "n_trials": rec.get("n_trials"),
         "n_succeeded": rec.get("n_succeeded"),
         "wallclock_s": rec.get("wallclock_s"),
@@ -616,6 +607,21 @@ def _attach_north_star(result: dict) -> None:
         ),
         "verification": rec.get("verification"),
     }
+
+
+def _attach_north_star(result: dict) -> None:
+    """Surface the checked-in 50-trial north-star records (scripts/
+    run_north_star.py) in the bench artifact, so the driver-captured JSON
+    carries the experiment-protocol evidence even when the TPU phase is
+    skipped. The verified TPU-scale capture is the headline record; the
+    CPU variant rides along for the reduced-scale comparison."""
+    extras = result.setdefault("extras", {})
+    tpu = _north_star_summary("examples/records/darts_hpo_50trials_tpu.json")
+    cpu = _north_star_summary("examples/records/darts_hpo_50trials_cpu.json")
+    # stable per-platform keys; north_star_record is the headline copy
+    extras["north_star_record_tpu"] = tpu
+    extras["north_star_record_cpu"] = cpu
+    extras["north_star_record"] = tpu if tpu.get("verification") == "ok" else cpu
 
 
 def _salvage(result_file: str, diag: str):
