@@ -666,6 +666,13 @@ class ExperimentSpec:
     metrics_collector_spec: MetricsCollectorSpec = field(default_factory=MetricsCollectorSpec)
     nas_config: Optional[NasConfig] = None
     resume_policy: ResumePolicy = ResumePolicy.NEVER
+    # TPU-first addition with no reference counterpart: when True, a new
+    # trial whose parameter assignments exactly match an already-Succeeded
+    # trial of the same experiment reuses that trial's observation log
+    # instead of re-running the workload (opt-in — stochastic trials give
+    # different metrics per run, so the author must declare determinism).
+    # Trials carrying checkpoint lineage (PBT exploit/explore) never reuse.
+    reuse_duplicate_results: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -687,6 +694,8 @@ class ExperimentSpec:
             d["maxFailedTrialCount"] = self.max_failed_trial_count
         if self.nas_config:
             d["nasConfig"] = self.nas_config.to_dict()
+        if self.reuse_duplicate_results:
+            d["reuseDuplicateResults"] = True
         return d
 
     @classmethod
@@ -707,6 +716,7 @@ class ExperimentSpec:
             ),
             nas_config=NasConfig.from_dict(d["nasConfig"]) if d.get("nasConfig") else None,
             resume_policy=ResumePolicy(d.get("resumePolicy", "Never")),
+            reuse_duplicate_results=bool(d.get("reuseDuplicateResults", False)),
         )
 
     def to_json(self) -> str:

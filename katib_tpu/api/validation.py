@@ -72,6 +72,11 @@ def validate_experiment(
         and spec.parallel_trial_count > spec.max_trial_count
     ):
         errs.append("parallelTrialCount should be less than or equal to maxTrialCount")
+    if spec.reuse_duplicate_results and spec.max_trial_count is None:
+        # duplicate trials finalize synchronously inside submit(): without a
+        # trial budget, an exhausted discrete space + unreachable goal would
+        # spin the reconcile loop creating reused trials at CPU speed
+        errs.append("reuseDuplicateResults requires maxTrialCount to bound the experiment")
 
     if old is not None:
         _validate_restart(spec, old, errs)

@@ -133,9 +133,55 @@ class TrialScheduler:
             self.recorder.event(exp.name, "Trial", trial.name, "TrialCreated", "Trial is created")
         if checkpoint_dir:
             self._checkpoint_dirs[trial.name] = checkpoint_dir
+        elif exp.spec.reuse_duplicate_results and self._reuse_duplicate(exp, trial):
+            # finalized from a prior identical-assignment success; never
+            # reused for checkpoint-lineage trials (PBT exploit/explore
+            # trains FROM a parent checkpoint — same params, different run)
+            return
         with self._lock:
             self._waiting.append((exp, trial))
         self._dispatch()
+
+    def _reuse_duplicate(self, exp: Experiment, trial: Trial) -> bool:
+        """Opt-in duplicate-result reuse (spec.reuse_duplicate_results): if a
+        Succeeded trial of this experiment has exactly the same parameter
+        assignments, copy its observation log to this trial and finalize it
+        Succeeded without running the workload. No reference counterpart —
+        on TPU, a duplicate suggestion (small discrete spaces, categorical
+        resampling) would otherwise re-burn a full training run."""
+        key = tuple(sorted((a.name, a.value) for a in trial.parameter_assignments))
+        if not key:
+            return False  # nothing to match on; run the trial
+        source = None
+        for t in self.state.list_trials(exp.name):
+            if (
+                t.name != trial.name
+                and t.condition == TrialCondition.SUCCEEDED
+                and tuple(sorted((a.name, a.value) for a in t.parameter_assignments)) == key
+            ):
+                source = t
+                break
+        if source is None:
+            return False
+        logs = self.obs_store.get_observation_log(source.name)
+        if logs:
+            self.obs_store.report_observation_log(trial.name, logs)
+        trial.observation = fold_observation(logs, exp.spec.objective.all_metric_names())
+        # pass through RUNNING so start_time is stamped — rung-cohort
+        # algorithms (hyperband) sort trials by start_time, and a None
+        # there would silently misplace the reused trial in its bracket
+        trial.set_condition(
+            TrialCondition.RUNNING, "TrialRunning",
+            f"reusing result of trial {source.name}",
+        )
+        trial.set_condition(
+            TrialCondition.SUCCEEDED,
+            "DuplicateResultReused",
+            f"reused result of trial {source.name} (identical assignments)",
+        )
+        self._record_terminal(exp, trial)
+        self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
+        return True
 
     def kill(self, trial_name: str) -> None:
         """Early-stop / parallel-shrink kill (reference deleteTrials) — a
@@ -569,6 +615,12 @@ class TrialScheduler:
             )
         else:
             trial.set_condition(TrialCondition.SUCCEEDED, "TrialSucceeded", "Trial has succeeded")
+        self._record_terminal(exp, trial)
+
+    def _record_terminal(self, exp: Experiment, trial: Trial) -> None:
+        """Terminal bookkeeping shared by every path that sets a trial's
+        final condition (_finalize and _reuse_duplicate): persist, count,
+        record the event, apply retainRun workdir semantics."""
         self.state.update_trial(trial)
         if self.metrics_registry is not None:
             bucket = {
@@ -592,7 +644,7 @@ class TrialScheduler:
         # for postmortem (a deviation the reference can't offer — its pods
         # are gone either way).
         if (
-            not spec.trial_template.retain
+            not exp.spec.trial_template.retain
             and self.workdir_root
             and trial.condition in (TrialCondition.SUCCEEDED, TrialCondition.EARLY_STOPPED)
         ):

@@ -255,3 +255,98 @@ class TestResumePolicies:
 
         with pytest.raises(ValidationError):
             controller.edit_experiment_budget("never-e2e", max_trial_count=6)
+
+
+class TestDuplicateResultReuse:
+    """spec.reuse_duplicate_results (TPU-first addition, no reference
+    counterpart): identical-assignment trials reuse a prior success's
+    observation log instead of re-running the workload."""
+
+    @staticmethod
+    def _categorical_spec(name, counter, reuse=True, max_trials=6):
+        def counted_trial(assignments, ctx):
+            counter.append(assignments["choice"])
+            ctx.report(objective=float(len(assignments["choice"])))
+
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec(
+                    "choice", ParameterType.CATEGORICAL,
+                    FeasibleSpace(list=["a", "bb"]),
+                ),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+            ),
+            algorithm=AlgorithmSpec(algorithm_name="random"),
+            trial_template=TrialTemplate(function=counted_trial),
+            max_trial_count=max_trials,
+            parallel_trial_count=1,  # serial: earlier successes are visible
+            reuse_duplicate_results=reuse,
+        )
+
+    def test_duplicates_reuse_observation_without_rerunning(self, controller):
+        executions = []
+        spec = self._categorical_spec("reuse-on", executions, reuse=True)
+        controller.create_experiment(spec)
+        exp = controller.run("reuse-on", timeout=120)
+        assert exp.status.reason == ExperimentReason.MAX_TRIALS_REACHED
+        trials = controller.state.list_trials("reuse-on")
+        assert len(trials) == 6
+        # two distinct values, six serial trials: at most one real run per
+        # distinct value, everything after is a reuse
+        assert len(executions) == len(set(executions))
+        reused = [t for t in trials if t.conditions and any(
+            c.reason == "DuplicateResultReused" for c in t.conditions)]
+        assert len(reused) == 6 - len(executions)
+        # a reused trial carries the source's folded observation
+        for t in reused:
+            m = t.observation.metric("objective")
+            assert m is not None
+            assert float(m.latest) == float(len(t.assignments_dict()["choice"]))
+
+    def test_flag_off_reruns_every_trial(self, controller):
+        executions = []
+        spec = self._categorical_spec("reuse-off", executions, reuse=False, max_trials=5)
+        controller.create_experiment(spec)
+        exp = controller.run("reuse-off", timeout=120)
+        assert exp.status.reason == ExperimentReason.MAX_TRIALS_REACHED
+        assert len(executions) == 5  # every trial actually ran
+
+    def test_spec_round_trips(self):
+        spec = self._categorical_spec("reuse-rt", [], reuse=True)
+        spec2 = ExperimentSpec.from_json(spec.to_json())
+        assert spec2.reuse_duplicate_results is True
+        off = self._categorical_spec("reuse-rt2", [], reuse=False)
+        assert "reuseDuplicateResults" not in off.to_dict()
+
+    def test_reuse_requires_trial_budget(self):
+        from katib_tpu.api import ValidationError, set_defaults, validate_experiment
+        from katib_tpu.earlystop.medianstop import registered_early_stoppers
+        from katib_tpu.suggest.base import registered_algorithms
+
+        spec = self._categorical_spec("reuse-unbounded", [], reuse=True)
+        spec.max_trial_count = None
+        set_defaults(spec)
+        with pytest.raises(ValidationError, match="reuseDuplicateResults"):
+            validate_experiment(
+                spec,
+                known_algorithms=registered_algorithms(),
+                known_early_stopping=registered_early_stoppers(),
+            )
+
+    def test_reused_trial_has_start_and_completion_time(self, controller):
+        executions = []
+        spec = self._categorical_spec("reuse-times", executions, reuse=True, max_trials=4)
+        controller.create_experiment(spec)
+        controller.run("reuse-times", timeout=120)
+        trials = controller.state.list_trials("reuse-times")
+        reused = [t for t in trials if any(
+            c.reason == "DuplicateResultReused" for c in t.conditions)]
+        assert reused, "4 serial trials over 2 values must produce a reuse"
+        for t in reused:
+            # hyperband sorts rung cohorts by start_time; a reused trial
+            # must carry real timestamps like any executed trial
+            assert t.start_time is not None
+            assert t.completion_time is not None
