@@ -67,6 +67,7 @@ a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
  <button id="cmpbtn">compare selected</button>
  <span class="muted">objective curves of the checked trials on one plot</span></div>
 <div id="cmpbox" style="display:none"><h2>trial comparison</h2><div id="cmp"></div></div>
+<div id="impbox" style="display:none"><h2>parameter importance</h2><div id="imp"></div></div>
 <pre id="logbox"></pre>
 <div id="nasbox" style="display:none"><h2>architectures (NAS)</h2><div id="nas"></div></div>
 <div id="evbox" style="display:none"><h2>events</h2><div id="events"></div></div>
@@ -131,7 +132,20 @@ async function sel(n){
    const r=await fetch(`/api/experiments/${encodeURIComponent(a.dataset.exp)}/trials/${encodeURIComponent(a.dataset.trial)}/logs`);
    const b=document.getElementById('logbox');
    b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}
- loadNas(n);loadEvents(n)}
+ loadNas(n);loadEvents(n);loadImportance(n)}
+async function loadImportance(n){
+ const box=document.getElementById('impbox');
+ try{
+  const r=await j(`/api/experiments/${encodeURIComponent(n)}/importance`);
+  if(!r.importance||!r.importance.length){box.style.display='none';return}
+  const mx=Math.max(...r.importance.map(x=>x.importance))||1;
+  document.getElementById('imp').innerHTML=r.importance.map(x=>
+   `<div style="margin:.15rem 0"><code style="display:inline-block;width:10rem">${esc(x.parameter)}</code>`+
+   `<span style="display:inline-block;background:#0b57d0;height:.7rem;width:${(x.importance/mx*220).toFixed(0)}px;vertical-align:middle"></span>`+
+   ` ${x.importance.toFixed(3)} <span class="muted">(${esc(x.method)}, n=${x.n})</span></div>`).join('')+
+   `<div class="muted">correlation-based importance over ${r.n} completed trials — a screen, not a causal claim</div>`;
+  box.style.display='block';
+ }catch(e){box.style.display='none'}}
 const PALETTE=['#0b57d0','#b3261e','#0a7d36','#7b5ea7','#b26a00','#00838f','#ad1457','#5d4037'];
 async function compareSel(){
  const names=[...document.querySelectorAll('.cmpsel:checked')].map(c=>c.dataset.trial);
@@ -276,6 +290,90 @@ def nas_graph(exp, trials) -> Dict[str, Any]:
     return {"experiment": exp.name, "architectures": out}
 
 
+def parameter_importance(exp, trials) -> Dict[str, Any]:
+    """Correlation-based parameter importance over the experiment's completed
+    rankable trials — numeric parameters get |Pearson r| against the
+    objective (log10-scaled for logUniform spaces), categorical/discrete get
+    eta-squared (between-group variance share). Deliberately simple, honest
+    analytics (labelled with the method per row); no reference counterpart —
+    the Angular UI plots curves but offers no importance view."""
+    import math
+
+    from ..api.spec import Distribution, ParameterType
+    from ..api.status import TrialCondition
+
+    obj_name = exp.spec.objective.objective_metric_name
+    points = []
+    for t in trials:
+        if t.condition not in (TrialCondition.SUCCEEDED, TrialCondition.EARLY_STOPPED):
+            continue
+        if not t.observation:
+            continue
+        m = t.observation.metric(obj_name)
+        if m is None:
+            continue
+        try:
+            y = float(m.latest)
+        except (TypeError, ValueError):
+            continue
+        if not math.isfinite(y):
+            continue  # one diverged 'nan' trial must not poison every score
+        points.append((t.assignments_dict(), y))
+    out: Dict[str, Any] = {"experiment": exp.name, "n": len(points), "importance": []}
+    if len(points) < 3:
+        return out
+    for p in exp.spec.parameters:
+        vals = [(a.get(p.name), y) for a, y in points if a.get(p.name) is not None]
+        if len(vals) < 3:
+            continue
+        if p.parameter_type in (ParameterType.DOUBLE, ParameterType.INT):
+            log_scale = p.feasible_space.distribution == Distribution.LOG_UNIFORM
+            try:
+                xs = [
+                    math.log10(float(v)) if log_scale else float(v) for v, _ in vals
+                ]
+            except ValueError:
+                continue
+            if not all(math.isfinite(x) for x in xs):
+                continue
+            yv = [y for _, y in vals]
+            n = len(xs)
+            x_mean = sum(xs) / n
+            ym = sum(yv) / n
+            sxx = sum((x - x_mean) ** 2 for x in xs)
+            syy = sum((y - ym) ** 2 for y in yv)
+            if sxx == 0 or syy == 0:
+                score = 0.0
+            else:
+                sxy = sum((x - x_mean) * (y - ym) for x, y in zip(xs, yv))
+                score = abs(sxy / math.sqrt(sxx * syy))
+            method = "abs_pearson" + ("_log10" if log_scale else "")
+        else:
+            groups: Dict[str, list] = {}
+            for v, y in vals:
+                groups.setdefault(str(v), []).append(y)
+            # variance share over the SUBSET that has this parameter — mixing
+            # subset group means with a full-set total would let the ratio
+            # exceed 1 when some trials lack the assignment
+            yv = [y for _, y in vals]
+            y_mean = sum(yv) / len(yv)
+            ss_total = sum((y - y_mean) ** 2 for y in yv)
+            if ss_total == 0 or len(groups) < 2:
+                score = 0.0
+            else:
+                ss_between = sum(
+                    len(g) * ((sum(g) / len(g)) - y_mean) ** 2 for g in groups.values()
+                )
+                score = ss_between / ss_total
+            method = "eta_squared"
+        out["importance"].append(
+            {"parameter": p.name, "importance": round(score, 4),
+             "method": method, "n": len(vals)}
+        )
+    out["importance"].sort(key=lambda r: -r["importance"])
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     controller = None   # injected by serve_ui
     auth_token = None   # injected by serve_ui; None disables write endpoints
@@ -407,6 +505,10 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(s.to_dict() if s else None)
                 if sub == "nas":
                     return self._send(nas_graph(exp, ctrl.state.list_trials(name)))
+                if sub == "importance":
+                    return self._send(
+                        parameter_importance(exp, ctrl.state.list_trials(name))
+                    )
             if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
                 from urllib.parse import parse_qs
 

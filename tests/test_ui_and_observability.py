@@ -461,3 +461,97 @@ class TestTrialLogsAndTemplates:
         assert again.get_template("t1") == {"command": ["echo", "hi"]}
         again.delete_template("t1")
         assert ExperimentStateStore(str(tmp_path)).get_template("t1") is None
+
+
+class TestParameterImportance:
+    def test_endpoint_perfect_correlation(self, stack):
+        base, ctrl, _ = stack
+        # the fixture experiment reports score == x: |pearson| must be ~1
+        status, _, body = get(f"{base}/api/experiments/ui-exp/importance")
+        assert status == 200
+        r = json.loads(body)
+        assert r["n"] == 3
+        (row,) = r["importance"]
+        assert row["parameter"] == "x"
+        assert row["method"] == "abs_pearson"
+        assert row["importance"] > 0.999
+
+    def test_unit_mixed_parameter_kinds(self):
+        from katib_tpu.api import (
+            Distribution,
+            ExperimentSpec,
+            FeasibleSpace,
+            ObjectiveSpec,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+        )
+        from katib_tpu.api.status import Experiment, Trial, TrialCondition
+        from katib_tpu.api.spec import ParameterAssignment
+        from katib_tpu.db.store import MetricLog, fold_observation
+        from katib_tpu.ui.server import parameter_importance
+
+        spec = ExperimentSpec(
+            name="imp",
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE,
+                              FeasibleSpace(min="1e-4", max="1e-1",
+                                            distribution=Distribution.LOG_UNIFORM)),
+                ParameterSpec("opt", ParameterType.CATEGORICAL,
+                              FeasibleSpace(list=["adam", "sgd"])),
+                ParameterSpec("noise", ParameterType.DOUBLE,
+                              FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE,
+                                    objective_metric_name="acc"),
+        )
+        exp = Experiment(spec=spec)
+        trials = []
+        # acc tracks log10(lr) exactly; opt flips a constant offset; noise
+        # is constant (zero variance -> importance 0)
+        cases = [
+            ("1e-4", "adam", -4.0), ("1e-3", "adam", -3.0),
+            ("1e-2", "sgd", -2.0), ("1e-1", "sgd", -1.0),
+            # a diverged trial must be excluded, not poison every score
+            ("1e-1", "sgd", float("nan")),
+        ]
+        for i, (lr, opt, acc) in enumerate(cases):
+            t = Trial(name=f"t{i}", experiment_name="imp")
+            t.parameter_assignments = [
+                ParameterAssignment("lr", lr),
+                ParameterAssignment("opt", opt),
+                ParameterAssignment("noise", "0.5"),
+            ]
+            t.observation = fold_observation(
+                [MetricLog(timestamp=float(i), metric_name="acc", value=str(acc))],
+                ["acc"],
+            )
+            t.set_condition(TrialCondition.SUCCEEDED, "TrialSucceeded", "ok")
+            trials.append(t)
+        out = parameter_importance(exp, trials)
+        assert out["n"] == 4  # the nan trial is screened out
+        rows = {r["parameter"]: r for r in out["importance"]}
+        assert rows["lr"]["method"] == "abs_pearson_log10"
+        assert rows["lr"]["importance"] > 0.999
+        assert rows["opt"]["method"] == "eta_squared"
+        assert 0.5 < rows["opt"]["importance"] < 1.0
+        assert rows["noise"]["importance"] == 0.0
+        assert all(0.0 <= r["importance"] <= 1.0 for r in out["importance"])
+        # sorted most-important first
+        assert out["importance"][0]["parameter"] == "lr"
+
+    def test_unit_insufficient_trials(self):
+        from katib_tpu.api import (ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+                                   ObjectiveType, ParameterSpec, ParameterType)
+        from katib_tpu.api.status import Experiment
+        from katib_tpu.ui.server import parameter_importance
+
+        spec = ExperimentSpec(
+            name="imp2",
+            parameters=[ParameterSpec("x", ParameterType.DOUBLE,
+                                      FeasibleSpace(min="0", max="1"))],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE,
+                                    objective_metric_name="acc"),
+        )
+        out = parameter_importance(Experiment(spec=spec), [])
+        assert out == {"experiment": "imp2", "n": 0, "importance": []}
