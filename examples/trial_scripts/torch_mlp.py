@@ -1,0 +1,66 @@
+"""A genuine PyTorch training script tuned as an arbitrary-subprocess trial.
+
+The reference's pytorch-mnist trial image
+(/root/reference/examples/v1beta1/trial-images/pytorch-mnist/mnist.py) is a
+plain torch script that prints metrics for the StdOut collector; katib-tpu
+keeps that capability — a trial is any command, in any ML framework — while
+its own compute path stays JAX/TPU. This script trains a torch MLP on a
+synthetic-blob classification task (no dataset download; the image has CPU
+torch) and prints ``name=value`` lines the TEXT metrics filter scrapes.
+
+Usage: python torch_mlp.py --lr 0.1 --momentum 0.9 --epochs 3
+"""
+
+import argparse
+
+import torch
+import torch.nn as nn
+
+
+def make_blobs(n: int = 2048, classes: int = 4, dim: int = 16, seed: int = 0):
+    # class centers are the TASK and stay fixed across splits; only the
+    # sampled points vary with ``seed``
+    gc = torch.Generator().manual_seed(1234)
+    centers = torch.randn(classes, dim, generator=gc) * 3.0
+    g = torch.Generator().manual_seed(seed)
+    y = torch.randint(0, classes, (n,), generator=g)
+    x = centers[y] + torch.randn(n, dim, generator=g)
+    return x, y
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=128)
+    args = ap.parse_args()
+
+    torch.manual_seed(0)
+    x, y = make_blobs()
+    x_test, y_test = make_blobs(n=512, seed=1)
+
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr, momentum=args.momentum)
+    loss_fn = nn.CrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(x))
+        total = 0.0
+        for i in range(0, len(x), args.batch_size):
+            idx = perm[i : i + args.batch_size]
+            opt.zero_grad()
+            loss = loss_fn(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()
+            total += float(loss) * len(idx)
+        with torch.no_grad():
+            acc = float((model(x_test).argmax(-1) == y_test).float().mean())
+        # one line per epoch: the TEXT collector folds min/max/latest
+        print(f"epoch={epoch}")
+        print(f"loss={total / len(x):.6f}")
+        print(f"accuracy={acc:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -323,3 +323,39 @@ def test_tfevent_e2e(controller, tmp_path):
         assert m is not None
         lr = float(t.assignments_dict()["lr"])
         assert abs(float(m.max) - lr) < 1e-5  # step 5: lr * 5/5
+
+
+def test_pytorch_subprocess_e2e(controller):
+    """The reference's pytorch-mnist matrix, as katib-tpu keeps it: a trial
+    is an arbitrary subprocess in any ML framework (here genuine CPU torch,
+    examples/trial_scripts/torch_mlp.py) with placeholder substitution and
+    StdOut TEXT metric scraping — the framework-agnostic contract
+    (README.md:27-31 of the reference)."""
+    import json
+    import os
+
+    pytest.importorskip("torch")  # not a katib-tpu dependency; trial-side only
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "examples", "pytorch-subprocess.json")) as f:
+        spec = ExperimentSpec.from_dict(json.load(f))
+    # the shipped example assumes cwd == repo root; pin it for the test and
+    # shrink the budget (torch import is ~5s per trial on this box)
+    spec.trial_template.working_dir = repo
+    spec.max_trial_count = 4
+    spec.parallel_trial_count = 2
+    spec.objective.goal = None  # assert on MaxTrialsReached determinism
+    controller.create_experiment(spec)
+    exp = controller.run(spec.name, timeout=300)
+    assert exp.status.is_succeeded, exp.status.message
+    trials = controller.state.list_trials(spec.name)
+    assert len(trials) == 4
+    assert all(t.condition == TrialCondition.SUCCEEDED for t in trials), [
+        (t.name, t.condition.value, t.message) for t in trials
+    ]
+    best = exp.status.current_optimal_trial
+    acc = float(best.observation.metric("accuracy").latest)
+    assert 0.0 < acc <= 1.0
+    # every trial scraped both metrics from stdout
+    for t in trials:
+        assert t.observation.metric("accuracy") is not None
+        assert t.observation.metric("loss") is not None
