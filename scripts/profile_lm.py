@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Capture an xplane profile of the large-LM train step on the TPU.
+
+The round-4 bench pins mfu_large at ~0.56; pushing further needs the real
+per-op time split, not guesses (a fused-CE kernel was considered and
+rejected on FLOP arithmetic — its backward recomputation costs more than
+the logits HBM traffic it saves at this config). This script runs the
+exact `bench.py` large-LM configuration under ``jax.profiler.trace`` and
+leaves the xplane protobufs in a scratch directory (default under /tmp —
+binary profiler blobs don't belong in the curated examples/records/; check
+in *conclusions*, not traces) for offline analysis; it also prints the
+coarse wall-clock split it can measure directly (compile, first step,
+steady step).
+
+Usage: python scripts/profile_lm.py [--steps 20] [--size large]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--size", choices=("small", "large"), default="large")
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--tpu", action="store_true",
+        help="run on the accelerator backend (default forces CPU — the axon "
+        "sitecustomize pins the TPU platform even under JAX_PLATFORMS=cpu, "
+        "and a wedged tunnel hangs backend init)",
+    )
+    args = ap.parse_args()
+
+    if not args.tpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from katib_tpu.models.transformer import TransformerConfig
+    from katib_tpu.parallel.mesh import make_mesh
+    from katib_tpu.parallel.train import make_lm_train_step
+    from katib_tpu.utils.compilation import enable_compilation_cache
+    from katib_tpu.utils.timing import host_sync
+
+    enable_compilation_cache()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if args.size == "large" and on_tpu:
+        cfg = dict(vocab_size=32768, embed_dim=1024, num_layers=8, num_heads=16,
+                   max_seq_len=2048, dtype=jnp.bfloat16)
+        batch, seq = 4, 2048
+    elif on_tpu:
+        cfg = dict(vocab_size=8192, embed_dim=512, num_layers=4, num_heads=8,
+                   max_seq_len=1024, dtype=jnp.bfloat16)
+        batch, seq = 8, 1024
+    else:  # CPU smoke of the script itself
+        cfg = dict(vocab_size=512, embed_dim=128, num_layers=2, num_heads=4,
+                   max_seq_len=256, dtype=jnp.float32)
+        batch, seq = 4, 256
+
+    config = TransformerConfig(**cfg)
+    mesh = make_mesh(jax.devices()[:1])
+    params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, 1e-3)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, config.vocab_size, size=(batch, seq + 1), dtype=np.int32)
+    tokens, targets, positions = put_batch(data[:, :-1], data[:, 1:])
+
+    t0 = time.time()
+    params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
+    host_sync(loss)
+    compile_s = time.time() - t0
+
+    day = datetime.datetime.now().strftime("%Y%m%d")
+    trace_dir = args.out or os.path.join(
+        tempfile.gettempdir(), "katib_tpu_profiles", f"lm_{args.size}_{day}"
+    )
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.time()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.steps):
+            params, opt_state, loss = step_fn(
+                params, opt_state, tokens, targets, positions
+            )
+        host_sync(loss)
+    steady = (time.time() - t0) / args.steps
+    print(f"device={getattr(dev, 'device_kind', dev.platform)} "
+          f"compile={compile_s:.1f}s steady_step={steady * 1e3:.2f}ms "
+          f"loss={float(loss):.4f}")
+    print(f"xplane trace -> {trace_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
