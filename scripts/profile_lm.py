@@ -49,10 +49,9 @@ def main() -> int:
 
     if not args.tpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
 
-    from katib_tpu.models.transformer import TransformerConfig
+    from katib_tpu.models.transformer import TransformerConfig, bench_lm_config
     from katib_tpu.parallel.mesh import make_mesh
     from katib_tpu.parallel.train import make_lm_train_step
     from katib_tpu.utils.compilation import enable_compilation_cache
@@ -61,19 +60,7 @@ def main() -> int:
     enable_compilation_cache()
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    if args.size == "large" and on_tpu:
-        cfg = dict(vocab_size=32768, embed_dim=1024, num_layers=8, num_heads=16,
-                   max_seq_len=2048, dtype=jnp.bfloat16)
-        batch, seq = 4, 2048
-    elif on_tpu:
-        cfg = dict(vocab_size=8192, embed_dim=512, num_layers=4, num_heads=8,
-                   max_seq_len=1024, dtype=jnp.bfloat16)
-        batch, seq = 8, 1024
-    else:  # CPU smoke of the script itself
-        cfg = dict(vocab_size=512, embed_dim=128, num_layers=2, num_heads=4,
-                   max_seq_len=256, dtype=jnp.float32)
-        batch, seq = 4, 256
-
+    cfg, batch, seq, effective = bench_lm_config(args.size, on_tpu)
     config = TransformerConfig(**cfg)
     mesh = make_mesh(jax.devices()[:1])
     params, opt_state, step_fn, put_batch = make_lm_train_step(config, mesh, 1e-3)
@@ -86,21 +73,32 @@ def main() -> int:
     host_sync(loss)
     compile_s = time.time() - t0
 
+    # untraced steady-step timing FIRST (the number comparable to bench.py's
+    # step_ms) — profiler start/stop and xplane serialization must not be
+    # divided into it
+    t0 = time.time()
+    for _ in range(args.steps):
+        params, opt_state, loss = step_fn(
+            params, opt_state, tokens, targets, positions
+        )
+    host_sync(loss)
+    steady = (time.time() - t0) / args.steps
+
     day = datetime.datetime.now().strftime("%Y%m%d")
     trace_dir = args.out or os.path.join(
-        tempfile.gettempdir(), "katib_tpu_profiles", f"lm_{args.size}_{day}"
+        tempfile.gettempdir(), "katib_tpu_profiles", f"lm_{effective}_{day}"
     )
     os.makedirs(trace_dir, exist_ok=True)
-    t0 = time.time()
     with jax.profiler.trace(trace_dir):
         for _ in range(args.steps):
             params, opt_state, loss = step_fn(
                 params, opt_state, tokens, targets, positions
             )
         host_sync(loss)
-    steady = (time.time() - t0) / args.steps
     print(f"device={getattr(dev, 'device_kind', dev.platform)} "
-          f"compile={compile_s:.1f}s steady_step={steady * 1e3:.2f}ms "
+          f"config={effective} ({config.num_layers}L {config.embed_dim}d "
+          f"V{config.vocab_size} b{batch} T{seq}) "
+          f"compile={compile_s:.1f}s untraced_step={steady * 1e3:.2f}ms "
           f"loss={float(loss):.4f}")
     print(f"xplane trace -> {trace_dir}")
     return 0

@@ -102,19 +102,16 @@ def sweep_flash(jax, np, rt_ms: float, quick: bool) -> dict:
 def sweep_lm_batch(jax, np, rt_ms: float, size: str, quick: bool) -> dict:
     import jax.numpy as jnp
 
-    from katib_tpu.models.transformer import TransformerConfig
+    from katib_tpu.models.transformer import TransformerConfig, bench_lm_config
     from katib_tpu.parallel.mesh import make_mesh
     from katib_tpu.parallel.train import make_lm_train_step
     from katib_tpu.utils.timing import host_sync
 
+    cfg, _, seq, _ = bench_lm_config(size, on_tpu=True)
     if size == "large":
-        cfg = dict(vocab_size=32768, embed_dim=1024, num_layers=8, num_heads=16,
-                   max_seq_len=2048, dtype=jnp.bfloat16)
-        seq, batches = 2048, ((2, 4) if quick else (2, 4, 8))
+        batches = (2, 4) if quick else (2, 4, 8)
     else:
-        cfg = dict(vocab_size=8192, embed_dim=512, num_layers=4, num_heads=8,
-                   max_seq_len=1024, dtype=jnp.bfloat16)
-        seq, batches = 1024, ((8, 16) if quick else (4, 8, 16))
+        batches = (8, 16) if quick else (4, 8, 16)
 
     config = TransformerConfig(**cfg)
     mesh = make_mesh(jax.devices()[:1])
