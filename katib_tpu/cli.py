@@ -5,11 +5,15 @@ Terminal-first replacement for the reference's Web-UI backend REST surface
 fetch_hp_job_info, fetch_trial_logs). Subcommands:
 
   run <spec.json>          create an experiment from a JSON spec and drive it
+  resume <name>            resume a persisted experiment in a fresh controller
   list                     list experiments in a state root
   status <name>            experiment status + trial buckets + optimal trial
   trials <name>            per-trial table (the fetch_hp_job_info view)
+  importance <name>        correlation-based parameter-importance table
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
+  ui                       serve the web dashboard + REST API
+  serve                    run the suggestion/early-stopping/db-manager service
 
 Experiments with in-process entry points use trialTemplate.entryPoint
 ("module:function"); arbitrary subprocess commands work via
@@ -106,6 +110,34 @@ def cmd_trials(args) -> int:
             metric = f"{m.name}={m.latest}"
         rows.append((t.name, t.condition.value, json.dumps(t.assignments_dict()), metric))
     _table(["TRIAL", "STATUS", "ASSIGNMENTS", "METRIC"], rows)
+    return 0
+
+
+def cmd_importance(args) -> int:
+    from .ui.server import parameter_importance
+
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    exp = ctrl.state.get_experiment(args.name)
+    if exp is None:
+        print(f"experiment {args.name!r} not found", file=sys.stderr)
+        return 1
+    out = parameter_importance(exp, ctrl.state.list_trials(args.name))
+    if not out["importance"]:
+        if out["n"] < 3:
+            print(f"no importance available ({out['n']} completed rankable trials; need >= 3)")
+        else:
+            print(f"no importance available: none of the parameters were scorable "
+                  f"over the {out['n']} completed trials (non-numeric or "
+                  "constant values)")
+        return 0
+    rows = [
+        (r["parameter"], f"{r['importance']:.4f}", r["method"], str(r["n"]))
+        for r in out["importance"]
+    ]
+    _table(["PARAMETER", "IMPORTANCE", "METHOD", "N"], rows)
+    print(f"(correlation-based screen over {out['n']} completed trials, "
+          "not a causal claim)")
     return 0
 
 
@@ -228,6 +260,10 @@ def main(argv=None) -> int:
     tr = sub.add_parser("trials", help="trial table for an experiment")
     tr.add_argument("name")
     tr.set_defaults(fn=cmd_trials)
+
+    im = sub.add_parser("importance", help="parameter-importance table for an experiment")
+    im.add_argument("name")
+    im.set_defaults(fn=cmd_importance)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")
     me.add_argument("trial")

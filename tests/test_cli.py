@@ -74,6 +74,13 @@ def test_cli_full_lifecycle(spec_path, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "hyperband" in out and "medianstop" in out
 
+    assert main(["--root", root, "importance", "cli-e2e"]) == 0
+    out = capsys.readouterr().out
+    # loss == lr exactly, so |pearson| == 1 over the 3 completed trials
+    assert "lr" in out and "abs_pearson" in out and "1.0000" in out
+
+    assert main(["--root", root, "importance", "no-such-exp"]) == 1
+
 
 def test_cli_resume(tmp_path, capsys):
     """`katib-tpu resume <name>` finishes a persisted experiment in a fresh
