@@ -148,7 +148,8 @@ def _make_a_tx(weight_decay, lr):
 
 @functools.lru_cache(maxsize=16)
 def _compiled_search_step(model: "DartsSupernet", total_steps: int,
-                          w_lr_min: float, w_grad_clip: float):
+                          w_lr_min: float, w_grad_clip: float,
+                          hessian_mode: str = "jvp"):
     """ONE jitted bilevel step per static configuration, shared across
     DartsSearch instances (flax Modules are frozen dataclasses — hashable
     cache keys). Every trial of an HPO sweep reuses the same Python
@@ -186,6 +187,7 @@ def _compiled_search_step(model: "DartsSupernet", total_steps: int,
             xi,
             hyper["w_momentum"],
             hyper["w_weight_decay"],
+            hessian_mode=hessian_mode,
         )
         a_updates, a_opt_state = a_tx.update(dalpha, a_opt_state, alphas)
         alphas = optax.apply_updates(alphas, a_updates)
@@ -245,6 +247,15 @@ class DartsSearch:
         # a full-length run instead of paying a fresh multi-minute XLA
         # compile for a different schedule constant.
         self.schedule_horizon = int(s.get("schedule_horizon", 0) or 0)
+        # "jvp" (exact, default) | "fd" (reference central-difference parity).
+        # Normalize + fail fast here: HPO assignments bypass the suggester's
+        # validate_algorithm_settings, and a bad value would otherwise only
+        # raise at the first jitted step, after dataset load and model init.
+        self.hessian_mode = str(s.get("hessian_mode", "jvp") or "jvp").strip().lower()
+        if self.hessian_mode not in ("jvp", "fd"):
+            raise ValueError(
+                f"hessian_mode must be 'jvp' or 'fd', got {s.get('hessian_mode')!r}"
+            )
         # settings arrive as strings from HPO assignments: explicit opt-in
         remat = str(s.get("remat_cells", "")).strip().lower() in ("1", "true", "yes", "on")
 
@@ -312,7 +323,8 @@ class DartsSearch:
             )
 
         self._search_step = _compiled_search_step(
-            self.model, self.total_steps, self.w_lr_min, self.w_grad_clip
+            self.model, self.total_steps, self.w_lr_min, self.w_grad_clip,
+            self.hessian_mode,
         )
         self._eval_step = _compiled_eval_step(self.model)
         self._built = True

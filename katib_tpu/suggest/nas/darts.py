@@ -86,6 +86,10 @@ class Darts(Suggester):
                 if name in {"init_channels", "print_step", "num_nodes", "stem_multiplier"}:
                     if not int(value) >= 1:
                         raise ValueError(f"{name} should be >= 1")
+                # beyond-reference: exact-jvp vs reference central-difference
+                # architect (models/darts_trainer.py architect_alpha_grad)
+                if name == "hessian_mode" and value not in ("jvp", "fd"):
+                    raise ValueError("hessian_mode should be 'jvp' or 'fd'")
             except ValueError:
                 raise
             except Exception as e:

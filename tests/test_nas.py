@@ -497,3 +497,28 @@ class TestDartsSecondOrderExact:
                 xi=0.025, w_momentum=0.9, w_weight_decay=3e-4,
                 hessian_mode="bogus",
             )
+
+
+class TestDartsHessianModeSetting:
+    def test_setting_flows_to_search_and_validates(self):
+        from katib_tpu.models.darts_trainer import DartsSearch
+        from katib_tpu.suggest.base import create
+
+        s = DartsSearch(("skip_connection", "max_pooling_3x3"), num_layers=2,
+                        settings={"hessian_mode": "fd"})
+        assert s.hessian_mode == "fd"
+        assert DartsSearch(("skip_connection",), num_layers=2).hessian_mode == "jvp"
+        # normalized + fail-fast at construction (HPO assignments bypass the
+        # suggester-side validation)
+        up = DartsSearch(("skip_connection",), num_layers=2,
+                         settings={"hessian_mode": " FD "})
+        assert up.hessian_mode == "fd"
+        with pytest.raises(ValueError, match="hessian_mode"):
+            DartsSearch(("skip_connection",), num_layers=2,
+                        settings={"hessian_mode": "jpv"})
+
+        darts = create("darts")
+        spec = nas_experiment("darts", enas_nas_config(),
+                              settings={"hessian_mode": "bogus"})
+        with pytest.raises(ValueError, match="hessian_mode"):
+            darts.validate_algorithm_settings(spec)
