@@ -87,9 +87,13 @@ class Darts(Suggester):
                     if not int(value) >= 1:
                         raise ValueError(f"{name} should be >= 1")
                 # beyond-reference: exact-jvp vs reference central-difference
-                # architect (models/darts_trainer.py architect_alpha_grad)
-                if name == "hessian_mode" and value not in ("jvp", "fd"):
-                    raise ValueError("hessian_mode should be 'jvp' or 'fd'")
+                # architect (models/darts_trainer.py architect_alpha_grad).
+                # Same normalization as DartsSearch.__init__ so admission
+                # never rejects a value the trainer would accept ('FD',
+                # ' jvp ', and the 'None'→default sentinel all run fine).
+                if name == "hessian_mode" and value != "None":
+                    if str(value).strip().lower() not in ("jvp", "fd"):
+                        raise ValueError("hessian_mode should be 'jvp' or 'fd'")
             except ValueError:
                 raise
             except Exception as e:

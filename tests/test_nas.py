@@ -522,3 +522,9 @@ class TestDartsHessianModeSetting:
                               settings={"hessian_mode": "bogus"})
         with pytest.raises(ValueError, match="hessian_mode"):
             darts.validate_algorithm_settings(spec)
+        # admission accepts exactly what the trainer accepts: normalized
+        # forms and the 'None'->default sentinel
+        for ok in (" FD ", "JVP", "None"):
+            darts.validate_algorithm_settings(
+                nas_experiment("darts", enas_nas_config(),
+                               settings={"hessian_mode": ok}))
