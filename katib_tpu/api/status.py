@@ -152,6 +152,17 @@ class Trial:
     def is_early_stopped(self) -> bool:
         return self.condition == TrialCondition.EARLY_STOPPED
 
+    @property
+    def current_reason(self) -> str:
+        """Reason of the CURRENT condition. Not ``conditions[-1]`` — the
+        _update_conditions append-or-replace semantics update a recurring
+        type (e.g. Pending after a restart requeue) in place, so the last
+        list entry can be a stale different-type condition."""
+        for c in self.conditions:
+            if c.type == self.condition.value:
+                return c.reason
+        return ""
+
     def set_condition(self, cond: TrialCondition, reason: str = "", message: str = "") -> None:
         self.condition = cond
         _update_conditions(self.conditions, Condition(type=cond.value, reason=reason, message=message))

@@ -635,7 +635,7 @@ class TrialScheduler:
             warning = trial.condition in (TrialCondition.FAILED, TrialCondition.METRICS_UNAVAILABLE)
             self.recorder.event(
                 exp.name, "Trial", trial.name,
-                trial.conditions[-1].reason if trial.conditions else trial.condition.value,
+                trial.current_reason or trial.condition.value,
                 trial.message, warning=warning,
             )
         # retainRun semantics (trial_controller.go:297 deletes the finished

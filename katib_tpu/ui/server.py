@@ -121,7 +121,9 @@ async function sel(n){
  const checked=new Set([...document.querySelectorAll('.cmpsel:checked')].map(c=>c.dataset.trial));
  document.getElementById('trials').innerHTML=table(ts.map((t,i)=>({
   sel:`<input type="checkbox" class="cmpsel" data-trial="${esc(t.name)}"${checked.has(t.name)?' checked':''}>`,
-  trial:esc(t.name),status:esc(t.condition),status_cls:t.condition,
+  trial:esc(t.name),
+  status:esc(t.condition)+(t.reason&&t.reason!=='Trial'+t.condition?` <span class="muted">(${esc(t.reason)})</span>`:''),
+  status_cls:t.condition,
   assignments:`<code>${esc(JSON.stringify(t.assignments))}</code>`,
   metric:esc(t.objective??''),curve:spark(curves[i]),
   logs:`<a href="#" class="loglink" data-exp="${esc(n)}" data-trial="${esc(t.name)}">logs</a>`})),
@@ -485,6 +487,11 @@ class _Handler(BaseHTTPRequestHandler):
                             {
                                 "name": t.name,
                                 "condition": t.condition.value,
+                                # the CURRENT condition's reason (not
+                                # conditions[-1] — recurring types update in
+                                # place): distinguishes DuplicateResultReused
+                                # / SchedulerShutdown at a glance
+                                "reason": t.current_reason,
                                 "assignments": t.assignments_dict(),
                                 "objective": obj,
                                 "labels": t.labels,

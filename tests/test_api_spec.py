@@ -229,3 +229,20 @@ class TestRoundTrip:
         assert again.is_succeeded
         assert again.assignments_dict() == {"lr": "0.05"}
         assert again.start_time is not None and again.completion_time is not None
+
+
+def test_trial_current_reason_tracks_recurring_conditions():
+    """conditions[-1] is NOT the current condition after a recurring type
+    updates in place (restart requeue: Pending -> Running -> Pending again
+    leaves Running last in the list); current_reason must follow the
+    condition the trial is actually in."""
+    from katib_tpu.api.status import Trial, TrialCondition
+
+    t = Trial(name="t", experiment_name="e")
+    t.set_condition(TrialCondition.PENDING, "TrialPending", "waiting")
+    t.set_condition(TrialCondition.RUNNING, "TrialRunning", "running")
+    t.set_condition(TrialCondition.PENDING, "TrialRestarting", "requeued")
+    assert t.conditions[-1].type == "Running"  # the in-place update artifact
+    assert t.current_reason == "TrialRestarting"
+    t.set_condition(TrialCondition.SUCCEEDED, "DuplicateResultReused", "reused")
+    assert t.current_reason == "DuplicateResultReused"

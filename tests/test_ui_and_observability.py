@@ -71,6 +71,7 @@ class TestUIServer:
         trials = json.loads(body)
         assert len(trials) == 3
         assert all(t["condition"] == "Succeeded" for t in trials)
+        assert all(t["reason"] == "TrialSucceeded" for t in trials)
         assert all("x" in t["assignments"] for t in trials)
 
     def test_trial_metrics(self, stack):
