@@ -108,8 +108,9 @@ def cmd_trials(args) -> int:
         if t.observation and t.observation.metrics:
             m = t.observation.metrics[0]
             metric = f"{m.name}={m.latest}"
-        rows.append((t.name, t.condition.value, json.dumps(t.assignments_dict()), metric))
-    _table(["TRIAL", "STATUS", "ASSIGNMENTS", "METRIC"], rows)
+        rows.append((t.name, t.condition.value, t.current_reason,
+                     json.dumps(t.assignments_dict()), metric))
+    _table(["TRIAL", "STATUS", "REASON", "ASSIGNMENTS", "METRIC"], rows)
     return 0
 
 
