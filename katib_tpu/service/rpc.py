@@ -130,12 +130,25 @@ class ApiServicer:
     # -- DBManager service (api.proto:13-31) ---------------------------------
 
     def report_observation_log(self, payload: Dict) -> Dict:
+        # Idempotent receiver: the client retries UNAVAILABLE (reference
+        # 10×/3s policy), and a server that committed the write but died
+        # before responding would otherwise double-append the same rows on
+        # the retry. At-least-once delivery + exact-duplicate drop here =
+        # effectively-once; (timestamp, metric, value) triples are unique
+        # for genuine observations (collectors stamp scrape/log time).
         assert self.store is not None
+        trial = payload["trialName"]
+        existing = {
+            (r.timestamp, r.metric_name, r.value)
+            for r in self.store.get_observation_log(trial)
+        }
         logs = [
             MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
             for l in payload.get("metricLogs", [])
         ]
-        self.store.report_observation_log(payload["trialName"], logs)
+        fresh = [l for l in logs if (l.timestamp, l.metric_name, l.value) not in existing]
+        if fresh:
+            self.store.report_observation_log(trial, fresh)
         return {}
 
     def get_observation_log(self, payload: Dict) -> Dict:
@@ -224,19 +237,57 @@ def serve(
     return server
 
 
-class ApiClient:
-    """JSON-bytes client for the service above."""
+# The reference retries every suggestion-client RPC 10 times on a 3s period
+# (pkg/controller.v1beta1/consts/const.go:88-91 DefaultGRPCRetryAttempts /
+# DefaultGRPCRetryPeriod, wired via grpc_retry in suggestionclient.go:57-61).
+DEFAULT_RETRY_ATTEMPTS = 10
+DEFAULT_RETRY_PERIOD_S = 3.0
 
-    def __init__(self, address: str = f"localhost:{DEFAULT_PORT}", timeout: float = 60.0):
+_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
+
+
+class ApiClient:
+    """JSON-bytes client for the service above.
+
+    Retry semantics match the reference's grpc_retry interceptor: up to
+    ``retries`` attempts spaced ``retry_period`` apart, retrying only on
+    UNAVAILABLE (server down/restarting). gRPC Python does NOT retry by
+    default — and its in-channel retryPolicy hard-caps maxAttempts at 5 —
+    so the 10×/3s reference policy is an explicit loop here, not channel
+    config. Non-retryable codes (e.g. INVALID_ARGUMENT from validation)
+    propagate immediately.
+    """
+
+    def __init__(
+        self,
+        address: str = f"localhost:{DEFAULT_PORT}",
+        timeout: float = 60.0,
+        retries: int = DEFAULT_RETRY_ATTEMPTS,
+        retry_period: float = DEFAULT_RETRY_PERIOD_S,
+    ):
         self.channel = grpc.insecure_channel(address)
         self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.retry_period = retry_period
 
     def _call(self, method: str, payload: Dict) -> Dict:
+        import time
+
         rpc = self.channel.unary_unary(
             f"/{SERVICE}/{method}", request_serializer=_ident, response_deserializer=_ident
         )
-        out = rpc(_json_bytes(payload), timeout=self.timeout)
-        return json.loads(out.decode()) if out else {}
+        data = _json_bytes(payload)
+        last_err: Optional[grpc.RpcError] = None
+        for attempt in range(self.retries):
+            try:
+                out = rpc(data, timeout=self.timeout)
+                return json.loads(out.decode()) if out else {}
+            except grpc.RpcError as e:
+                if e.code() not in _RETRYABLE or attempt == self.retries - 1:
+                    raise
+                last_err = e
+                time.sleep(self.retry_period)
+        raise last_err  # unreachable; loop either returns or raises
 
     def close(self) -> None:
         self.channel.close()
@@ -245,13 +296,20 @@ class ApiClient:
 class RemoteSuggester(Suggester):
     """Suggester backed by a remote service — lets the controller use
     out-of-process algorithms exactly like the reference's per-experiment
-    suggestion pods (grpc retry: consts/const.go:88-91 is mirrored by the
-    channel's default retry on UNAVAILABLE)."""
+    suggestion pods. The 10×/3s UNAVAILABLE retry from
+    consts/const.go:88-91 lives in ApiClient._call, so a suggester that is
+    restarting mid-experiment is retried instead of failing the reconcile."""
 
     name = "remote"
 
-    def __init__(self, address: str, timeout: float = 60.0):
-        self.client = ApiClient(address, timeout=timeout)
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 60.0,
+        retries: int = DEFAULT_RETRY_ATTEMPTS,
+        retry_period: float = DEFAULT_RETRY_PERIOD_S,
+    ):
+        self.client = ApiClient(address, timeout=timeout, retries=retries, retry_period=retry_period)
 
     def get_suggestions(self, request: SuggestionRequest):
         from ..suggest.base import SuggestionReply
@@ -286,8 +344,16 @@ class RemoteObservationStore(ObservationStore):
     """ObservationStore backed by the remote DBManager — what a trial pod on
     another host uses to push metrics (api/report_metrics.py push mode)."""
 
-    def __init__(self, address: str, timeout: float = 30.0):
-        self.client = ApiClient(address, timeout=timeout)
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        retries: int = DEFAULT_RETRY_ATTEMPTS,
+        retry_period: float = DEFAULT_RETRY_PERIOD_S,
+    ):
+        self.client = ApiClient(
+            address, timeout=timeout, retries=retries, retry_period=retry_period
+        )
 
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
         self.client._call(

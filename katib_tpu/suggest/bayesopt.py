@@ -4,19 +4,34 @@ Capability match for the reference's skopt service
 (pkg/suggestion/v1beta1/skopt/base_service.py:25-141: Optimizer with
 base_estimator="GP", n_initial_points, acq_func) without the scikit-optimize
 dependency. GP regression with a Matérn-5/2 kernel over the unit cube, fitted
-by Cholesky (O(n^3) in completed trials, n is tens-to-hundreds here), and an
-expected-improvement acquisition maximized over a quasi-random candidate batch
-— all dense numpy linear algebra.
+by Cholesky (O(n^3) in completed trials, n is tens-to-hundreds here), with
+kernel hyperparameters (length-scale × noise) selected by marginal-likelihood
+grid search per fit — the capability analogue of skopt's GP, which optimizes
+kernel params by MLE on every tell. Acquisition is maximized over a
+quasi-random candidate batch — all dense numpy linear algebra.
 
 Settings (mirroring skopt service.py validation):
   base_estimator (only "GP"), n_initial_points (default 10),
-  acq_func ("ei" | "pi" | "lcb", default "ei"), random_state.
+  acq_func ("gp_hedge" | "ei" | "pi" | "lcb", default "gp_hedge" — the
+  reference skopt default, base_service.py:33), random_state,
+  length_scale (optional: pin the kernel length-scale, disabling MLE —
+  used by the convergence A/B tests).
+
+gp_hedge is a portfolio over EI/PI/LCB with multiplicative-weights gains
+(Hoffman et al. 2011, as in skopt): each call computes every portfolio
+member's candidate, picks one by softmax over gains, and labels the trial
+with the member that produced it. skopt accumulates gains in optimizer
+state (``gains_ -= est.predict(next_xs_)``); this suggester is
+stateless-per-call, so gains are reconstructed from history instead: for
+every completed trial the *current* GP's predicted mean at that trial's x
+is credited to the member that proposed it (label ``bo-acq``). Same
+full-refit predicted-value reward, no RNG replay required.
 """
 
 from __future__ import annotations
 
 import math
-from typing import List
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 from scipy.linalg import cho_factor, cho_solve
@@ -25,6 +40,13 @@ from scipy.stats import norm
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
 from ..api.spec import TrialAssignment
 from .internal.search_space import MIN_GOAL
+
+ACQ_LABEL = "bo-acq"
+PORTFOLIO = ("ei", "pi", "lcb")
+
+# Marginal-likelihood grid (unit-cube inputs, standardized targets).
+_LENGTH_GRID = (0.05, 0.1, 0.2, 0.35, 0.6, 1.0)
+_NOISE_GRID = (1e-6, 1e-4, 1e-2)
 
 
 def _matern52(a: np.ndarray, b: np.ndarray, length: float) -> np.ndarray:
@@ -42,9 +64,37 @@ class _GP:
         self.y_std = ys.std() + 1e-12
         self.ys = (ys - self.y_mean) / self.y_std
         self.length = length
+        self.noise = noise
         K = _matern52(xs, xs, length) + noise * np.eye(len(xs))
         self.chol = cho_factor(K, lower=True)
         self.alpha = cho_solve(self.chol, self.ys)
+
+    def log_marginal_likelihood(self) -> float:
+        n = len(self.ys)
+        log_det = 2.0 * np.log(np.diag(self.chol[0])).sum()
+        return float(-0.5 * self.ys @ self.alpha - 0.5 * log_det - 0.5 * n * math.log(2 * math.pi))
+
+    @classmethod
+    def fit_mle(cls, xs: np.ndarray, ys: np.ndarray) -> "_GP":
+        """Grid-search length-scale × noise by log marginal likelihood.
+
+        The reference's skopt GP re-optimizes its kernel on every tell
+        (skopt Optimizer -> sklearn GaussianProcessRegressor L-BFGS MLE);
+        a coarse grid gives the same adaptivity at a fraction of the cost
+        and with no optimizer-failure modes at tiny n.
+        """
+        best: Optional[_GP] = None
+        best_lml = -np.inf
+        for length in _LENGTH_GRID:
+            for noise in _NOISE_GRID:
+                try:
+                    gp = cls(xs, ys, length=length, noise=noise)
+                except np.linalg.LinAlgError:
+                    continue
+                lml = gp.log_marginal_likelihood()
+                if lml > best_lml:
+                    best, best_lml = gp, lml
+        return best if best is not None else cls(xs, ys)
 
     def predict(self, cands: np.ndarray):
         Ks = _matern52(cands, self.xs, self.length)  # [m, n]
@@ -52,6 +102,17 @@ class _GP:
         v = cho_solve(self.chol, Ks.T)  # [n, m]
         var = np.maximum(1.0 - (Ks * v.T).sum(axis=1), 1e-12)
         return mu * self.y_std + self.y_mean, np.sqrt(var) * self.y_std
+
+
+def _acq_scores(acq: str, mu: np.ndarray, sigma: np.ndarray, y_best: float) -> np.ndarray:
+    """Higher is better; inputs are in minimization orientation."""
+    if acq == "lcb":
+        return -(mu - 1.96 * sigma)  # minimize LCB -> maximize negative
+    imp = y_best - mu  # improvement for minimization
+    z = imp / sigma
+    if acq == "pi":
+        return norm.cdf(z)
+    return imp * norm.cdf(z) + sigma * norm.pdf(z)  # ei
 
 
 @register
@@ -64,16 +125,17 @@ class BayesianOptimization(Suggester):
             raise ValueError("only base_estimator=GP is supported")
         if "n_initial_points" in s and int(s["n_initial_points"]) < 1:
             raise ValueError("n_initial_points must be >= 1")
-        if s.get("acq_func", "ei") not in ("ei", "pi", "lcb", "gp_hedge"):
+        if s.get("acq_func", "gp_hedge") not in ("ei", "pi", "lcb", "gp_hedge"):
             raise ValueError("acq_func must be one of ei, pi, lcb, gp_hedge")
+        if "length_scale" in s and not (float(s["length_scale"]) > 0):
+            raise ValueError("length_scale must be > 0")
 
     def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
         space = self.search_space(request.experiment)
         s = self.settings(request.experiment)
         n_initial = int(s.get("n_initial_points", 10))
-        acq = s.get("acq_func", "ei")
-        if acq == "gp_hedge":
-            acq = "ei"
+        acq = s.get("acq_func", "gp_hedge")
+        fixed_length = float(s["length_scale"]) if "length_scale" in s else None
         seed = self.seed_from(request.experiment, salt=len(request.trials))
         rng = np.random.default_rng(seed)
         minimize = space.goal == MIN_GOAL
@@ -84,13 +146,42 @@ class BayesianOptimization(Suggester):
         ys = np.array([t.objective for t in history], dtype=np.float64)
         if not minimize:
             ys = -ys
+        acq_labels = [t.labels.get(ACQ_LABEL) for t in history]
+
+        n_real = len(ys)
+
+        # Select kernel hyperparameters once per call, on the real history —
+        # liar rows barely move the marginal-likelihood optimum, and re-running
+        # the 18-point grid for every batch pick would put 18 O(n^3) fits per
+        # suggestion on the hot path.
+        hypers: Optional[Tuple[float, float]] = None
+        gp_real: Optional[_GP] = None
+        if fixed_length is not None:
+            hypers = (fixed_length, 1e-6)
+        elif n_real >= n_initial:
+            gp_real = _GP.fit_mle(xs, ys)
+            hypers = (gp_real.length, gp_real.noise)
+
+        # Hedge gains come from the pre-batch, real-history-only GP: the
+        # constant-liar rows appended below (y = worst seen) would otherwise
+        # contaminate the posterior AND the evaluation set, punishing the
+        # member whose pick the lie was attached to. Gains are therefore
+        # fixed across the batch, like skopt's (which updates only on tell).
+        gains: Optional[np.ndarray] = None
+        if acq == "gp_hedge" and hypers is not None and n_real >= n_initial:
+            if gp_real is None:
+                gp_real = _GP(xs, ys, length=hypers[0], noise=hypers[1])
+            gains = self.hedge_gains(gp_real, xs, acq_labels)
 
         assignments: List[TrialAssignment] = []
         for _ in range(request.current_request_number):
+            labels: Dict[str, str] = {}
             if len(ys) < n_initial:
                 u = space.sample_uniform(rng, 1)[0]
             else:
-                u = self._acquire(xs, ys, space, rng, acq)
+                u, chosen = self._acquire(xs, ys, space, rng, acq, hypers, gains)
+                if chosen is not None:
+                    labels[ACQ_LABEL] = chosen
                 # constant liar for batch diversity
                 xs = np.vstack([xs, u[None, :]])
                 ys = np.append(ys, ys.max())
@@ -98,12 +189,42 @@ class BayesianOptimization(Suggester):
                 TrialAssignment(
                     name=self.make_trial_name(request.experiment),
                     parameter_assignments=space.decode(u),
+                    labels=labels,
                 )
             )
         return SuggestionReply(assignments=assignments)
 
-    def _acquire(self, xs, ys, space, rng, acq: str) -> np.ndarray:
-        gp = _GP(xs, ys)
+    @staticmethod
+    def hedge_gains(gp: "_GP", xs: np.ndarray, acq_labels: List[Optional[str]]) -> np.ndarray:
+        """Gains per portfolio member from the current GP's predicted means.
+
+        Predicted value (not the noisy observation) at each member's past
+        proposals, negated so lower predicted objective = higher gain —
+        skopt's ``gains_ -= est.predict(...)`` rule re-derived statelessly.
+        Predictions are standardized by the GP's own scale so gains are
+        objective-magnitude invariant.
+        """
+        gains = np.zeros(len(PORTFOLIO))
+        if len(xs) == 0:
+            return gains
+        mu, _ = gp.predict(xs)
+        mu_z = (mu - gp.y_mean) / gp.y_std
+        for x_mu, label in zip(mu_z, acq_labels):
+            if label in PORTFOLIO:
+                gains[PORTFOLIO.index(label)] -= x_mu
+        return gains
+
+    def _acquire(
+        self,
+        xs: np.ndarray,
+        ys: np.ndarray,
+        space,
+        rng,
+        acq: str,
+        hypers: Tuple[float, float],
+        gains: Optional[np.ndarray],
+    ) -> Tuple[np.ndarray, Optional[str]]:
+        gp = _GP(xs, ys, length=hypers[0], noise=hypers[1])
         n_cand = max(512, 64 * len(space))
         cands = space.sample_uniform(rng, n_cand)
         # include jittered copies of the best points (local exploitation)
@@ -116,13 +237,20 @@ class BayesianOptimization(Suggester):
         cands = np.vstack([cands, local])
         mu, sigma = gp.predict(cands)
         y_best = ys.min()
-        if acq == "lcb":
-            score = -(mu - 1.96 * sigma)  # minimize LCB -> maximize negative
-        else:
-            imp = y_best - mu  # improvement for minimization
-            z = imp / sigma
-            if acq == "pi":
-                score = norm.cdf(z)
-            else:  # ei
-                score = imp * norm.cdf(z) + sigma * norm.pdf(z)
-        return cands[int(np.argmax(score))]
+
+        if acq != "gp_hedge":
+            score = _acq_scores(acq, mu, sigma, y_best)
+            return cands[int(np.argmax(score))], acq
+
+        # Portfolio: every member nominates its argmax; softmax over the
+        # caller-supplied gains (computed once, real history only) picks the
+        # member whose nominations have been predicted best.
+        if gains is None:
+            gains = np.zeros(len(PORTFOLIO))
+        nominations = [
+            cands[int(np.argmax(_acq_scores(a, mu, sigma, y_best)))] for a in PORTFOLIO
+        ]
+        logits = gains - gains.max()
+        probs = np.exp(logits) / np.exp(logits).sum()
+        idx = int(rng.choice(len(PORTFOLIO), p=probs))
+        return nominations[idx], PORTFOLIO[idx]

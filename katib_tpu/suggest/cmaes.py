@@ -14,7 +14,22 @@ Numeric (int/double) parameters only, >= 2 dimensions — mirroring the optuna
 service's cmaes validation (service.py).
 
 Settings: sigma (initial step, default 0.3), popsize (default 4+floor(3 ln D)),
-restart_strategy (accepted, only "none"), random_state.
+restart_strategy ("none" | "ipop" | "bipop", default none — honored, matching
+optuna's RestartStrategy plumbing at pkg/suggestion/v1beta1/optuna/service.py:85-95),
+random_state.
+
+Restarts in the replay model: stagnation is detected while folding completed
+generations (no improvement in generation-best > tolfun for a standard
+stall window, or step-size collapse). On trigger the strategy state is
+re-initialized at a seed-derived random mean — ``ipop`` doubles popsize each
+restart (optuna inc_popsize=2); ``bipop`` alternates between a doubling
+"large" regime and a baseline-popsize "small" regime, picking whichever has
+consumed less evaluation budget (the BIPOP rule). Restart decisions depend
+only on folded history + the experiment seed, so every call reconstructs
+the identical restart sequence. The current popsize/restart count are
+surfaced through the settings-feedback channel (SuggestionReply
+.algorithm_settings), the same mechanism the reference uses for hyperband
+state.
 """
 
 from __future__ import annotations
@@ -30,6 +45,14 @@ from ..api.spec import TrialAssignment
 from .internal.search_space import MIN_GOAL, SearchSpace
 
 GENERATION_LABEL = "cmaes-generation"
+
+# Stagnation tolerance for restart detection (cmaes package tolfun analogue).
+TOLFUN = 1e-12
+
+
+def stall_generations(dim: int, popsize: int) -> int:
+    """Standard CMA-ES stagnation window: 10 + 30·D/λ generations."""
+    return 10 + int(30 * dim / popsize)
 
 
 @dataclass
@@ -146,50 +169,92 @@ class CMAES(Suggester):
         space = self.search_space(request.experiment)
         s = self.settings(request.experiment)
         dim = len(space)
-        popsize = int(s.get("popsize", 4 + int(3 * math.log(max(dim, 1)))))
+        popsize0 = int(s.get("popsize", 4 + int(3 * math.log(max(dim, 1)))))
         sigma0 = float(s.get("sigma", 0.3))
+        strategy = s.get("restart_strategy", "none")
         seed = self.seed_from(request.experiment, salt=len(request.trials))
         rng = np.random.default_rng(seed)
         minimize = space.goal == MIN_GOAL
 
+        popsize = popsize0
         state = _CmaState.fresh(dim, popsize, sigma0)
 
         # Replay completed generations in order.
         by_gen: Dict[int, List] = {}
         created_by_gen: Dict[int, int] = {}
+        terminal_by_gen: Dict[int, int] = {}
         for t in request.trials:
             g = t.labels.get(GENERATION_LABEL)
             if g is None:
                 continue
             created_by_gen[int(g)] = created_by_gen.get(int(g), 0) + 1
+            if t.is_terminal:
+                terminal_by_gen[int(g)] = terminal_by_gen.get(int(g), 0) + 1
         for t in self.history(request):
             g = t.labels.get(GENERATION_LABEL)
             if g is None or t.objective is None:
                 continue
             by_gen.setdefault(int(g), []).append(t)
 
+        # Restart bookkeeping (deterministic from folded history + seed).
+        restarts = 0
+        large_restarts = 0
+        gen_best: List[float] = []  # best internal fitness per folded gen since last restart
+        evals_large = 0  # bipop budgets
+        evals_small = 0
+        in_large = True
+
+        def restart() -> None:
+            nonlocal state, popsize, restarts, gen_best, in_large, large_restarts
+            restarts += 1
+            if strategy == "ipop":
+                popsize *= 2
+            elif strategy == "bipop":
+                # BIPOP: run whichever regime has consumed less budget; the
+                # large regime doubles per large restart, the small regime
+                # re-runs at the baseline popsize.
+                in_large = evals_large <= evals_small
+                if in_large:
+                    large_restarts += 1
+                popsize = popsize0 * (2 ** large_restarts if in_large else 1)
+            # Fresh mean at a seed-derived point, independent of call-time
+            # trial count, so every future call replays the same restart.
+            r_rng = np.random.default_rng(
+                self.restart_seed(request.experiment, restarts)
+            )
+            state = _CmaState.fresh(dim, popsize, sigma0)
+            state.mean = r_rng.uniform(0.0, 1.0, dim)
+            gen_best = []
+
         gen = 0
         while True:
             created = created_by_gen.get(gen, 0)
             done = by_gen.get(gen, [])
-            # A generation folds into the state once popsize of its trials have
-            # completed (failed/killed trials never complete, so also fold when
-            # every created trial in a full generation is terminal).
-            terminal_in_gen = sum(
-                1
-                for t in request.trials
-                if t.labels.get(GENERATION_LABEL) == str(gen) and t.is_terminal
-            )
-            if created >= popsize and (len(done) >= popsize or terminal_in_gen >= created):
+            # A generation folds into the state once every one of its created
+            # trials is terminal (completed/failed/killed). Folding on the
+            # full created set — not the first popsize completions — keeps the
+            # folded subset unique no matter when a reconcile observes it: a
+            # generation can hold more than the current popsize trials after a
+            # bipop shrink (or a concurrent-suggest label race), and folding a
+            # call-time-dependent prefix would replay divergent trajectories.
+            if created >= popsize and terminal_by_gen.get(gen, 0) >= created:
                 if done:
                     xs = space.encode_many([t.assignments for t in done])
                     ys = np.array([t.objective for t in done])
                     if not minimize:
                         ys = -ys
                     state.update(xs, ys)
+                    gen_best.append(float(ys.min()))
+                    if strategy == "bipop":
+                        if in_large:
+                            evals_large += len(done)
+                        else:
+                            evals_small += len(done)
                 else:
                     state.generation += 1
                 gen += 1
+                if strategy != "none" and self._stagnated(state, gen_best, dim, popsize):
+                    restart()
             else:
                 break
 
@@ -207,4 +272,42 @@ class CMAES(Suggester):
                     labels={GENERATION_LABEL: str(label_gen)},
                 )
             )
-        return SuggestionReply(assignments=assignments)
+        # Namespaced keys: settings feedback is overlaid onto the experiment's
+        # algorithm settings by the suggestion client, so these must not
+        # collide with the user-facing "popsize" setting (which seeds popsize0).
+        return SuggestionReply(
+            assignments=assignments,
+            algorithm_settings={
+                "cmaes_current_popsize": str(popsize),
+                "cmaes_restarts": str(restarts),
+            },
+        )
+
+    @classmethod
+    def restart_seed(cls, experiment, restarts: int) -> int:
+        """Deterministic seed for restart #N's fresh mean. Unlike the sampling
+        rng (salted by call-time trial count), this must reconstruct
+        identically on every future call — and seed_from is None when
+        random_state is unset, which would entropy-seed the rng and corrupt
+        the replayed trajectory; fall back to a name-derived seed instead."""
+        base = cls.seed_from(experiment, salt=0)
+        if base is None:
+            import hashlib
+
+            base = int.from_bytes(
+                hashlib.blake2b(experiment.name.encode(), digest_size=4).digest(), "big"
+            )
+        return base + 100_000 + restarts
+
+    @staticmethod
+    def _stagnated(state: _CmaState, gen_best: List[float], dim: int, popsize: int) -> bool:
+        """Restart triggers: step-size collapse, or no generation-best
+        improvement > TOLFUN across the standard stall window."""
+        if state.sigma <= 1e-8:
+            return True
+        stall = stall_generations(dim, popsize)
+        if len(gen_best) <= stall:
+            return False
+        window = gen_best[-stall:]
+        before = min(gen_best[:-stall])
+        return before - min(window) < TOLFUN

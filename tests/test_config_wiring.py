@@ -275,3 +275,75 @@ def test_service_address_runs_experiment_out_of_process(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_experiment_survives_suggester_restart(tmp_path):
+    """Kill the out-of-process suggester after experiment creation and bring
+    it back mid-run: the ApiClient's 10×/3s UNAVAILABLE retry (reference
+    consts/const.go:88-91) must carry the first reconcile's GetSuggestions
+    through the outage instead of failing the experiment."""
+    import socket
+    import subprocess
+    import sys
+    import threading
+    from pathlib import Path
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = str(Path(__file__).resolve().parent.parent)
+
+    def launch():
+        return subprocess.Popen(
+            [sys.executable, "-m", "katib_tpu.cli", "--root", str(tmp_path / "svc"),
+             "serve", "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=repo,
+        )
+
+    def wait_up(p):
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if p.poll() is not None:
+                pytest.fail("serve died: " + p.stdout.read().decode(errors="replace")[-800:])
+            with socket.socket() as probe:
+                probe.settimeout(0.5)
+                if probe.connect_ex(("127.0.0.1", port)) == 0:
+                    return
+            time.sleep(0.2)
+        pytest.fail("serve never came up")
+
+    proc = launch()
+    restarted = {}
+    try:
+        wait_up(proc)
+        cfg = KatibConfig(
+            suggestions={"tpe": SuggestionConfig(service_address=f"localhost:{port}")}
+        )
+        c = ExperimentController(root_dir=str(tmp_path / "ctl"), config=cfg)
+        try:
+            c.create_experiment(_spec("restart-tpe", algorithm="tpe", max_trials=4))
+            # validation used the live server; now take it down so the very
+            # first GetSuggestions reconcile hits a dead endpoint...
+            proc.terminate()
+            proc.wait(timeout=10)
+
+            def bring_back():
+                time.sleep(2.0)
+                restarted["proc"] = launch()
+
+            t = threading.Thread(target=bring_back)
+            t.start()
+            try:
+                exp = c.run("restart-tpe", timeout=120)
+            finally:
+                t.join()
+            assert exp.status.is_succeeded
+            trials = c.state.list_trials("restart-tpe")
+            assert len(trials) == 4 and all(t.is_succeeded for t in trials)
+        finally:
+            c.close()
+    finally:
+        for p in (proc, restarted.get("proc")):
+            if p is not None:
+                p.terminate()
+                p.wait(timeout=10)

@@ -79,6 +79,100 @@ class TestRemoteDBManager:
         db.delete_observation_log("rpc-t1")
         assert db.get_observation_log("rpc-t1") == []
 
+    def test_report_is_idempotent_under_retry(self, server):
+        """A retried ReportObservationLog (server died after commit, before
+        response) must not duplicate rows — the receiver drops exact
+        (timestamp, metric, value) duplicates."""
+        address, _ = server
+        db = RemoteObservationStore(address)
+        batch = [MetricLog(1.0, "acc", "0.5"), MetricLog(2.0, "acc", "0.9")]
+        db.report_observation_log("rpc-dup", batch)
+        db.report_observation_log("rpc-dup", batch)  # the retry
+        rows = db.get_observation_log("rpc-dup")
+        assert [(r.timestamp, r.value) for r in rows] == [(1.0, "0.5"), (2.0, "0.9")]
+        # new observations still append
+        db.report_observation_log("rpc-dup", [MetricLog(3.0, "acc", "0.95")])
+        assert len(db.get_observation_log("rpc-dup")) == 3
+
+
+class TestRetryPolicy:
+    """The reference retries suggestion RPCs 10×/3s on UNAVAILABLE
+    (consts/const.go:88-91). gRPC Python does not retry by default, so
+    ApiClient carries an explicit retry loop — these tests pin it."""
+
+    def test_call_survives_server_restart(self):
+        import socket
+        import threading
+        import time
+
+        from katib_tpu.service.rpc import ApiServicer, RemoteSuggester, serve
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        srv = serve(ApiServicer(), port=port)
+        remote = RemoteSuggester(f"127.0.0.1:{port}", retries=30, retry_period=0.3)
+        spec = make_experiment("random", settings={"random_state": 1})
+        assert len(remote.get_suggestions(SuggestionRequest(spec, [], 2)).assignments) == 2
+
+        # kill the service, bring it back on the same port after a beat —
+        # the reference's restarting-suggestion-pod scenario
+        srv.stop(0)
+        restarted = {}
+
+        def bring_back():
+            time.sleep(1.0)
+            deadline = time.time() + 20
+            while True:
+                try:  # the freed port can take a beat to rebind
+                    restarted["srv"] = serve(ApiServicer(), port=port)
+                    return
+                except RuntimeError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.3)
+
+        t = threading.Thread(target=bring_back)
+        t.start()
+        try:
+            reply = remote.get_suggestions(SuggestionRequest(spec, [], 2))
+            assert len(reply.assignments) == 2  # retried through the outage
+        finally:
+            t.join()
+            restarted["srv"].stop(0)
+
+    def test_retries_exhaust_then_raise(self):
+        import socket
+        import time
+
+        import grpc
+
+        from katib_tpu.service.rpc import ApiClient
+
+        with socket.socket() as s:  # nothing ever listens here
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        client = ApiClient(f"127.0.0.1:{port}", timeout=2, retries=3, retry_period=0.1)
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError) as e:
+            client._call("GetSuggestions", {"experiment": {}})
+        assert e.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert time.monotonic() - t0 >= 0.2  # at least 2 sleeps -> it did retry
+
+    def test_invalid_argument_is_not_retried(self, server):
+        import time
+
+        address, _ = server
+        from katib_tpu.service.rpc import RemoteSuggester
+
+        remote = RemoteSuggester(address, retries=10, retry_period=5.0)
+        spec = make_experiment("tpe", settings={"gamma": "7"})
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="gamma"):
+            remote.validate_algorithm_settings(spec)
+        # 10 retries at 5s would take ~45s; non-retryable codes fail fast
+        assert time.monotonic() - t0 < 2.0
+
 
 def test_cli_serve_starts_service(tmp_path):
     """katib-tpu serve runs the gRPC plane standalone; a RemoteSuggester can

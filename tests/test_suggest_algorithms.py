@@ -183,6 +183,132 @@ class TestBayesOpt:
             s.validate_algorithm_settings(
                 make_experiment("bayesianoptimization", settings={"base_estimator": "RF"})
             )
+        with pytest.raises(ValueError):
+            s.validate_algorithm_settings(
+                make_experiment("bayesianoptimization", settings={"length_scale": "-1"})
+            )
+        # the reference skopt default (base_service.py:33) is accepted
+        s.validate_algorithm_settings(
+            make_experiment("bayesianoptimization", settings={"acq_func": "gp_hedge"})
+        )
+
+    def test_gp_hedge_labels_suggestions_with_portfolio_member(self):
+        """gp_hedge (the reference skopt default) tags every post-warmup
+        suggestion with the EI/PI/LCB member that nominated it."""
+        from katib_tpu.suggest.bayesopt import ACQ_LABEL, PORTFOLIO
+
+        spec = make_experiment(
+            "bayesianoptimization",
+            settings={"n_initial_points": 4, "acq_func": "gp_hedge", "random_state": 0},
+            params=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0"))],
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        trials = [
+            completed_trial(f"t{i}", {"x": x}, (x - 0.7) ** 2)
+            for i, x in enumerate(np.linspace(0.05, 0.95, 8))
+        ]
+        reply = create("bayesianoptimization").get_suggestions(
+            SuggestionRequest(spec, trials, 6)
+        )
+        assert len(reply.assignments) == 6
+        for a in reply.assignments:
+            assert a.labels[ACQ_LABEL] in PORTFOLIO
+
+    def test_gp_hedge_gains_favor_better_member(self):
+        """The hedge gains update credits the member whose past proposals the
+        current GP predicts to be better (skopt's gains_ -= predict rule)."""
+        from katib_tpu.suggest.bayesopt import PORTFOLIO, _GP, BayesianOptimization
+
+        rng = np.random.default_rng(0)
+        # EI's proposals landed near the optimum of a 1-d bowl, LCB's far away.
+        xs_good = rng.uniform(0.65, 0.75, 8)
+        xs_bad = rng.uniform(0.0, 0.1, 8)
+        xs = np.concatenate([xs_good, xs_bad])[:, None]
+        ys = (xs[:, 0] - 0.7) ** 2
+        labels = ["ei"] * 8 + ["lcb"] * 8
+        gp = _GP.fit_mle(xs, ys)
+        gains = BayesianOptimization.hedge_gains(gp, xs, labels)
+        assert gains[PORTFOLIO.index("ei")] > gains[PORTFOLIO.index("lcb")]
+        # unlabeled (warmup) trials contribute nothing
+        assert gains[PORTFOLIO.index("pi")] == 0.0
+
+    def test_gp_hedge_gains_exclude_constant_liar_rows(self, monkeypatch):
+        """Regression: batch picks append constant-liar pseudo-trials (y =
+        worst seen); crediting those to the member that proposed them would
+        punish it for the rest of the batch. Gains must see real history only."""
+        from katib_tpu.suggest import bayesopt as bo
+
+        spec = make_experiment(
+            "bayesianoptimization",
+            settings={"n_initial_points": 4, "acq_func": "gp_hedge", "random_state": 0},
+            params=[ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0"))],
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        n_hist = 8
+        trials = [
+            completed_trial(f"t{i}", {"x": x}, (x - 0.7) ** 2)
+            for i, x in enumerate(np.linspace(0.05, 0.95, n_hist))
+        ]
+        seen_lengths = []
+        orig = bo.BayesianOptimization.hedge_gains  # staticmethod -> plain fn
+
+        def spy(gp, xs, labels):
+            seen_lengths.append(len(xs))
+            return orig(gp, xs, labels)
+
+        monkeypatch.setattr(bo.BayesianOptimization, "hedge_gains", staticmethod(spy))
+        create("bayesianoptimization").get_suggestions(SuggestionRequest(spec, trials, 4))
+        # computed once per call, pre-batch, from real rows only — never the
+        # liar-augmented posterior or evaluation set
+        assert seen_lengths == [n_hist]
+
+    def test_mle_adapts_length_scale(self):
+        """The marginal-likelihood grid picks a shorter length for a
+        fast-varying target than for a smooth one (the adaptivity the
+        fixed-0.25 kernel lacked)."""
+        from katib_tpu.suggest.bayesopt import _GP
+
+        xs = np.linspace(0, 1, 40)[:, None]
+        smooth = _GP.fit_mle(xs, xs[:, 0] * 2.0)
+        wiggly = _GP.fit_mle(xs, np.sin(40 * xs[:, 0]))
+        assert wiggly.length < smooth.length
+
+    @pytest.mark.parametrize(
+        "fn",
+        [
+            lambda x, y: (x - 0.6) ** 2 + (y - 0.3) ** 2,  # sphere
+            lambda x, y: 25.0 * (x - 0.6) ** 2 + 0.25 * (y - 0.3) ** 2,  # anisotropic
+        ],
+        ids=["sphere", "anisotropic"],
+    )
+    def test_mle_convergence_matches_or_beats_fixed_kernel(self, fn):
+        """Convergence A/B mandated by round-4 review: MLE-fitted kernel must
+        match or beat the old fixed length=0.25 kernel on sphere + an
+        anisotropic bowl (sequential loop, same seeds)."""
+
+        def run(settings, seed):
+            spec = make_experiment(
+                "bayesianoptimization",
+                settings={"n_initial_points": 6, "random_state": seed, **settings},
+                params=[
+                    ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0")),
+                    ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0")),
+                ],
+                goal_type=ObjectiveType.MINIMIZE,
+            )
+            s = create("bayesianoptimization")
+            trials = []
+            for i in range(24):
+                a = s.get_suggestions(SuggestionRequest(spec, trials, 1)).assignments[0]
+                d = a.assignments_dict()
+                val = fn(float(d["x"]), float(d["y"]))
+                trials.append(completed_trial(a.name, d, val, labels=dict(a.labels)))
+            return min(float(t.observation.metrics[0].latest) for t in trials)
+
+        seeds = [0, 1, 2]
+        mle = np.mean([run({"acq_func": "ei"}, s) for s in seeds])
+        fixed = np.mean([run({"acq_func": "ei", "length_scale": 0.25}, s) for s in seeds])
+        assert mle <= fixed * 1.25 + 1e-3, (mle, fixed)
 
 
 class TestCMAES:
@@ -225,6 +351,141 @@ class TestCMAES:
                 )
             mean_dist.append(np.mean([math.hypot(p[0] - 1, p[1] + 1) for p in pts]))
         assert mean_dist[-1] < mean_dist[0] * 0.7, mean_dist
+
+    def _stagnant_history(self, gens, popsize=6):
+        """popsize trials per generation, all with identical fitness — the
+        textbook stagnation signal (tolfun window never improves)."""
+        trials = []
+        rng = np.random.default_rng(7)
+        for g in range(gens):
+            for i in range(popsize):
+                d = {"x": float(rng.uniform(-5, 5)), "y": float(rng.uniform(-5, 5))}
+                trials.append(
+                    completed_trial(
+                        f"g{g}i{i}", d, 1.0, labels={"cmaes-generation": str(g)}
+                    )
+                )
+        return trials
+
+    def test_ipop_restart_fires_on_stagnated_history(self):
+        """ipop (optuna service.py:87): stagnation restart doubles popsize.
+        dim=2 popsize=6 → stall window 10+30·2/6 = 20 generations."""
+        spec = make_experiment(
+            "cmaes",
+            settings={"popsize": 6, "random_state": 1, "restart_strategy": "ipop"},
+            params=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+                ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+            ],
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        s = create("cmaes")
+        s.validate_algorithm_settings(spec)
+        reply = s.get_suggestions(SuggestionRequest(spec, self._stagnant_history(21), 4))
+        assert reply.algorithm_settings["cmaes_restarts"] == "1"
+        assert reply.algorithm_settings["cmaes_current_popsize"] == "12"
+        # without a restart strategy the same history folds with no restart
+        plain = make_experiment(
+            "cmaes",
+            settings={"popsize": 6, "random_state": 1},
+            params=spec.parameters,
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        reply2 = s.get_suggestions(SuggestionRequest(plain, self._stagnant_history(21), 4))
+        assert reply2.algorithm_settings["cmaes_restarts"] == "0"
+        assert reply2.algorithm_settings["cmaes_current_popsize"] == "6"
+
+    def test_ipop_restart_is_replay_stable(self):
+        """The restart decision (incl. the fresh mean) must reconstruct
+        identically across calls with different trial counts."""
+        spec = make_experiment(
+            "cmaes",
+            settings={"popsize": 6, "random_state": 1, "restart_strategy": "ipop"},
+            params=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+                ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+            ],
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        s = create("cmaes")
+        hist = self._stagnant_history(21)
+        r1 = s.get_suggestions(SuggestionRequest(spec, hist, 2))
+        # complete those two suggestions and ask again: still one restart,
+        # same post-restart popsize
+        more = hist + [
+            completed_trial(a.name, a.assignments_dict(), 0.9, labels=dict(a.labels))
+            for a in r1.assignments
+        ]
+        r2 = s.get_suggestions(SuggestionRequest(spec, more, 2))
+        assert r2.algorithm_settings["cmaes_restarts"] == "1"
+        assert r2.algorithm_settings["cmaes_current_popsize"] == "12"
+
+    def test_restart_seed_deterministic_without_random_state(self):
+        """Regression: with no random_state, seed_from is None and
+        default_rng(None) would entropy-seed the restart's fresh mean — each
+        call would then replay a different post-restart trajectory. The
+        restart seed must fall back to a stable name-derived value."""
+        from katib_tpu.suggest.cmaes import CMAES
+
+        spec = make_experiment("cmaes", settings={"popsize": 6})
+        s1 = CMAES.restart_seed(spec, 1)
+        assert isinstance(s1, int)
+        assert s1 == CMAES.restart_seed(spec, 1)  # stable across calls
+        assert s1 != CMAES.restart_seed(spec, 2)  # varies per restart
+        other = make_experiment("cmaes", settings={"popsize": 6})
+        other.name = "другой"
+        assert s1 != CMAES.restart_seed(other, 1)  # varies per experiment
+
+    def test_bipop_alternates_large_and_small_regimes(self):
+        """bipop: first restart goes small (baseline popsize — the initial run
+        consumed large-regime budget), second goes large (doubled)."""
+        spec = make_experiment(
+            "cmaes",
+            settings={"popsize": 6, "random_state": 1, "restart_strategy": "bipop"},
+            params=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+                ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min="-5", max="5")),
+            ],
+            goal_type=ObjectiveType.MINIMIZE,
+        )
+        s = create("cmaes")
+        reply = s.get_suggestions(SuggestionRequest(spec, self._stagnant_history(21), 1))
+        assert reply.algorithm_settings["cmaes_restarts"] == "1"
+        assert reply.algorithm_settings["cmaes_current_popsize"] == "6"  # small regime
+        reply = s.get_suggestions(SuggestionRequest(spec, self._stagnant_history(42), 1))
+        assert reply.algorithm_settings["cmaes_restarts"] == "2"
+        assert reply.algorithm_settings["cmaes_current_popsize"] == "12"  # large regime
+
+    def test_generation_folds_only_when_fully_terminal(self):
+        """Regression: a generation can hold more trials than the current
+        popsize (bipop shrink, concurrent-suggest label race). Folding on the
+        first popsize completions would consume a call-time-dependent subset;
+        the fold must wait for the entire created set to be terminal."""
+        spec = self.make_spec(popsize=6)
+        s = create("cmaes")
+        rng = np.random.default_rng(3)
+
+        def gen0(n_done, n_running):
+            trials = []
+            for i in range(n_done + n_running):
+                d = {"x": float(rng.uniform(-5, 5)), "y": float(rng.uniform(-5, 5))}
+                cond = (
+                    TrialCondition.SUCCEEDED if i < n_done else TrialCondition.RUNNING
+                )
+                t = completed_trial(
+                    f"t{i}", d, 1.0 + i, condition=cond,
+                    labels={"cmaes-generation": "0"},
+                )
+                trials.append(t)
+            return trials
+
+        # 12 created / 6 done / 6 running: must NOT fold (old code folded on
+        # done >= popsize) — new suggestions spill past the unfolded gen 0
+        reply = s.get_suggestions(SuggestionRequest(spec, gen0(6, 6), 2))
+        assert {a.labels["cmaes-generation"] for a in reply.assignments} == {"2"}
+        # all 12 terminal: folds exactly once, consuming the full set
+        reply = s.get_suggestions(SuggestionRequest(spec, gen0(12, 0), 2))
+        assert {a.labels["cmaes-generation"] for a in reply.assignments} == {"1"}
 
     def test_validation_rejects_categorical(self):
         s = create("cmaes")
