@@ -9,12 +9,17 @@ Because DartsSearch traces its hyperparameters, all 50 trials share ONE
 compiled search step (reference counterpart: 50 pod launches of
 examples/v1beta1/nas/darts-cpu.yaml, each recompiling from scratch).
 
-Scale is platform-adaptive: the TPU scale matches the round-3 bench e2e
-(init_channels=8, num_nodes=2, 3 epochs — demonstrably >=0.9-learnable);
-the CPU scale is reduced to keep 50 trials inside ~15 min on this 1-core
-box while still scoring ~3x chance. CIFAR-10: uses a real npz via
-KATIB_TPU_CIFAR10 when present; otherwise the learnable synthetic
-stand-in, with the fetch failure reason recorded in the artifact.
+Scale is platform-adaptive. The TPU scale gives each trial a 192-step
+search budget (6 epochs x 4096 examples) on the calibrated discriminative
+stand-in (utils/datasets.py): good optimizer settings reach high val-acc,
+bad ones stay near chance, so the 50-trial distribution actually spreads —
+the round-4 review found the previous task saturated at 1.0 and mandated
+this recalibration. The CPU scale is reduced to keep 50 trials tractable
+on this 1-core box; at that capacity the task is mostly unlearnable, so
+CPU records show a thin spread just above chance (capacity-starved by
+design, the TPU record is the evidence artifact). CIFAR-10: uses a real
+npz via KATIB_TPU_CIFAR10 when present; otherwise the synthetic stand-in,
+with the fetch failure reason recorded in the artifact.
 
 Usage: python scripts/run_north_star.py [--trials N] [--out PATH]
 """
@@ -36,10 +41,16 @@ def cifar10_provenance() -> str:
     path = os.environ.get("KATIB_TPU_CIFAR10")
     if path and os.path.exists(path):
         return f"real CIFAR-10 npz ({path})"
+    from katib_tpu.utils.datasets import (
+        SYNTH_DISTRACTOR, SYNTH_NOISE, SYNTH_TRAIN_LABEL_NOISE, SYNTH_VARIANTS,
+    )
+
     return (
-        "synthetic learnable stand-in (utils/datasets.py) — real CIFAR-10 "
-        "fetch blocked by zero-egress environment: urlopen 'Name or service "
-        "not known' for cs.toronto.edu (scripts/fetch_cifar10.py)"
+        "calibrated discriminative synthetic stand-in (utils/datasets.py: "
+        f"noise={SYNTH_NOISE}, distractor={SYNTH_DISTRACTOR}, "
+        f"variants={SYNTH_VARIANTS}, train_label_noise={SYNTH_TRAIN_LABEL_NOISE}) "
+        "— real CIFAR-10 fetch blocked by zero-egress environment: urlopen "
+        "'Name or service not known' for cs.toronto.edu (scripts/fetch_cifar10.py)"
     )
 
 
@@ -71,7 +82,11 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
     if on_tpu:
-        scale = dict(num_epochs=3, num_train_examples=2048, batch_size=64,
+        # 192 search steps/trial: enough for good w_lr/momentum settings to
+        # learn the calibrated task (CNN probe: ~0.96 reachable; tiny-scale
+        # supernet at 4ch/192 steps measured 0.44) while bad settings stay
+        # near chance — the spread the round-4 review required.
+        scale = dict(num_epochs=6, num_train_examples=4096, batch_size=64,
                      init_channels=8, num_nodes=2, stem_multiplier=3,
                      num_layers=3)
     else:

@@ -344,8 +344,8 @@ def test_e2e_plan_tpu_ladder_degrades_to_warm_rung(bench, monkeypatch):
     rung rather than skip the e2e stage outright."""
     monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS", raising=False)
     monkeypatch.delenv("BENCH_NOMINAL_DARTS_STEP_MS_TPU", raising=False)
-    scale, n, _ = bench._e2e_plan(True, 300.0, {"step_ms": 25.0}, 10)
-    assert scale["init_channels"] == 8 and n == 10  # plenty: learnable rung
+    scale, n, _ = bench._e2e_plan(True, 400.0, {"step_ms": 25.0}, 10)
+    assert scale["init_channels"] == 8 and n == 10  # plenty: discriminative rung
     scale, n, _ = bench._e2e_plan(True, 60.0, {"step_ms": 25.0}, 10)
     assert scale["init_channels"] == 1 and scale["schedule_horizon"] == 390
     assert bench._e2e_plan(True, 30.0, {"step_ms": 25.0}, 10) is None

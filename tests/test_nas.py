@@ -172,11 +172,13 @@ class TestDartsDerived:
         run_darts_retrain_trial(
             {"genotype": gene_repr, "lr": "0.05"},
             Ctx(),
-            num_epochs=5, num_train_examples=512, batch_size=32,
+            num_epochs=5, num_train_examples=1024, batch_size=32,
             init_channels=8, num_layers=1, stem_multiplier=1,
         )
-        # measured ~0.44 at this scale; 10-class chance = 0.1
-        assert reported["Validation-accuracy"] > 0.25
+        # measured ~0.285 at this scale on the calibrated discriminative
+        # stand-in (0.44 on the pre-round-5 easy task at half the data);
+        # 10-class chance = 0.1, threshold keeps a ~1.6x cushion
+        assert reported["Validation-accuracy"] > 0.18
 
 
 class TestEnasSuggestion:
