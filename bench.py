@@ -420,6 +420,112 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool, darts=None):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _bench_darts_mfu(jax, np):
+    """TPU-only: the DARTS supernet at the REFERENCE search configuration —
+    8 cells, 4 nodes, init_channels 16, batch 128, the full 7-op primitive
+    set (/root/reference/pkg/suggestion/v1beta1/nas/darts/service.py:120-135)
+    — bilevel search-step latency + MFU.
+
+    FLOPs come from XLA's own cost model on the compiled bilevel step
+    (lowered.compile().cost_analysis()), which counts every conv/matmul in
+    the mixed-op supernet including the Hessian-vector terms — more honest
+    than a hand flops model that inevitably drops terms. The round-4 review
+    flagged that the headline workload had step time but no MFU; this stage
+    answers "is DARTS fast on TPU?" at the scale the reference searches."""
+    from katib_tpu.models.darts_trainer import DartsSearch
+
+    primitives = [
+        "max_pooling_3x3",
+        "avg_pooling_3x3",
+        "skip_connection",
+        "separable_convolution_3x3",
+        "separable_convolution_5x5",
+        "dilated_convolution_3x3",
+        "dilated_convolution_5x5",
+        "none",
+    ]
+    settings = {
+        "num_epochs": 50,
+        "num_nodes": 4,
+        "init_channels": 16,
+        "batch_size": 128,
+        "stem_multiplier": 3,
+    }
+    search = DartsSearch(primitives=primitives, num_layers=8, settings=settings)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 32, 32, 3)).astype("float32")
+    y = rng.integers(0, 10, 256).astype("int32")
+
+    rt_ms = _roundtrip_ms(jax)
+    t0 = time.time()
+    try:
+        search.build((32, 32, 3), STEPS_PER_EPOCH * settings["num_epochs"])
+        import jax.numpy as jnp
+
+        bx, by = jnp.asarray(x[:128]), jnp.asarray(y[:128])
+        vx, vy = jnp.asarray(x[128:]), jnp.asarray(y[128:])
+        args = (
+            search.weights, search.alphas, search.w_opt_state,
+            search.a_opt_state, search.step_idx, search.hyper,
+            (bx, by), (vx, vy),
+        )
+        # AOT compile ONCE: the 8-cell bilevel step is the most expensive
+        # compile in this file, and a jit warmup call followed by a separate
+        # .lower().compile() for cost_analysis would pay it twice
+        compiled = search._search_step.lower(*args).compile()
+        state = compiled(*args)
+        _sync(state[-1])
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"[:300]
+        out = {"error": msg, "config": "cells=8 nodes=4 C=16 batch=128 full-op-set"}
+        if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+            out["memory_note"] = (
+                "reference-config supernet bilevel step does not fit this "
+                "chip's HBM; remat_cells=1 or smaller batch is the documented "
+                "mitigation (models/darts_trainer.py remat flag)"
+            )
+        return out
+    compile_s = time.time() - t0
+
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        flops = None  # backend without cost analysis: report step time only
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    step_s = None
+    for _pass in range(2):  # min of 2 passes: the TPU pool is shared/noisy
+        t0 = time.time()
+        for _ in range(n_steps):
+            state = compiled(*args)
+            args = tuple(state[:4]) + args[4:]
+        _sync(state[-1])
+        cur = max((time.time() - t0 - rt_ms / 1e3) / n_steps, 1e-9)
+        step_s = cur if step_s is None else min(step_s, cur)
+
+    device_kind = getattr(jax.devices()[0], "device_kind", "?")
+    peak = _peak_flops(device_kind)
+    n_params = sum(
+        int(p.size)
+        for p in jax.tree_util.tree_leaves((search.weights, search.alphas))
+    )
+    return {
+        "config": "cells=8 nodes=4 C=16 batch=128 full-op-set (reference scale)",
+        "compile_s": round(compile_s, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "n_params": n_params,
+        "flops_per_step": flops,
+        "flops_source": "xla cost_analysis" if flops else None,
+        "mfu": round(flops / step_s / peak, 4) if flops and peak else None,
+        "device_kind": device_kind,
+    }
+
+
 def _bench_flash_vs_dense(jax, np):
     """TPU-only: fused Pallas flash kernel vs plain XLA dense attention."""
     import jax.numpy as jnp
@@ -553,6 +659,17 @@ def child_main(platform: str) -> None:
             }
         except Exception as e:
             extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if (
+        on_tpu
+        and os.environ.get("BENCH_SKIP_DARTS_MFU") != "1"
+        and gate("darts_mfu", 300.0)
+    ):
+        try:
+            extras["darts_mfu"] = _bench_darts_mfu(jax, np)
+        except Exception as e:
+            extras["darts_mfu"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
     if os.environ.get("BENCH_SKIP_E2E") != "1":
@@ -741,12 +858,106 @@ def _probe_tpu(timeout_s: float):
     return "dead", "probe produced no JSON", None
 
 
+def _probe_until_live(window_end, probe=None, sleep=time.sleep, clock=time.time):
+    """Retry the TPU probe across the whole window instead of one shot.
+
+    Round-4 lesson: the driver bench reached the TPU in only 1 of 4 rounds
+    because a single 150s probe landed inside a wedge stretch while the
+    tunnel recovered minutes later. This loop spends the window the TPU
+    child would have had anyway — a healthy probe exits immediately, a
+    wedged tunnel is re-probed every BENCH_PROBE_RETRY_SLEEP (45s) until
+    the window (total budget minus the CPU reserve) is gone.
+
+    Returns (verdict, diag, rt_ms, attempt_errors).
+    """
+    probe = probe or _probe_tpu
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    retry_sleep = float(os.environ.get("BENCH_PROBE_RETRY_SLEEP", "45"))
+    # Absolute attempt cap: the window bound alone would let a fast-failing
+    # probe (rc!=0 in ms, not a hang) spin thousands of times; 12 attempts
+    # out-lasts any real window at the default timing (12 x ~195s > 2300s).
+    max_attempts = int(os.environ.get("BENCH_PROBE_MAX_ATTEMPTS", "12"))
+    attempts, attempt_errors = 0, []
+    while attempts < max_attempts:
+        budget = min(timeout, window_end - clock())
+        if budget < 10:
+            return (
+                "dead",
+                attempt_errors[-1] if attempt_errors else "probe window too small",
+                None,
+                attempt_errors,
+            )
+        attempts += 1
+        verdict, diag, rt = probe(budget)
+        if verdict != "dead":
+            return verdict, diag, rt, attempt_errors
+        attempt_errors.append(f"probe attempt {attempts}: {diag}")
+        # Only wedge-shaped failures are worth waiting out (hung probe, or a
+        # round-trip past the ceiling). A fast deterministic failure — e.g.
+        # rc=1 'no accelerator backend' on a box with no tunnel at all —
+        # will not change in 45s, and retrying it would sleep away most of
+        # the CPU child's budget.
+        if "timed out" not in diag and "roundtrip" not in diag:
+            return "dead", diag, None, attempt_errors
+        if window_end - clock() < retry_sleep + 15:
+            return "dead", diag, None, attempt_errors
+        sleep(retry_sleep)
+    return (
+        "dead",
+        f"tunnel wedged through {attempts} probe attempts "
+        f"(last: {attempt_errors[-1] if attempt_errors else '?'})",
+        None,
+        attempt_errors,
+    )
+
+
+def _freshest_tpu_capture():
+    """Summary of the newest watcher-captured TPU bench record, labeled as
+    such — when the driver's own run cannot reach the TPU (wedge that
+    outlasts the whole budget), the artifact still carries the freshest
+    real-TPU numbers WITH their provenance instead of nothing."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "examples", "records", "bench_tpu_*.json")))
+    if not paths:
+        return None
+    path = paths[-1]
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    res = rec.get("result") or {}
+    ex = res.get("extras") or {}
+    darts_mfu = ex.get("darts_mfu") if isinstance(ex.get("darts_mfu"), dict) else {}
+    flash = ex.get("flash_attention") if isinstance(ex.get("flash_attention"), dict) else {}
+    return {
+        "file": os.path.relpath(path, here),
+        "captured_at": rec.get("captured_at"),
+        "probe_rt_ms": rec.get("probe_rt_ms"),
+        "provenance": (
+            "builder watcher capture (scripts/capture_tpu_evidence.py) from a "
+            "probe-verified live tunnel — NOT measured by this driver run"
+        ),
+        "headline_value_s": res.get("value"),
+        "darts_step_ms": ex.get("darts_step_ms"),
+        "mfu_small": ex.get("mfu_small"),
+        "mfu_large": ex.get("mfu_large"),
+        "darts_mfu_reference_scale": darts_mfu.get("mfu"),
+        "flash_speedup": flash.get("speedup"),
+    }
+
+
 def main() -> None:
     """One total deadline governs everything (round-3 lesson: the children's
     summed worst cases must never exceed what the caller is willing to wait).
-    Order: cheap probe → TPU child (budget minus the CPU reserve) → CPU child
-    (whatever remains) → sentinel. Every arm is derived from `remaining()`,
-    so the sentinel line always prints inside BENCH_TOTAL_BUDGET."""
+    Order: cheap probe (retried across the TPU window when wedged) → TPU
+    child (budget minus the CPU reserve) → CPU child (whatever remains) →
+    sentinel. Every arm is derived from `remaining()`, so the sentinel line
+    always prints inside BENCH_TOTAL_BUDGET. When the TPU never answers,
+    the CPU/sentinel artifact carries the freshest watcher capture's TPU
+    numbers labeled with their provenance."""
     deadline = time.time() + float(os.environ.get("BENCH_TOTAL_BUDGET", "1140"))
     margin = 20.0  # sentinel/print headroom
     cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE", "360"))
@@ -759,19 +970,19 @@ def main() -> None:
     probe_note = None
     tpu_child_env = None
     if use_tpu:
-        probe_budget = min(
-            float(os.environ.get("BENCH_PROBE_TIMEOUT", "150")),
-            remaining() - cpu_reserve - margin,
-        )
-        if probe_budget < 10:
+        probe_window_end = time.time() + (remaining() - cpu_reserve - margin)
+        if probe_window_end - time.time() < 10:
             use_tpu = False
             errors.append("tpu probe skipped: total budget too small")
         else:
-            verdict, diag, rt_ms = _probe_tpu(probe_budget)
+            verdict, diag, rt_ms, attempt_errors = _probe_until_live(probe_window_end)
             probe_note = diag
+            if len(attempt_errors) > 1:
+                probe_note = f"{diag} (after {len(attempt_errors)} wedged attempts)"
             if verdict == "dead":
                 use_tpu = False
                 errors.append(f"tpu probe: {diag}")
+                errors.extend(attempt_errors[:-1])
             elif verdict == "degraded" and "BENCH_STEPS" not in os.environ:
                 # rt is subtracted once per timed pass, so its residual noise
                 # scales as rt / (steps * step_ms). steps ≈ 0.9*rt_ms keeps
@@ -816,7 +1027,11 @@ def main() -> None:
     if cpu_budget >= 60:
         result, err = _run_child("cpu", cpu_budget)
         if result is not None:
-            result.setdefault("extras", {})["tpu_init_errors"] = errors
+            extras = result.setdefault("extras", {})
+            extras["tpu_init_errors"] = errors
+            capture = _freshest_tpu_capture()
+            if capture:  # real-TPU numbers with watcher provenance
+                extras["freshest_tpu_capture"] = capture
             _attach_north_star(result)
             print(json.dumps(result))
             return
@@ -831,6 +1046,9 @@ def main() -> None:
         "vs_baseline": 0.0,
         "extras": {"errors": errors},
     }
+    capture = _freshest_tpu_capture()
+    if capture:
+        sentinel["extras"]["freshest_tpu_capture"] = capture
     _attach_north_star(sentinel)
     print(json.dumps(sentinel))
 

@@ -208,27 +208,43 @@ def main() -> int:
                         f"north star skipped: {ns_budget:.0f}s left under "
                         "--max-hours", flush=True,
                     )
-                # third rung: on-chip tuning sweep (block sizes / batch
-                # knee) while the window lasts — writes its own record
-                tune_left = deadline - time.time()
-                if tune_left <= 1500:
-                    print(
-                        f"tuning sweep skipped: {tune_left:.0f}s left "
-                        "under --max-hours", flush=True,
-                    )
-                else:
+                # remaining rungs while the window lasts, cheapest-evidence
+                # first; each writes its own record and is individually
+                # bounded so one hang cannot eat the rest
+                for label, argv, need_s, timeout_s in (
+                    # round-5 mandates: ENAS + hyperband records (review
+                    # item 8) and the dispersion-carrying flash A/B (item 7)
+                    ("capability records (enas+hyperband)",
+                     [sys.executable,
+                      os.path.join(REPO, "scripts", "run_capability_records.py"),
+                      "--tpu", "--timeout", "1200"],
+                     1800, 2700),
+                    ("flash A/B dispersion",
+                     [sys.executable,
+                      os.path.join(REPO, "scripts", "flash_ab.py")],
+                     900, 900),
+                    ("tuning sweep",
+                     [sys.executable, os.path.join(REPO, "scripts", "tune_tpu.py")],
+                     1500, 1200),
+                ):
+                    left = deadline - time.time()
+                    if left <= need_s:
+                        print(f"{label} skipped: {left:.0f}s left "
+                              "under --max-hours", flush=True)
+                        continue
                     try:
                         proc = subprocess.run(
-                            [sys.executable,
-                             os.path.join(REPO, "scripts", "tune_tpu.py")],
-                            capture_output=True, text=True, timeout=1200,
+                            argv, capture_output=True, text=True,
+                            # never outlive --max-hours: a rung that would
+                            # cross the deadline is clamped to what's left
+                            timeout=min(timeout_s, max(60.0, left - 60.0)),
                             cwd=REPO,
                         )
                         tail = (proc.stdout or proc.stderr).strip().splitlines()[-1:]
-                        print(f"tuning rc={proc.returncode}: "
+                        print(f"{label} rc={proc.returncode}: "
                               f"{(tail or ['?'])[0][:160]}", flush=True)
                     except subprocess.TimeoutExpired:
-                        print("tuning sweep hung past 1200s", flush=True)
+                        print(f"{label} hung past {timeout_s}s", flush=True)
                 return 0
             print(f"[{stamp}] bench ran but no TPU numbers "
                   f"(platform={platform}); will retry", flush=True)

@@ -34,9 +34,14 @@ def bench(monkeypatch):
         "BENCH_NOMINAL_DARTS_STEP_MS", "BENCH_NOMINAL_DARTS_STEP_MS_CPU",
         "BENCH_NOMINAL_DARTS_STEP_MS_TPU", "BENCH_STEPS",
         "BENCH_PROBE_MAX_RT_MS", "BENCH_PROBE_DEGRADED_RT_MS",
+        "BENCH_PROBE_MAX_ATTEMPTS",
     ):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("BENCH_RETRY_SLEEP", "0")  # stubbed children: no backoff
+    # stubbed probes return instantly; without these the retry loop would
+    # spend real wall-clock sleeping between attempts
+    monkeypatch.setenv("BENCH_PROBE_RETRY_SLEEP", "0")
+    monkeypatch.setenv("BENCH_PROBE_MAX_ATTEMPTS", "3")
     return mod
 
 
@@ -358,3 +363,112 @@ def test_e2e_plan_garbage_nominal_override_falls_back(bench, monkeypatch):
         monkeypatch.setenv("BENCH_NOMINAL_DARTS_STEP_MS", bad)
         _, _, contention = bench._e2e_plan(False, 900.0, {"step_ms": 2200.0}, 3)
         assert contention == pytest.approx(2.0)  # 2200 / builtin 1100
+
+
+def test_probe_until_live_exits_on_first_healthy(bench, monkeypatch):
+    """A live tunnel must cost exactly one probe — retries are only for
+    wedges, never overhead on the happy path."""
+    calls = []
+
+    def probe(budget):
+        calls.append(budget)
+        return "healthy", "rt 5ms on v5e", 5.0
+
+    verdict, diag, rt, errs = bench._probe_until_live(
+        time.time() + 700, probe=probe, sleep=lambda s: None
+    )
+    assert verdict == "healthy" and rt == 5.0 and errs == []
+    assert len(calls) == 1
+
+
+def test_probe_until_live_retries_through_a_wedge(bench, monkeypatch):
+    """Round-4 fix: a wedge that clears mid-window must be survived — the
+    old single-shot probe gave up and fell back to CPU (1 TPU capture in 4
+    rounds). Simulated clock: two wedged attempts, then recovery."""
+    monkeypatch.setenv("BENCH_PROBE_RETRY_SLEEP", "45")
+    now = [0.0]
+    answers = iter([
+        ("dead", "probe timed out after 150s (tunnel wedged or backend hung)", None),
+        ("dead", "roundtrip 400.0ms > 250.0ms ceiling (tunnel degraded past use)", None),
+        ("degraded", "rt 80ms on v5e", 80.0),
+    ])
+
+    def probe(budget):
+        now[0] += 150  # each probe consumes its budget
+        return next(answers)
+
+    def sleep(s):
+        now[0] += s
+
+    verdict, diag, rt, errs = bench._probe_until_live(
+        700.0, probe=probe, sleep=sleep, clock=lambda: now[0]
+    )
+    assert verdict == "degraded" and rt == 80.0
+    assert len(errs) == 2 and "attempt 1" in errs[0] and "attempt 2" in errs[1]
+
+
+def test_probe_until_live_respects_window(bench, monkeypatch):
+    """Retries must never eat into the CPU reserve: when the window is gone,
+    the loop reports dead with the attempt history."""
+    monkeypatch.setenv("BENCH_PROBE_RETRY_SLEEP", "45")
+    now = [0.0]
+
+    def probe(budget):
+        assert budget <= 150.0 + 1e-9
+        now[0] += min(150, budget)
+        return "dead", f"probe timed out after {budget:.0f}s (tunnel wedged)", None
+
+    def sleep(s):
+        now[0] += s
+
+    verdict, _, rt, errs = bench._probe_until_live(
+        500.0, probe=probe, sleep=sleep, clock=lambda: now[0]
+    )
+    assert verdict == "dead" and rt is None
+    assert 2 <= len(errs) <= 4  # several attempts fit a 500s window, not 50
+    assert now[0] <= 500.0 + 150.0  # never sleeps past the window
+
+
+def test_probe_until_live_fails_fast_on_deterministic_failure(bench, monkeypatch):
+    """A fast rc!=0 probe failure (e.g. 'no accelerator backend' on a box
+    with no tunnel) is permanent, not a wedge — retrying it would sleep
+    away the CPU child's budget. One attempt, immediate dead verdict."""
+    monkeypatch.setenv("BENCH_PROBE_RETRY_SLEEP", "45")
+    calls = []
+
+    def probe(budget):
+        calls.append(budget)
+        return "dead", "probe rc=1: AssertionError: no accelerator backend", None
+
+    slept = []
+    verdict, diag, rt, errs = bench._probe_until_live(
+        time.time() + 700, probe=probe, sleep=slept.append
+    )
+    assert verdict == "dead" and rt is None
+    assert len(calls) == 1 and slept == []
+    assert "no accelerator backend" in diag
+
+
+def test_freshest_tpu_capture_summarizes_watcher_record(bench):
+    """The CPU-fallback artifact must carry the newest watcher capture's TPU
+    numbers labeled with provenance (round-4 mandate: BENCH_r05 carries TPU
+    MFU even through a wedge cycle)."""
+    cap = bench._freshest_tpu_capture()
+    # the repo ships at least one watcher capture (examples/records/)
+    assert cap is not None
+    assert "NOT measured by this driver run" in cap["provenance"]
+    assert cap["file"].startswith("examples/records/bench_tpu_")
+    assert cap["captured_at"]
+    assert cap["mfu_small"] or cap["headline_value_s"]
+
+
+def test_sentinel_carries_freshest_capture(bench, monkeypatch, capsys):
+    """Even the all-dead sentinel line ships the labeled watcher numbers."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "40")  # too small for anything
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setattr(bench, "_run_child", lambda *a, **k: (None, "stubbed dead"))
+    bench.main()
+    line = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["value"] == -1.0
+    assert payload["extras"]["freshest_tpu_capture"]["captured_at"]
