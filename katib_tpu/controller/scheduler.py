@@ -124,7 +124,17 @@ class TrialScheduler:
 
     # -- submission ----------------------------------------------------------
 
+    LINEAGE_LABEL = "checkpoint-lineage"
+
     def submit(self, exp: Experiment, trial: Trial, checkpoint_dir: Optional[str] = None) -> None:
+        if checkpoint_dir:
+            # Persisted marker (the _checkpoint_dirs entry is transient —
+            # popped on start): this trial trains FROM a parent checkpoint,
+            # so its metrics reflect inherited training, and duplicate-reuse
+            # must never treat it as a from-scratch result for the same
+            # assignments — in either direction (advisor round-4 finding:
+            # the old guard only blocked lineage trials as reuse TARGETS).
+            trial.labels[self.LINEAGE_LABEL] = "1"
         trial.set_condition(TrialCondition.PENDING, "TrialPending", "waiting for devices")
         self.state.update_trial(trial)
         if self.metrics_registry is not None:
@@ -133,7 +143,16 @@ class TrialScheduler:
             self.recorder.event(exp.name, "Trial", trial.name, "TrialCreated", "Trial is created")
         if checkpoint_dir:
             self._checkpoint_dirs[trial.name] = checkpoint_dir
-        elif exp.spec.reuse_duplicate_results and self._reuse_duplicate(exp, trial):
+        elif (
+            # the persisted label, not the transient checkpoint_dir arg: a
+            # resumed lineage trial can be resubmitted with
+            # checkpoint_dir=None (experiment.py resume path swallows
+            # _checkpoint_dir_for failures) and must still never consume a
+            # from-scratch result
+            not trial.labels.get(self.LINEAGE_LABEL)
+            and exp.spec.reuse_duplicate_results
+            and self._reuse_duplicate(exp, trial)
+        ):
             # finalized from a prior identical-assignment success; never
             # reused for checkpoint-lineage trials (PBT exploit/explore
             # trains FROM a parent checkpoint — same params, different run)
@@ -148,7 +167,17 @@ class TrialScheduler:
         assignments, copy its observation log to this trial and finalize it
         Succeeded without running the workload. No reference counterpart —
         on TPU, a duplicate suggestion (small discrete spaces, categorical
-        resampling) would otherwise re-burn a full training run."""
+        resampling) would otherwise re-burn a full training run.
+
+        Scope, by design: only PREVIOUSLY COMPLETED trials match. Identical
+        suggestions dispatched in the same reconcile batch (parallel > 1)
+        all execute in full — deduping against in-flight twins would need a
+        subscription on their completion and buys little, since duplicate
+        suggestions mostly arrive across reconciles as a search converges.
+        Checkpoint-lineage trials (persisted ``checkpoint-lineage`` label)
+        are excluded as sources: their metrics reflect training inherited
+        from a parent checkpoint, not a from-scratch run with these
+        assignments."""
         key = tuple(sorted((a.name, a.value) for a in trial.parameter_assignments))
         if not key:
             return False  # nothing to match on; run the trial
@@ -157,6 +186,7 @@ class TrialScheduler:
             if (
                 t.name != trial.name
                 and t.condition == TrialCondition.SUCCEEDED
+                and t.labels.get(self.LINEAGE_LABEL) != "1"
                 and tuple(sorted((a.name, a.value) for a in t.parameter_assignments)) == key
             ):
                 source = t

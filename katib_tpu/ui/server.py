@@ -7,8 +7,11 @@ TPU-native replacement is a zero-dependency threaded http.server with the
 same information surface:
 
   GET /api/experiments                          list with status summary
-  GET /api/experiments/<name>                   full spec+status
+  GET /api/experiments/<name>                   full spec+status (?format=yaml
+                                                for the Angular YAML-tab view)
   GET /api/experiments/<name>/trials            fetch_hp_job_info view
+                                                (?offset=&limit= -> paged
+                                                envelope with total)
   GET /api/experiments/<name>/trials/<t>/logs   trial stdout (fetch_trial_logs)
   GET /api/experiments/<name>/trials/<t>/profile  xplane profiler artifacts
   GET /api/experiments/<name>/events            event stream (K8s Events parity)
@@ -19,6 +22,9 @@ same information surface:
   GET /api/templates[/<name>]                   trial-template store
   GET /metrics                                  Prometheus text exposition
   GET /                                         single-page HTML dashboard
+  GET /experiment/<name>                        experiment detail page (live
+                                                paginated trials + log/profile
+                                                links + spec YAML/JSON)
   POST /api/experiments                         create + start   [auth]
   POST /api/templates                           save template    [auth]
   DELETE /api/experiments/<name>                delete           [auth]
@@ -44,7 +50,7 @@ import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
-from urllib.parse import unquote, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 _DASHBOARD = """<!DOCTYPE html>
 <html><head><title>katib-tpu</title><style>
@@ -100,8 +106,9 @@ async function load(){
  document.getElementById('exps').innerHTML=table(es.map(e=>({
   name:`<a href="#" data-name="${esc(e.name)}" class="explink">${esc(e.name)}</a>`,
   status:esc(e.status),status_cls:e.status,reason:esc(e.reason),algorithm:esc(e.algorithm),
-  succeeded:`${esc(e.trialsSucceeded)}/${esc(e.trials)}`,best:esc(e.bestTrialName)})),
-  ['name','status','reason','algorithm','succeeded','best']);
+  succeeded:`${esc(e.trialsSucceeded)}/${esc(e.trials)}`,best:esc(e.bestTrialName),
+  detail:`<a href="/experiment/${encodeURIComponent(e.name)}">detail &rarr;</a>`})),
+  ['name','status','reason','algorithm','succeeded','best','detail']);
  for(const a of document.querySelectorAll('.explink'))
   a.onclick=(ev)=>{ev.preventDefault();sel(a.dataset.name)};
  if(CUR)sel(CUR)}
@@ -253,6 +260,89 @@ async function loadTemplates(){
  selEl.innerHTML='<option value="">(inline trialTemplate)</option>'+
   names.map(n=>`<option${n===cur?' selected':''}>${esc(n)}</option>`).join('')}
 load();loadTemplates();setInterval(load,3000);
+</script></body></html>"""
+
+# Dedicated experiment detail page (reference Angular experiment-details
+# module: trials table + experiment YAML view,
+# pkg/ui/v1beta1/frontend/src/app/experiment-details): live paginated trial
+# table with per-trial log/profile links and a spec YAML/JSON toggle.
+_DETAIL_PAGE = """<!DOCTYPE html>
+<html><head><title>katib-tpu experiment</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.4rem}
+table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+th,td{text-align:left;padding:.4rem .7rem;border-bottom:1px solid #eee;font-size:.9rem}
+th{background:#f0f0f3} .Succeeded{color:#0a7d36}.Failed{color:#b3261e}
+.Running{color:#0b57d0}.EarlyStopped{color:#7b5ea7} code{font-size:.85em}
+a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
+.muted{color:#888;font-size:.85em}
+#specbox{background:#fff;padding:.8rem;font:.78rem/1.3 monospace;white-space:pre;
+ overflow:auto;max-height:26rem;box-shadow:0 1px 2px #0002}
+#logbox{background:#111;color:#ddd;padding:.8rem;font:.78rem/1.3 monospace;
+ white-space:pre-wrap;max-height:24rem;overflow:auto;display:none}
+button{margin-right:.3rem}
+</style></head><body>
+<div class="muted"><a href="/">&larr; all experiments</a></div>
+<h1 id="title">experiment</h1>
+<div id="status" class="muted">loading...</div>
+<h2>trials <span id="pageinfo" class="muted"></span></h2>
+<div>
+ page size <select id="psize"><option>10</option><option selected>25</option><option>50</option></select>
+ <button id="prev">&larr; prev</button><button id="next">next &rarr;</button>
+</div>
+<div id="trials" style="margin-top:.5rem">loading...</div>
+<pre id="logbox"></pre>
+<h2>spec <button id="fmtjson">JSON</button><button id="fmtyaml">YAML</button></h2>
+<div id="specbox">loading...</div>
+<script>
+const NAME=decodeURIComponent(location.pathname.split('/').filter(Boolean).pop());
+const esc=s=>String(s??'').replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+async function j(u){return (await fetch(u)).json()}
+let OFFSET=0,TOTAL=0;
+function psize(){return parseInt(document.getElementById('psize').value)}
+async function loadHead(){
+ const e=await j(`/api/experiments/${encodeURIComponent(NAME)}`);
+ document.getElementById('title').textContent=NAME;
+ const s=e.status||{};
+ document.getElementById('status').innerHTML=
+  `status <b class="${esc(s.condition)}">${esc(s.condition)}</b> (${esc(s.reason??'')})`+
+  ` &nbsp; algorithm <code>${esc(e.spec?.algorithm?.algorithmName??'')}</code>`+
+  ` &nbsp; best trial <code>${esc(s.currentOptimalTrial?.bestTrialName??'—')}</code>`}
+async function loadTrials(){
+ const r=await j(`/api/experiments/${encodeURIComponent(NAME)}/trials?offset=${OFFSET}&limit=${psize()}`);
+ const total=r.total??0, ts=r.trials??[];
+ TOTAL=total;
+ document.getElementById('pageinfo').textContent=
+  total?`${OFFSET+1}-${Math.min(OFFSET+ts.length,total)} of ${total}`:'none yet';
+ if(!ts.length){document.getElementById('trials').innerHTML='<i>none</i>';return}
+ let h='<table><tr><th>trial</th><th>status</th><th>assignments</th><th>objective</th><th>links</th></tr>';
+ for(const t of ts){
+  h+=`<tr><td>${esc(t.name)}</td>`+
+   `<td class="${esc(t.condition)}">${esc(t.condition)}`+
+   (t.reason&&t.reason!=='Trial'+t.condition?` <span class="muted">(${esc(t.reason)})</span>`:'')+`</td>`+
+   `<td><code>${esc(JSON.stringify(t.assignments))}</code></td>`+
+   `<td>${esc(t.objective??'')}</td>`+
+   `<td><a href="#" class="loglink" data-trial="${esc(t.name)}">logs</a> `+
+   `<a href="/api/experiments/${encodeURIComponent(NAME)}/trials/${encodeURIComponent(t.name)}/profile">profile</a></td></tr>`}
+ document.getElementById('trials').innerHTML=h+'</table>';
+ for(const a of document.querySelectorAll('.loglink'))
+  a.onclick=async(ev)=>{ev.preventDefault();
+   const r=await fetch(`/api/experiments/${encodeURIComponent(NAME)}/trials/${encodeURIComponent(a.dataset.trial)}/logs`);
+   const b=document.getElementById('logbox');
+   b.style.display='block';b.textContent=r.ok?await r.text():`no logs (${r.status})`}}
+async function loadSpec(fmt){
+ const box=document.getElementById('specbox');
+ if(fmt==='yaml'){
+  const r=await fetch(`/api/experiments/${encodeURIComponent(NAME)}?format=yaml`);
+  box.textContent=await r.text()}
+ else box.textContent=JSON.stringify(await j(`/api/experiments/${encodeURIComponent(NAME)}`),null,1)}
+document.getElementById('prev').onclick=()=>{OFFSET=Math.max(0,OFFSET-psize());loadTrials()};
+document.getElementById('next').onclick=()=>{if(OFFSET+psize()<TOTAL){OFFSET+=psize();loadTrials()}};
+document.getElementById('psize').onchange=()=>{OFFSET=0;loadTrials()};
+document.getElementById('fmtjson').onclick=()=>loadSpec('json');
+document.getElementById('fmtyaml').onclick=()=>loadSpec('yaml');
+loadHead();loadTrials();loadSpec('yaml');
+setInterval(()=>{loadHead();loadTrials()},3000);
 </script></body></html>"""
 
 
@@ -425,6 +515,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "" or path == "/":
                 return self._send(_DASHBOARD, "text/html")
+            if path.startswith("/experiment/"):
+                # detail page: name is parsed client-side from the URL, so
+                # one template serves every experiment (404s surface in-page)
+                return self._send(_DETAIL_PAGE, "text/html")
             if path == "/metrics":
                 return self._send(ctrl.metrics.render(), "text/plain; version=0.0.4")
             if path == "/api/algorithms":
@@ -469,6 +563,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if exp is None:
                     return self._send({"error": f"experiment {name!r} not found"}, code=404)
                 if len(parts) == 4:
+                    fmt = parse_qs(urlparse(self.path).query).get("format", ["json"])[0]
+                    if fmt == "yaml":
+                        # the Angular UI's YAML tab (experiment-yaml view);
+                        # PyYAML renders the same dict the JSON path returns
+                        import yaml
+
+                        return self._send(
+                            yaml.safe_dump(exp.to_dict(), sort_keys=False),
+                            "text/yaml",
+                        )
                     return self._send(exp.to_dict())
                 sub = parts[4]
                 if sub == "trials" and len(parts) == 7 and parts[6] == "logs":
@@ -476,8 +580,27 @@ class _Handler(BaseHTTPRequestHandler):
                 if sub == "trials" and len(parts) == 7 and parts[6] == "profile":
                     return self._trial_profile(name, parts[5])
                 if sub == "trials":
+                    trials = ctrl.state.list_trials(name)
+                    q = parse_qs(urlparse(self.path).query)
+                    paged = "offset" in q or "limit" in q
+                    offset, limit = 0, None
+                    if paged:
+                        # paginated envelope (Angular trials table pages
+                        # server-side at scale); the bare-list shape stays
+                        # for existing consumers. Slice BEFORE building the
+                        # per-trial dicts so a thousands-of-trials poll only
+                        # folds the page it returns.
+                        try:
+                            offset = max(0, int(q.get("offset", ["0"])[0]))
+                            limit = max(1, int(q.get("limit", ["25"])[0]))
+                        except ValueError:
+                            return self._send(
+                                {"error": "offset/limit must be integers"}, code=400
+                            )
+                    total = len(trials)
+                    page = trials[offset:offset + limit] if paged else trials
                     out = []
-                    for t in ctrl.state.list_trials(name):
+                    for t in page:
                         obj = None
                         if t.observation:
                             m = t.observation.metric(exp.spec.objective.objective_metric_name)
@@ -497,10 +620,13 @@ class _Handler(BaseHTTPRequestHandler):
                                 "labels": t.labels,
                             }
                         )
+                    if paged:
+                        return self._send(
+                            {"total": total, "offset": offset, "limit": limit,
+                             "trials": out}
+                        )
                     return self._send(out)
                 if sub == "events":
-                    from urllib.parse import parse_qs
-
                     events = [e.to_dict() for e in ctrl.events.list(name)]
                     limit = parse_qs(urlparse(self.path).query).get("limit", [None])[0]
                     if limit is not None and limit.isdigit():
@@ -517,8 +643,6 @@ class _Handler(BaseHTTPRequestHandler):
                         parameter_importance(exp, ctrl.state.list_trials(name))
                     )
             if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
-                from urllib.parse import parse_qs
-
                 logs = ctrl.obs_store.get_observation_log(parts[3])
                 q = parse_qs(urlparse(self.path).query)
                 limit = q.get("limit", [None])[0]

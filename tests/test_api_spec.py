@@ -6,6 +6,7 @@ Models reference test files defaults_test.go and validator_test.go
 
 import pytest
 
+
 from katib_tpu.api import (
     AlgorithmSpec,
     ExperimentSpec,
@@ -23,6 +24,9 @@ from katib_tpu.api import (
     validate_experiment,
 )
 from katib_tpu.api.status import Experiment, ExperimentCondition, ExperimentReason
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 def make_spec(**kw) -> ExperimentSpec:

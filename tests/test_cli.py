@@ -5,9 +5,13 @@ cmd/ui/v1beta1/main.go:42-75, terminal-first)."""
 import json
 import sys
 
+
 import pytest
 
 from katib_tpu.cli import main
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture

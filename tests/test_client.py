@@ -7,7 +7,11 @@ level: objective function -> experiment -> optimal hyperparameters.
 
 import pytest
 
+
 from katib_tpu.client import KatibClient, search
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture

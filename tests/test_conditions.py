@@ -5,6 +5,7 @@ first, then success, else the default exit-code classification."""
 
 import pytest
 
+
 from katib_tpu.api import (
     AlgorithmSpec,
     ExperimentSpec,
@@ -24,6 +25,9 @@ from katib_tpu.controller.conditions import (
     parse_condition,
 )
 from katib_tpu.controller.experiment import ExperimentController
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 class TestConditionExpressions:

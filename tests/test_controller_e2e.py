@@ -10,6 +10,7 @@ Models the reference's e2e verifier assertions
 
 import math
 
+
 import pytest
 
 from katib_tpu.api import (
@@ -27,6 +28,9 @@ from katib_tpu.api import (
     TrialTemplate,
 )
 from katib_tpu.controller.experiment import ExperimentController
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 def quadratic_objective(assignments, ctx):
@@ -335,6 +339,76 @@ class TestDuplicateResultReuse:
                 known_algorithms=registered_algorithms(),
                 known_early_stopping=registered_early_stoppers(),
             )
+
+    def test_lineage_trial_never_serves_as_reuse_source(self, controller, tmp_path):
+        """Advisor round-4 finding: a Succeeded trial submitted WITH a
+        checkpoint_dir (PBT exploit/explore) trained from a parent
+        checkpoint, so its metrics are not a from-scratch result for those
+        assignments — a later identical-assignment trial must execute, not
+        copy them. The lineage marker must be a persisted label, since the
+        scheduler's _checkpoint_dirs map is popped on start."""
+        import time as _time
+
+        from katib_tpu.api import ParameterAssignment
+        from katib_tpu.api.status import Trial
+
+        executions = []
+        spec = self._categorical_spec("reuse-lineage", executions, reuse=True)
+        controller.create_experiment(spec)
+        exp = controller.state.get_experiment("reuse-lineage")
+
+        def submit_and_wait(name, checkpoint_dir=None):
+            t = Trial(
+                name=name,
+                experiment_name="reuse-lineage",
+                parameter_assignments=[ParameterAssignment("choice", "a")],
+            )
+            controller.state.update_trial(t)
+            controller.scheduler.submit(exp, t, checkpoint_dir=checkpoint_dir)
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                cur = controller.state.get_trial("reuse-lineage", name)
+                if cur.is_terminal:
+                    return cur
+                _time.sleep(0.05)
+            raise AssertionError(f"trial {name} never finished")
+
+        lineage = submit_and_wait("lineage-t", checkpoint_dir=str(tmp_path / "ckpt"))
+        assert lineage.is_succeeded and lineage.labels.get("checkpoint-lineage") == "1"
+        assert executions == ["a"]
+
+        fresh = submit_and_wait("fresh-t")
+        assert fresh.is_succeeded
+        # executed from scratch — no DuplicateResultReused from the lineage run
+        assert executions == ["a", "a"]
+        assert not any(c.reason == "DuplicateResultReused" for c in fresh.conditions)
+
+        # a second fresh duplicate DOES reuse the from-scratch run's result
+        dup = submit_and_wait("dup-t")
+        assert executions == ["a", "a"]
+        assert any(c.reason == "DuplicateResultReused" for c in dup.conditions)
+
+        # target direction survives a resume: a lineage-labeled trial
+        # resubmitted WITHOUT its checkpoint_dir (the resume path swallows
+        # _checkpoint_dir_for failures) must still execute, not consume the
+        # from-scratch result
+        resumed = Trial(
+            name="resumed-lineage-t",
+            experiment_name="reuse-lineage",
+            parameter_assignments=[ParameterAssignment("choice", "a")],
+            labels={"checkpoint-lineage": "1"},
+        )
+        controller.state.update_trial(resumed)
+        controller.scheduler.submit(exp, resumed, checkpoint_dir=None)
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            cur = controller.state.get_trial("reuse-lineage", "resumed-lineage-t")
+            if cur.is_terminal:
+                break
+            _time.sleep(0.05)
+        assert cur.is_succeeded
+        assert executions == ["a", "a", "a"]  # it ran
+        assert not any(c.reason == "DuplicateResultReused" for c in cur.conditions)
 
     def test_reused_trial_has_start_and_completion_time(self, controller):
         executions = []

@@ -13,6 +13,7 @@ test/e2e/v1beta1/scripts/gh-actions/run-e2e-experiment.py.
 import numpy as np
 import pytest
 
+
 from katib_tpu.utils.datasets import (
     SYNTH_TRAIN_LABEL_NOISE,
     _synthetic_images,
@@ -20,6 +21,9 @@ from katib_tpu.utils.datasets import (
     load_cifar10,
     load_mnist,
 )
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 class TestGeneration:

@@ -3,6 +3,7 @@ and the getMetrics fold (trial_controller_util.go:165-217)."""
 
 import math
 
+
 import pytest
 
 from katib_tpu.api import (
@@ -19,6 +20,9 @@ from katib_tpu.db import (
     fold_observation,
     objective_value,
 )
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture(params=["memory", "sqlite"])

@@ -252,6 +252,7 @@ def _plateau_trial(assignments, ctx):
         ctx.report(**{"accuracy": value})
 
 
+@pytest.mark.smoke
 def test_medianstop_e2e(controller):
     """Early-stopping workflow: plateauing trials are stopped once the
     median rule is established by good trials."""

@@ -6,12 +6,16 @@ import glob
 import json
 import os
 
+
 import pytest
 
 from katib_tpu.api import set_defaults, validate_experiment
 from katib_tpu.api.spec import ExperimentSpec
 from katib_tpu.earlystop.medianstop import registered_early_stoppers
 from katib_tpu.suggest.base import registered_algorithms
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 EXAMPLES_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"

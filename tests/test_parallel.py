@@ -33,6 +33,7 @@ class TestMesh:
 
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.smoke
     def test_matches_dense(self, devices, causal):
         mesh = make_mesh(devices, seq=4)  # data=2, seq=4
         rng = np.random.default_rng(0)
@@ -74,6 +75,7 @@ class TestRingAttention:
 
 
 class TestShardedTrainStep:
+    @pytest.mark.smoke
     def test_dp_tp_sp_step_runs_and_learns(self, devices):
         from katib_tpu.models.transformer import TransformerConfig
         from katib_tpu.parallel.train import make_lm_train_step

@@ -65,6 +65,7 @@ def _run_until_partial(ctrl, name, min_done, poll=0.25, budget=60):
     raise AssertionError(f"never reached {min_done} terminal trials")
 
 
+@pytest.mark.smoke
 def test_resume_subprocess_experiment(tmp_path):
     root = str(tmp_path)
     spec = ExperimentSpec(

@@ -7,6 +7,7 @@ actual localhost server since the transport itself is ours.
 
 import pytest
 
+
 from katib_tpu.db.store import InMemoryObservationStore, MetricLog
 from katib_tpu.service.rpc import (
     ApiServicer,
@@ -16,6 +17,9 @@ from katib_tpu.service.rpc import (
 )
 from katib_tpu.suggest.base import SuggestionRequest
 from tests.test_suggest_algorithms import completed_trial, make_experiment
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 @pytest.fixture(scope="module")

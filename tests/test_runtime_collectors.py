@@ -5,12 +5,16 @@ of checked-in TF fixtures."""
 
 import struct
 
+
 import numpy as np
 import pytest
 
 from katib_tpu.db.store import MetricLog
 from katib_tpu.runtime.metrics import parse_json_lines, parse_text_lines
 from katib_tpu.runtime.tfevent import collect_tfevent_metrics, read_tfevents
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 # -- minimal protobuf/TFRecord writer (test-side encoder) --------------------

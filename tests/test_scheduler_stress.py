@@ -26,6 +26,7 @@ def _fast_trial(assignments, ctx):
     ctx.report(score=float(assignments["x"]))
 
 
+@pytest.mark.smoke
 def test_parallel_64_throughput_and_cleanup(tmp_path):
     c = ExperimentController(root_dir=str(tmp_path), devices=list(range(64)))
     try:

@@ -8,6 +8,7 @@ directly, same contract).
 
 import math
 
+
 import numpy as np
 import pytest
 
@@ -28,6 +29,9 @@ from katib_tpu.api import (
     TrialTemplate,
 )
 from katib_tpu.suggest.base import SuggestionRequest, create, registered_algorithms
+
+# Fast, capability-representative module: part of the -m smoke tier.
+pytestmark = pytest.mark.smoke
 
 
 def make_experiment(algorithm="random", settings=None, params=None, goal_type=ObjectiveType.MAXIMIZE):

@@ -62,6 +62,7 @@ class TestUIServer:
         assert exps[0]["trialsSucceeded"] == 3
         assert exps[0]["bestTrialName"]
 
+    @pytest.mark.smoke
     def test_experiment_detail_and_trials(self, stack):
         base, _, _ = stack
         _, _, body = get(f"{base}/api/experiments/ui-exp")
@@ -73,6 +74,54 @@ class TestUIServer:
         assert all(t["condition"] == "Succeeded" for t in trials)
         assert all(t["reason"] == "TrialSucceeded" for t in trials)
         assert all("x" in t["assignments"] for t in trials)
+
+    @pytest.mark.smoke
+    def test_trials_pagination_envelope(self, stack):
+        """Angular trials-table parity: offset/limit return a paged envelope
+        with the total, while the bare-list shape stays for old consumers."""
+        base, _, _ = stack
+        _, _, body = get(f"{base}/api/experiments/ui-exp/trials?offset=0&limit=2")
+        page = json.loads(body)
+        assert page["total"] == 3 and page["offset"] == 0 and page["limit"] == 2
+        assert len(page["trials"]) == 2
+        _, _, body = get(f"{base}/api/experiments/ui-exp/trials?offset=2&limit=2")
+        page2 = json.loads(body)
+        assert len(page2["trials"]) == 1
+        names = {t["name"] for t in page["trials"]} | {t["name"] for t in page2["trials"]}
+        assert len(names) == 3  # pages partition the set
+        # past-the-end offset: empty page, not an error
+        _, _, body = get(f"{base}/api/experiments/ui-exp/trials?offset=50&limit=10")
+        assert json.loads(body)["trials"] == []
+        # garbage paging params are a 400, not a 500
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{base}/api/experiments/ui-exp/trials?offset=banana")
+        assert e.value.code == 400
+
+    @pytest.mark.smoke
+    def test_experiment_spec_yaml_view(self, stack):
+        """The Angular YAML tab: ?format=yaml renders the same spec+status
+        dict as YAML text."""
+        import yaml
+
+        base, _, _ = stack
+        status, ctype, body = get(f"{base}/api/experiments/ui-exp?format=yaml")
+        assert status == 200 and "yaml" in ctype
+        doc = yaml.safe_load(body)
+        assert doc["spec"]["algorithm"]["algorithmName"] == "random"
+        assert doc["status"]["condition"] == "Succeeded"
+
+    @pytest.mark.smoke
+    def test_experiment_detail_page_served(self, stack):
+        """/experiment/<name> serves the detail page (trials table with
+        pagination controls, per-trial log/profile links, spec YAML/JSON
+        toggle — the three most-used Angular views)."""
+        base, _, _ = stack
+        status, ctype, body = get(f"{base}/experiment/ui-exp")
+        assert status == 200 and "html" in ctype
+        for needle in ("page size", "loadTrials", "profile", "fmtyaml", "logs"):
+            assert needle in body, needle
 
     def test_trial_metrics(self, stack):
         base, ctrl, token = stack
