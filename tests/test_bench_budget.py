@@ -256,7 +256,7 @@ def test_sentinel_via_real_subprocess():
     lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
     assert len(lines) == 1
     parsed = json.loads(lines[0])
-    assert parsed["metric"] == "darts_cifar10_e2e_projected_wallclock"
+    assert parsed["metric"] == "darts_cifar10_e2e_steady_state_epoch"
 
 
 def test_e2e_plan_contention_inflates_estimates(bench, monkeypatch):
