@@ -1,0 +1,46 @@
+"""Conformance runner contract (reference conformance/run.sh: run one
+example experiment e2e, tee a log, drop a done-file)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_conformance_runs_example_and_writes_report(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "conformance.py"),
+         "--set", "num_train_examples=512", "--set", "num_epochs=1",
+         "--max-trials", "3", "--parallel", "2",
+         "--outdir", str(tmp_path), "--timeout", "300"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-400:]
+    # the reference run.sh contract: log + done-file; plus a typed report
+    assert (tmp_path / "katib-tpu-conformance.done").exists()
+    log = (tmp_path / "katib-tpu-conformance.log").read_text()
+    assert "e2e verifier: ok" in log
+    report = json.loads((tmp_path / "katib-tpu-conformance.json").read_text())
+    assert report["pass"] is True
+    assert report["trials"] == 3 and report["trials_succeeded"] == 3
+    assert report["optimal_assignments"]
+
+
+@pytest.mark.smoke
+def test_conformance_bad_spec_fails_with_report(tmp_path):
+    spec = {"name": "broken"}  # no parameters/objective -> validation error
+    p = tmp_path / "broken.json"
+    p.write_text(json.dumps(spec))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "conformance.py"),
+         "--experiment-path", str(p), "--outdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    report = json.loads((tmp_path / "katib-tpu-conformance.json").read_text())
+    assert report["pass"] is False and report["error"]
+    assert (tmp_path / "katib-tpu-conformance.done").exists()
