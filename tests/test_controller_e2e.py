@@ -186,6 +186,29 @@ class TestBayesOptE2E:
         assert exp.status.is_succeeded
         assert exp.status.trials_succeeded == 12
 
+    def test_gp_hedge_default_labels_trials_e2e(self, controller):
+        """The reference skopt default acquisition through the full stack:
+        with no acq_func setting, post-warmup trials carry the bo-acq label
+        naming the portfolio member that nominated them, and the labels
+        survive the state store round-trip."""
+        spec = make_spec(
+            "bo-hedge-e2e", algorithm="bayesianoptimization", max_trials=10,
+            parallel=2, settings={"n_initial_points": 4, "random_state": 3},
+        )
+        controller.create_experiment(spec)
+        exp = controller.run("bo-hedge-e2e", timeout=180)
+        assert exp.status.is_succeeded
+        # assert on a FRESH store load, not the live in-memory objects, so
+        # the labels are proven to survive persistence
+        from katib_tpu.db.state import ExperimentStateStore
+
+        fresh = ExperimentStateStore(controller.state.root)
+        assert fresh.load("bo-hedge-e2e") is not None
+        trials = fresh.list_trials("bo-hedge-e2e")
+        labeled = [t.labels.get("bo-acq") for t in trials if "bo-acq" in t.labels]
+        assert labeled, "no post-warmup trial carried a portfolio-member label"
+        assert set(labeled) <= {"ei", "pi", "lcb"}
+
 
 class TestSubprocessTrialE2E:
     def test_command_template_with_stdout_collector(self, controller):
