@@ -26,3 +26,15 @@ if os.environ.get("KATIB_TPU_TEST_TPU") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def load_bench_module():
+    """Load repo-root bench.py as a module (shared by test_bench_budget's
+    fixture and the hardware-gated MFU test)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -21,11 +21,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture()
 def bench(monkeypatch):
-    spec = importlib.util.spec_from_file_location(
-        "bench_under_test", os.path.join(REPO, "bench.py")
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    from tests.conftest import load_bench_module
+
+    mod = load_bench_module()
     # isolate from the ambient env: no caps, default budgets
     for var in (
         "BENCH_TOTAL_BUDGET", "BENCH_TPU_TIMEOUT", "BENCH_CPU_TIMEOUT",

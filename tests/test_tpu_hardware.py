@@ -131,3 +131,40 @@ def test_lm_train_step_compiles_and_runs_on_tpu():
     tokens, targets, positions = put_batch(d[:, :-1], d[:, 1:])
     params, opt_state, loss = step_fn(params, opt_state, tokens, targets, positions)
     assert np.isfinite(float(loss))
+
+
+@requires_tpu
+def test_darts_mfu_stage_reports_flops_and_mfu():
+    """bench.py's reference-scale supernet MFU stage (round-5: 8 cells,
+    4 nodes, C=16, batch 128, full op set) must produce a finite step time
+    and an XLA-cost-model MFU on real hardware — or an explicit memory note
+    if the bilevel step exceeds HBM."""
+    import os
+
+    from tests.conftest import load_bench_module
+
+    bench = load_bench_module()
+    # contract check, not a measurement: 3 steps instead of the bench's 30
+    # spare the shared pool ~20x of reference-scale bilevel work
+    prev = os.environ.get("BENCH_STEPS")
+    os.environ["BENCH_STEPS"] = "3"
+    try:
+        out = bench._bench_darts_mfu(jax, np)
+    finally:
+        if prev is None:
+            os.environ.pop("BENCH_STEPS", None)
+        else:
+            os.environ["BENCH_STEPS"] = prev
+    if "error" in out:
+        # only an out-of-memory outcome is acceptable, and it must carry
+        # the documented mitigation note
+        assert "memory_note" in out, out
+        return
+    assert out["step_ms"] > 0 and np.isfinite(out["step_ms"])
+    assert out["n_params"] > 0
+    # on known hardware (the _peak_flops table covers every TPU generation
+    # this pool serves) flops AND mfu must both materialize
+    assert out["flops_per_step"], "XLA cost analysis returned no flops"
+    assert out["mfu"] is not None and 0 < out["mfu"] < 1.0, out
+    print(f"darts_mfu: step {out['step_ms']}ms, mfu {out['mfu']}, "
+          f"params {out['n_params']}, compile {out['compile_s']}s")
