@@ -164,6 +164,66 @@ def load_mnist(
     )
 
 
+DIGITS_PROVENANCE = (
+    "real UCI handwritten digits (sklearn.datasets.load_digits: 1797 8x8 "
+    "grayscale images, 10 classes) — genuine real-world data bundled with "
+    "scikit-learn, the only real image-classification dataset available "
+    "in this zero-egress environment"
+)
+
+
+def load_digits(
+    split: str = "train",
+    n: Optional[int] = None,
+    seed: int = 0,
+    image_size: int = 8,
+    channels: int = 1,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """REAL image data: the UCI handwritten-digits set bundled with
+    scikit-learn (1797 8x8 grayscale images, 10 classes).
+
+    Every other loader in this module falls back to a synthetic stand-in
+    because CIFAR-10/MNIST downloads are blocked by zero egress (round-4
+    review, Missing #1: 'real-dataset accuracy parity' was the top evidence
+    gap). This one never synthesizes: the pixels are genuine scans of
+    handwritten digits, so HPO records built on it verify the real-data
+    axis — small scale, honestly labeled (see DIGITS_PROVENANCE).
+
+    Deterministic 80/20 shuffle-split (1437 train / 360 val) with a fixed
+    split seed so train/val are disjoint across calls regardless of
+    ``seed``, which only controls subset sampling when ``n`` is given.
+    ``image_size`` (multiple of 8) nearest-neighbour-upsamples for models
+    built for larger frames; ``channels`` tiles grayscale for RGB stems.
+    Values are scaled from [0, 16] to [-1, 1]. ``n`` is capped at the
+    split's true size — 1797 real samples is what exists.
+    """
+    from sklearn.datasets import load_digits as _sk_digits
+
+    d = _sk_digits()
+    x = d.images.astype(np.float32) / 8.0 - 1.0
+    y = d.target.astype(np.int32)
+    split_rng = np.random.default_rng(7)  # split is fixed; never reseeded
+    idx = split_rng.permutation(len(x))
+    x, y = x[idx], y[idx]
+    n_train = (len(x) * 4) // 5
+    if split == "train":
+        x, y = x[:n_train], y[:n_train]
+    else:
+        x, y = x[n_train:], y[n_train:]
+    if image_size != 8:
+        if image_size % 8:
+            raise ValueError(f"image_size must be a multiple of 8, got {image_size}")
+        k = image_size // 8
+        x = np.kron(x, np.ones((1, k, k), dtype=np.float32))
+    x = x[..., None]
+    if channels > 1:
+        x = np.tile(x, (1, 1, 1, channels))
+    if n is not None and n < len(x):
+        sel = np.random.default_rng(seed).permutation(len(x))[:n]
+        x, y = x[sel], y[sel]
+    return np.ascontiguousarray(x), np.ascontiguousarray(y)
+
+
 def batches(x: np.ndarray, y: np.ndarray, batch_size: int, rng: np.random.Generator):
     """Shuffled full-epoch batch iterator (drops the ragged tail so shapes
     stay static for jit)."""

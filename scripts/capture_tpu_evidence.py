@@ -219,6 +219,11 @@ def main() -> int:
                       os.path.join(REPO, "scripts", "run_capability_records.py"),
                       "--tpu", "--timeout", "1200"],
                      1800, 2700),
+                    ("real-digits HPO (real-data axis)",
+                     [sys.executable,
+                      os.path.join(REPO, "scripts", "run_digits_hpo.py"),
+                      "--tpu", "--timeout", "900"],
+                     1000, 1100),
                     ("flash A/B dispersion",
                      [sys.executable,
                       os.path.join(REPO, "scripts", "flash_ab.py")],

@@ -129,3 +129,97 @@ class TestDiscrimination:
         good, bad = run(3e-3), run(1e-4)
         assert good > 0.6, f"good optimizer should learn the task (got {good})"
         assert good - bad > 0.2, f"no optimizer discrimination: good={good} bad={bad}"
+
+
+class TestRealDigits:
+    """load_digits is the one loader backed by REAL data (sklearn's bundled
+    UCI handwritten digits) — the round-4 review's top evidence gap was that
+    every accuracy claim rested on synthetic pixels. These pin the loader's
+    contract: genuine data, deterministic disjoint split, shape adapters."""
+
+    def test_shapes_split_and_determinism(self):
+        from katib_tpu.utils.datasets import load_digits
+
+        xtr, ytr = load_digits("train")
+        xv, yv = load_digits("test")
+        assert xtr.shape == (1437, 8, 8, 1) and xtr.dtype == np.float32
+        assert xv.shape == (360, 8, 8, 1) and yv.dtype == np.int32
+        # split is fixed and disjoint: no validation image appears in train
+        tr_keys = {xtr[i].tobytes() for i in range(len(xtr))}
+        assert not any(xv[i].tobytes() in tr_keys for i in range(len(xv)))
+        x2, y2 = load_digits("train")
+        np.testing.assert_array_equal(xtr, x2)
+        np.testing.assert_array_equal(ytr, y2)
+        # all ten digit classes present in both splits
+        assert set(ytr) == set(range(10)) and set(yv) == set(range(10))
+
+    def test_data_is_real_not_synthetic(self):
+        """Pixels must come from sklearn's bundled scans, not a generator:
+        integer sixteenths in [-1, 1], matching the 0..16 pen-stroke counts
+        of the UCI optical-recognition preprocessing."""
+        from katib_tpu.utils.datasets import load_digits
+
+        x, _ = load_digits("train")
+        assert float(x.min()) >= -1.0 and float(x.max()) <= 1.0
+        sixteenths = x * 8.0
+        np.testing.assert_allclose(sixteenths, np.round(sixteenths), atol=1e-5)
+
+    def test_upsample_tile_and_subset(self):
+        from katib_tpu.utils.datasets import load_digits
+
+        x, y = load_digits("train", n=128, image_size=16, channels=3, seed=1)
+        assert x.shape == (128, 16, 16, 3)
+        # nearest-neighbour upsample: each 2x2 block is constant
+        np.testing.assert_array_equal(x[:, 0::2, 0::2, 0], x[:, 1::2, 1::2, 0])
+        # channel tiling: grayscale replicated
+        np.testing.assert_array_equal(x[..., 0], x[..., 2])
+        with pytest.raises(ValueError):
+            load_digits("train", image_size=12)
+        # n larger than the real split is capped, not padded with fakes
+        xa, _ = load_digits("test", n=100000)
+        assert len(xa) == 360
+
+    def test_digits_discriminate_under_optimization(self):
+        """The real task must reward good hyperparameters the way the HPO
+        records claim: a sensibly-trained linear probe clears a bad-lr run
+        by a wide margin at an identical tiny budget."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from katib_tpu.utils.datasets import load_digits
+
+        xtr, ytr = load_digits("train", n=640)
+        xv, yv = load_digits("test")
+        w0 = jnp.zeros((64, 10))
+
+        def run(lr, steps=60):
+            tx = optax.adam(lr)
+            w, st = w0, tx.init(w0)
+
+            @jax.jit
+            def step(w, st, xb, yb):
+                def loss(w):
+                    lg = xb.reshape(len(xb), -1) @ w
+                    return optax.softmax_cross_entropy_with_integer_labels(
+                        lg, yb
+                    ).mean()
+
+                g = jax.grad(loss)(w)
+                up, st2 = tx.update(g, st)
+                return optax.apply_updates(w, up), st2
+
+            rng = np.random.default_rng(0)
+            i = 0
+            while i < steps:
+                for xb, yb in batches(xtr, ytr, 64, rng):
+                    w, st = step(w, st, jnp.asarray(xb), jnp.asarray(yb))
+                    i += 1
+                    if i >= steps:
+                        break
+            pred = jnp.argmax(jnp.asarray(xv).reshape(len(xv), -1) @ w, -1)
+            return float((np.asarray(pred) == yv).mean())
+
+        good, bad = run(3e-2), run(1e-5)
+        assert good > 0.8, f"real digits should be learnable (got {good})"
+        assert good - bad > 0.3, f"no discrimination on real data: {good} vs {bad}"
