@@ -224,6 +224,27 @@ def load_digits(
     return np.ascontiguousarray(x), np.ascontiguousarray(y)
 
 
+def load_dataset(
+    name: str,
+    split: str = "train",
+    n: Optional[int] = None,
+    image_size: int = 32,
+    channels: int = 3,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One-line dataset dispatch for trial workloads: ``"digits"`` is the
+    REAL bundled UCI scans adapted to the requested stem shape; anything
+    else is the CIFAR-10 loader (real npz when present, calibrated
+    synthetic stand-in otherwise). Keeps the digits adapter arguments in
+    one place so every record family trains on identically shaped data.
+    Unknown names raise — a typo must not silently train on the synthetic
+    stand-in while the record claims real-digits provenance."""
+    if name == "digits":
+        return load_digits(split, n=n, image_size=image_size, channels=channels)
+    if name in ("cifar", "cifar10"):
+        return load_cifar10(split, n=n)
+    raise ValueError(f"unknown dataset {name!r}; expected 'digits' or 'cifar'")
+
+
 def batches(x: np.ndarray, y: np.ndarray, batch_size: int, rng: np.random.Generator):
     """Shuffled full-epoch batch iterator (drops the ragged tail so shapes
     stay static for jit)."""

@@ -126,7 +126,7 @@ def _cnn_trainer(lr, steps, xtr, ytr, xv, yv):
     return float((_np.asarray(pred) == yv).mean())
 
 
-def run_enas(ctrl, timeout, scale):
+def run_enas(ctrl, timeout, scale, dataset="cifar"):
     """REINFORCE controller loop over a layer-wise op search space —
     reference e2e-test-enas-cifar10 equivalent at in-repo scale."""
     from katib_tpu.api import (
@@ -138,13 +138,14 @@ def run_enas(ctrl, timeout, scale):
     def enas_trial(assignments, ctx):
         from katib_tpu.models.enas_child import run_enas_trial
 
-        run_enas_trial(
-            {**assignments,
-             "num_epochs": str(scale["epochs"]),
-             "num_train_examples": str(scale["n_train"]),
-             "batch_size": "64"},
-            ctx,
-        )
+        overrides = {
+            "num_epochs": str(scale["epochs"]),
+            "num_train_examples": str(scale["n_train"]),
+            "batch_size": "64",
+        }
+        if dataset == "digits":
+            overrides["dataset"] = "digits"
+        run_enas_trial({**assignments, **overrides}, ctx)
 
     name = "enas-record"
     spec = ExperimentSpec(
@@ -191,7 +192,7 @@ def run_enas(ctrl, timeout, scale):
     })
 
 
-def run_hyperband(ctrl, timeout, scale):
+def run_hyperband(ctrl, timeout, scale, dataset="cifar"):
     """Bracket experiment — reference hyperband.yaml shape (lr searched,
     epochs as the halving resource)."""
     from katib_tpu.api import (
@@ -199,10 +200,10 @@ def run_hyperband(ctrl, timeout, scale):
         FeasibleSpace, ObjectiveSpec, ObjectiveType, ParameterSpec,
         ParameterType, TrialTemplate,
     )
-    from katib_tpu.utils.datasets import load_cifar10
+    from katib_tpu.utils.datasets import load_dataset
 
-    n = scale["n_train"]
-    x, y = load_cifar10("train", n=n)
+    x, y = load_dataset(dataset, "train", n=scale["n_train"])
+    n = len(x)  # digits caps at its real 1437-sample split
     split = (3 * n) // 4
     xtr, ytr, xv, yv = x[:split], y[:split], x[split:], y[split:]
     steps_per_epoch = max(split // 64, 1)
@@ -250,6 +251,10 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=1500.0)
     ap.add_argument("--tpu", action="store_true",
                     help="run on the accelerator backend (default forces CPU)")
+    ap.add_argument("--dataset", choices=["cifar", "digits"], default="cifar",
+                    help="'digits' runs on the REAL bundled UCI handwritten "
+                    "digits (sklearn) instead of the CIFAR loader's "
+                    "synthetic stand-in")
     args = ap.parse_args()
 
     if not args.tpu:
@@ -268,6 +273,12 @@ def main() -> None:
         scale = dict(trials=12, epochs=3, n_train=4096)
     else:  # 1-core box: keep each child to seconds
         scale = dict(trials=4, epochs=1, n_train=512)
+    if args.dataset == "digits":
+        # clamp to the real split size so the record's provenance reports
+        # the training data actually used, not the requested cap
+        from katib_tpu.utils.datasets import load_digits
+
+        scale["n_train"] = min(scale["n_train"], len(load_digits("train")[1]))
 
     from katib_tpu.controller.experiment import ExperimentController
 
@@ -279,13 +290,21 @@ def main() -> None:
         root = tempfile.mkdtemp(prefix=f"{which}-record-")
         ctrl = ExperimentController(root_dir=root)
         try:
-            rec = runner(ctrl, args.timeout, scale)
+            rec = runner(ctrl, args.timeout, scale, dataset=args.dataset)
             rec["platform"] = platform
             rec["device_kind"] = getattr(jax.devices()[0], "device_kind", platform)
-            from run_north_star import cifar10_provenance
+            if args.dataset == "digits":
+                from katib_tpu.utils.datasets import DIGITS_PROVENANCE
 
-            rec["dataset"] = cifar10_provenance()
-            out = os.path.join(REPO, "examples", "records", f"{which}_{platform}.json")
+                rec["dataset"] = DIGITS_PROVENANCE
+                rec["dataset_is_real"] = True
+                stem = f"{which}_{platform}_digits"
+            else:
+                from run_north_star import cifar10_provenance
+
+                rec["dataset"] = cifar10_provenance()
+                stem = f"{which}_{platform}"
+            out = os.path.join(REPO, "examples", "records", f"{stem}.json")
             with open(out, "w") as f:
                 json.dump(rec, f, indent=1)
             brief = {k: v for k, v in rec.items() if k != "trials"}
