@@ -25,6 +25,12 @@ same information surface:
   GET /experiment/<name>                        experiment detail page (live
                                                 paginated trials + log/profile
                                                 links + spec YAML/JSON)
+  GET /api/experiments/<name>/trials/<t>        full single-trial object
+                                                (assignments, condition
+                                                history, observation, times)
+  GET /experiment/<name>/trial/<t>              trial detail page (metric
+                                                chart + condition timeline +
+                                                logs + profile artifacts)
   POST /api/experiments                         create + start   [auth]
   POST /api/templates                           save template    [auth]
   DELETE /api/experiments/<name>                delete           [auth]
@@ -128,7 +134,7 @@ async function sel(n){
  const checked=new Set([...document.querySelectorAll('.cmpsel:checked')].map(c=>c.dataset.trial));
  document.getElementById('trials').innerHTML=table(ts.map((t,i)=>({
   sel:`<input type="checkbox" class="cmpsel" data-trial="${esc(t.name)}"${checked.has(t.name)?' checked':''}>`,
-  trial:esc(t.name),
+  trial:`<a href="/experiment/${encodeURIComponent(n)}/trial/${encodeURIComponent(t.name)}">${esc(t.name)}</a>`,
   status:esc(t.condition)+(t.reason&&t.reason!=='Trial'+t.condition?` <span class="muted">(${esc(t.reason)})</span>`:''),
   status_cls:t.condition,
   assignments:`<code>${esc(JSON.stringify(t.assignments))}</code>`,
@@ -317,7 +323,7 @@ async function loadTrials(){
  if(!ts.length){document.getElementById('trials').innerHTML='<i>none</i>';return}
  let h='<table><tr><th>trial</th><th>status</th><th>assignments</th><th>objective</th><th>links</th></tr>';
  for(const t of ts){
-  h+=`<tr><td>${esc(t.name)}</td>`+
+  h+=`<tr><td><a href="/experiment/${encodeURIComponent(NAME)}/trial/${encodeURIComponent(t.name)}">${esc(t.name)}</a></td>`+
    `<td class="${esc(t.condition)}">${esc(t.condition)}`+
    (t.reason&&t.reason!=='Trial'+t.condition?` <span class="muted">(${esc(t.reason)})</span>`:'')+`</td>`+
    `<td><code>${esc(JSON.stringify(t.assignments))}</code></td>`+
@@ -343,6 +349,106 @@ document.getElementById('fmtjson').onclick=()=>loadSpec('json');
 document.getElementById('fmtyaml').onclick=()=>loadSpec('yaml');
 loadHead();loadTrials();loadSpec('yaml');
 setInterval(()=>{loadHead();loadTrials()},3000);
+</script></body></html>"""
+
+
+# Dedicated trial detail page (reference Angular trial-details module,
+# pkg/ui/v1beta1/frontend/src/app/trial-details: metrics-over-time plot +
+# trial info + logs tab): per-metric time-series chart with the objective
+# metric emphasized, parameter assignments, the full condition history
+# timeline, stdout logs, and profiler artifacts — all client-rendered from
+# the JSON API so the page is one static template.
+_TRIAL_PAGE = """<!DOCTYPE html>
+<html><head><title>katib-tpu trial</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.4rem}
+table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+th,td{text-align:left;padding:.4rem .7rem;border-bottom:1px solid #eee;font-size:.9rem}
+th{background:#f0f0f3} .Succeeded{color:#0a7d36}.Failed{color:#b3261e}
+.Running{color:#0b57d0}.EarlyStopped{color:#7b5ea7} code{font-size:.85em}
+a{color:#0b57d0;text-decoration:none} a:hover{text-decoration:underline}
+.muted{color:#888;font-size:.85em}
+#logbox{background:#111;color:#ddd;padding:.8rem;font:.78rem/1.3 monospace;
+ white-space:pre-wrap;max-height:24rem;overflow:auto}
+</style></head><body>
+<div class="muted" id="crumbs"></div>
+<h1 id="title">trial</h1>
+<div id="status" class="muted">loading...</div>
+<h2>metrics</h2><div id="chart" class="muted">loading...</div>
+<h2>parameter assignments</h2><div id="assign">loading...</div>
+<h2>condition history</h2><div id="conds">loading...</div>
+<h2>profiler artifacts</h2><div id="prof" class="muted">loading...</div>
+<h2>logs</h2><pre id="logbox">loading...</pre>
+<script>
+const SEG=location.pathname.split('/').filter(Boolean);
+const EXP=decodeURIComponent(SEG[1]),TRIAL=decodeURIComponent(SEG[3]);
+const esc=s=>String(s??'').replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+async function j(u){return (await fetch(u)).json()}
+const PALETTE=['#0b57d0','#b3261e','#0a7d36','#7b5ea7','#b26a00','#00838f','#ad1457','#5d4037'];
+document.getElementById('crumbs').innerHTML=
+ `<a href="/">all experiments</a> / <a href="/experiment/${encodeURIComponent(EXP)}">${esc(EXP)}</a>`;
+async function loadTrial(){
+ const t=await j(`/api/experiments/${encodeURIComponent(EXP)}/trials/${encodeURIComponent(TRIAL)}`);
+ if(t.error){document.getElementById('status').textContent=t.error;return}
+ document.getElementById('title').textContent=TRIAL;
+ const dur=t.startTime&&t.completionTime?` &nbsp; duration ${(t.completionTime-t.startTime).toFixed(1)}s`:'';
+ document.getElementById('status').innerHTML=
+  `status <b class="${esc(t.condition)}">${esc(t.condition)}</b>`+
+  (t.message?` — ${esc(t.message)}`:'')+dur+
+  (Object.keys(t.labels||{}).length?` &nbsp; labels <code>${esc(JSON.stringify(t.labels))}</code>`:'');
+ const as=t.parameterAssignments||[];
+ document.getElementById('assign').innerHTML=as.length?
+  '<table><tr><th>parameter</th><th>value</th></tr>'+
+  as.map(a=>`<tr><td><code>${esc(a.name)}</code></td><td><code>${esc(a.value)}</code></td></tr>`).join('')+
+  '</table>':'<i>none</i>';
+ const cs=(t.conditions||[]).slice().sort((a,b)=>a.lastTransitionTime-b.lastTransitionTime);
+ document.getElementById('conds').innerHTML=cs.length?
+  '<table><tr><th>time</th><th>type</th><th>current</th><th>reason</th><th>message</th></tr>'+
+  cs.map(c=>`<tr><td class="muted">${new Date(c.lastTransitionTime*1000).toLocaleTimeString()}</td>`+
+   `<td class="${esc(c.type)}">${esc(c.type)}</td><td>${c.status?'&#10003;':''}</td>`+
+   `<td>${esc(c.reason)}</td><td class="muted">${esc(c.message)}</td></tr>`).join('')+
+  '</table>':'<i>none</i>';
+ return t.objectiveMetricName}
+function chart(rowsByMetric,objective){
+ const names=Object.keys(rowsByMetric);
+ if(!names.length)return '<i>no observations</i>';
+ const w=640,h=240,L=46,B=22,T=10,R=8;
+ const all=names.flatMap(n=>rowsByMetric[n]);
+ const mn=Math.min(...all),mx=Math.max(...all),rg=(mx-mn)||1;
+ const maxlen=Math.max(...names.map(n=>rowsByMetric[n].length));
+ const X=i=>L+(maxlen>1?i/(maxlen-1):0)*(w-L-R);
+ const Y=v=>T+(1-(v-mn)/rg)*(h-T-B);
+ let s=`<svg width="${w}" height="${h}" style="background:#fff;box-shadow:0 1px 2px #0002">`;
+ for(const f of [0,0.5,1]){const v=mn+f*rg,y=Y(v);
+  s+=`<line x1="${L}" y1="${y}" x2="${w-R}" y2="${y}" stroke="#eee"/>`+
+     `<text x="${L-4}" y="${y+3}" text-anchor="end" font-size="9" fill="#888">${v.toPrecision(3)}</text>`}
+ s+=`<text x="${(L+w-R)/2}" y="${h-6}" text-anchor="middle" font-size="9" fill="#888">report #</text>`;
+ names.forEach((nm,k)=>{const vals=rowsByMetric[nm];if(!vals.length)return;
+  const col=PALETTE[k%PALETTE.length],wd=nm===objective?2.4:1.2;
+  if(vals.length===1){s+=`<circle cx="${X(0)}" cy="${Y(vals[0])}" r="3" fill="${col}"/>`;return}
+  const pts=vals.map((v,i)=>`${X(i).toFixed(1)},${Y(v).toFixed(1)}`).join(' ');
+  s+=`<polyline points="${pts}" fill="none" stroke="${col}" stroke-width="${wd}"/>`});
+ s+='</svg>';
+ const legend=names.map((nm,k)=>
+  `<span style="color:${PALETTE[k%PALETTE.length]}">&#9632;</span> ${esc(nm)}`+
+  (nm===objective?' <span class="muted">(objective)</span>':'')).join(' &nbsp; ');
+ return s+`<div class="muted">${legend}</div>`}
+async function loadMetrics(objective){
+ const rows=await j(`/api/trials/${encodeURIComponent(TRIAL)}/metrics?limit=1000`);
+ const by={};
+ for(const r of rows){const v=parseFloat(r.value);
+  if(!isNaN(v))(by[r.metric]=by[r.metric]||[]).push(v)}
+ document.getElementById('chart').innerHTML=chart(by,objective)}
+async function loadLogs(){
+ const r=await fetch(`/api/experiments/${encodeURIComponent(EXP)}/trials/${encodeURIComponent(TRIAL)}/logs`);
+ document.getElementById('logbox').textContent=r.ok?await r.text():`no logs (${r.status})`}
+async function loadProfile(){
+ const p=await j(`/api/experiments/${encodeURIComponent(EXP)}/trials/${encodeURIComponent(TRIAL)}/profile`);
+ const arts=p.artifacts||[];
+ document.getElementById('prof').innerHTML=arts.length?
+  arts.map(a=>`<code>${esc(typeof a==='string'?a:JSON.stringify(a))}</code>`).join('<br>'):'<i>none</i>'}
+async function refresh(){const obj=await loadTrial();await loadMetrics(obj)}
+refresh();loadLogs();loadProfile();setInterval(refresh,3000);
 </script></body></html>"""
 
 
@@ -516,8 +622,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "" or path == "/":
                 return self._send(_DASHBOARD, "text/html")
             if path.startswith("/experiment/"):
-                # detail page: name is parsed client-side from the URL, so
-                # one template serves every experiment (404s surface in-page)
+                # detail pages: names are parsed client-side from the URL, so
+                # one template serves every experiment (404s surface in-page);
+                # /experiment/<name>/trial/<t> gets the trial-details view
+                page_parts = path.split("/")
+                if len(page_parts) == 5 and page_parts[3] == "trial":
+                    return self._send(_TRIAL_PAGE, "text/html")
                 return self._send(_DETAIL_PAGE, "text/html")
             if path == "/metrics":
                 return self._send(ctrl.metrics.render(), "text/plain; version=0.0.4")
@@ -579,6 +689,21 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._trial_logs(name, parts[5])
                 if sub == "trials" and len(parts) == 7 and parts[6] == "profile":
                     return self._trial_profile(name, parts[5])
+                if sub == "trials" and len(parts) == 6:
+                    # full single-trial object (trial-details page backend):
+                    # assignments, condition history, observation, times —
+                    # plus the experiment's objective metric name so the
+                    # client can emphasize it without a second fetch
+                    for t in ctrl.state.list_trials(name):
+                        if t.name == parts[5]:
+                            out = t.to_dict()
+                            out["objectiveMetricName"] = (
+                                exp.spec.objective.objective_metric_name
+                            )
+                            return self._send(out)
+                    return self._send(
+                        {"error": f"trial {parts[5]!r} not found"}, code=404
+                    )
                 if sub == "trials":
                     trials = ctrl.state.list_trials(name)
                     q = parse_qs(urlparse(self.path).query)

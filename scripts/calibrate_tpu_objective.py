@@ -47,9 +47,11 @@ PROBES = {
     "bad": {"w_lr": "0.006", "alpha_lr": "0.0003", "w_momentum": "0.6"},
 }
 
+# substituted via str.replace, NOT str.format — the body's literal {}
+# braces would be eaten as positional placeholders
 CHILD = r"""
 import json, os, sys
-sys.path.insert(0, {repo!r})
+sys.path.insert(0, __REPO__)
 if os.environ.get("CALIB_CPU") == "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -82,6 +84,7 @@ for label, assignments in probes.items():
     accs = ctx.metrics.get("Validation-accuracy", [])
     out[label] = max(accs) if accs else None
 print("CALIB_RESULT " + json.dumps(out))
+sys.stdout.flush()  # os._exit skips buffered-stdout flush
 os._exit(0)
 """
 
@@ -111,7 +114,7 @@ def main() -> None:
         t0 = time.time()
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", CHILD.format(repo=REPO)],
+                [sys.executable, "-c", CHILD.replace("__REPO__", repr(REPO))],
                 capture_output=True, text=True, timeout=args.timeout, env=env,
                 cwd=REPO,
             )

@@ -88,6 +88,33 @@ def test_healthy_probe_runs_tpu_child(bench, monkeypatch, capsys):
     assert not seen["extra"]
 
 
+def test_tpu_result_missing_darts_mfu_carries_freshest_capture(
+    bench, monkeypatch, capsys
+):
+    """A TPU run squeezed/killed before the reference-scale darts_mfu stage
+    still ships that number via the freshest watcher capture, labeled; a
+    run that measured it itself does not get the redundant attachment."""
+    monkeypatch.setattr(bench, "_probe_tpu", lambda t: ("healthy", "rt 2ms", 2.0))
+    capture = {
+        "file": "examples/records/bench_tpu_20260801.json",
+        "darts_mfu_reference_scale": 0.31,
+        "provenance": "builder watcher capture",
+    }
+    monkeypatch.setattr(bench, "_freshest_tpu_capture", lambda: dict(capture))
+
+    child_result = {"metric": "m", "value": 1.0, "extras": {}}
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda p, t, extra_env=None: (json.loads(json.dumps(child_result)), None),
+    )
+    result = _run_main(bench, capsys)
+    assert result["extras"]["freshest_tpu_capture"]["darts_mfu_reference_scale"] == 0.31
+
+    child_result["extras"] = {"darts_mfu": {"mfu": 0.28, "step_ms": 50.0}}
+    result = _run_main(bench, capsys)
+    assert "freshest_tpu_capture" not in result["extras"]
+
+
 def test_degraded_probe_still_benches_tpu_with_longer_loops(
     bench, monkeypatch, capsys
 ):

@@ -5,6 +5,7 @@ and the observability parity items (SURVEY.md §5).
 """
 
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -122,6 +123,38 @@ class TestUIServer:
         assert status == 200 and "html" in ctype
         for needle in ("page size", "loadTrials", "profile", "fmtyaml", "logs"):
             assert needle in body, needle
+
+    @pytest.mark.smoke
+    def test_single_trial_endpoint_and_page(self, stack):
+        """/api/experiments/<e>/trials/<t> returns the full trial object
+        (assignments, condition history, observation, objective metric name)
+        and /experiment/<e>/trial/<t> serves the trial-details page — the
+        Angular trial-details module (metrics plot + info + logs)."""
+        base, ctrl, _ = stack
+        trial = ctrl.state.list_trials("ui-exp")[0]
+        status, ctype, body = get(f"{base}/api/experiments/ui-exp/trials/{trial.name}")
+        assert status == 200 and "json" in ctype
+        t = json.loads(body)
+        assert t["name"] == trial.name
+        assert t["condition"] == "Succeeded"
+        assert t["objectiveMetricName"] == "score"
+        assert t["parameterAssignments"][0]["name"] == "x"
+        assert any(c["type"] == "Succeeded" and c["status"] for c in t["conditions"])
+        assert t["observation"] is not None
+        status, ctype, body = get(f"{base}/experiment/ui-exp/trial/{trial.name}")
+        assert status == 200 and "html" in ctype
+        for needle in ("condition history", "loadMetrics", "loadProfile", "logbox"):
+            assert needle in body, needle
+
+    def test_single_trial_endpoint_404(self, stack):
+        base, _, _ = stack
+        try:
+            urllib.request.urlopen(
+                f"{base}/api/experiments/ui-exp/trials/no-such-trial", timeout=10
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
 
     def test_trial_metrics(self, stack):
         base, ctrl, token = stack
