@@ -727,6 +727,62 @@ class ExperimentSpec:
         return cls.from_dict(json.loads(s))
 
 
+def load_experiment_document(text: str) -> "ExperimentSpec":
+    """Parse an experiment document in any shape a Katib user would bring:
+
+    - the plain spec mapping this package serializes (`to_dict` shape),
+      as JSON or YAML;
+    - the reference's full CRD envelope (`apiVersion: kubeflow.org/v1beta1,
+      kind: Experiment, metadata: {name}, spec: {...}` — every file under
+      reference examples/v1beta1/ is this shape): the envelope is
+      unwrapped, with `metadata.name` carried into the spec (the CRD keeps
+      the name outside `spec`).
+
+    JSON is attempted first (every JSON doc is also YAML 1.2, but going
+    through the JSON parser keeps error messages crisp for the common
+    case); YAML only on JSON failure. Non-mapping documents raise
+    ValueError rather than produce an empty spec.
+    """
+    return experiment_spec_from_mapping(parse_spec_document(text))
+
+
+def parse_spec_document(text: str) -> Any:
+    """Parse JSON-or-YAML text to the raw document (no spec conversion) —
+    shared by `load_experiment_document` and callers that need to mutate
+    the mapping before conversion (the UI's trial_template_ref)."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        try:
+            return yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ValueError(f"spec document is neither JSON nor YAML: {e}")
+
+
+def unwrap_crd_envelope(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """If ``doc`` is the Katib CRD envelope, return its ``spec`` mapping
+    (copied) with ``metadata.name`` carried in; otherwise return ``doc``
+    unchanged. The single home of the envelope predicate."""
+    if doc.get("kind") == "Experiment" and isinstance(doc.get("spec"), dict):
+        name = (doc.get("metadata") or {}).get("name", "")
+        doc = dict(doc["spec"])
+        doc.setdefault("name", name)
+    return doc
+
+
+def experiment_spec_from_mapping(doc: Any) -> "ExperimentSpec":
+    """`load_experiment_document` for an already-parsed document: unwraps
+    the CRD envelope when present, otherwise treats the mapping as the
+    plain spec shape."""
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"spec document must be a mapping, got {type(doc).__name__}"
+        )
+    return ExperimentSpec.from_dict(unwrap_crd_envelope(doc))
+
+
 # ---------------------------------------------------------------------------
 # Assignments / observations
 # ---------------------------------------------------------------------------

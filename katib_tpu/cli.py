@@ -4,7 +4,8 @@ Terminal-first replacement for the reference's Web-UI backend REST surface
 (cmd/ui/v1beta1/main.go:42-75: fetch_experiments, create_experiment,
 fetch_hp_job_info, fetch_trial_logs). Subcommands:
 
-  run <spec.json>          create an experiment from a JSON spec and drive it
+  run <spec.{json,yaml}>   create an experiment from a JSON/YAML spec (plain
+                           or Katib CRD envelope) and drive it
   resume <name>            resume a persisted experiment in a fresh controller
   list                     list experiments in a state root
   status <name>            experiment status + trial buckets + optimal trial
@@ -38,12 +39,21 @@ def _controller(root: Optional[str], devices: Optional[int] = None):
 
 
 def cmd_run(args) -> int:
-    from .api.spec import ExperimentSpec
-
+    from .api.spec import load_experiment_document
     from .api.validation import ValidationError
 
+    # JSON or YAML, plain spec or the reference's CRD envelope
+    # (apiVersion/kind/metadata/spec — the kubectl-apply shape every
+    # reference examples/v1beta1 file uses)
     with open(args.spec) as f:
-        spec = ExperimentSpec.from_dict(json.load(f))
+        try:
+            spec = load_experiment_document(f.read())
+        except (ValueError, KeyError, TypeError) as e:
+            # KeyError/TypeError: parseable document, malformed spec shape
+            # (e.g. a parameter entry missing 'name') — still a user error,
+            # still the friendly message + rc=2, not a traceback
+            print(f"invalid experiment spec: {type(e).__name__}: {e}", file=sys.stderr)
+            return 2
     ctrl = _controller(args.root, args.devices)
     try:
         ctrl.create_experiment(spec)
@@ -238,7 +248,11 @@ def main(argv=None) -> int:
     p.add_argument("--root", default=".katib-tpu", help="state root directory")
     sub = p.add_subparsers(dest="command", required=True)
 
-    run_p = sub.add_parser("run", help="create + drive an experiment from a JSON spec")
+    run_p = sub.add_parser(
+        "run",
+        help="create + drive an experiment from a JSON or YAML spec "
+        "(plain spec or the Katib CRD envelope)",
+    )
     run_p.add_argument("spec")
     run_p.add_argument("--timeout", type=float, default=None)
     run_p.add_argument("--devices", type=int, default=None, help="abstract device slots (default: 8 slots; in-process JAX trials see the real devices regardless)")

@@ -855,9 +855,24 @@ class _Handler(BaseHTTPRequestHandler):
                 ctrl.state.put_template(name, template)
                 return self._send({"saved": name}, code=201)
             if path == "/api/experiments":
-                from ..api.spec import ExperimentSpec
+                from ..api.spec import (
+                    experiment_spec_from_mapping,
+                    parse_spec_document,
+                    unwrap_crd_envelope,
+                )
 
-                payload = json.loads(body)
+                # JSON or YAML body, plain spec or the Katib CRD envelope
+                # (the Angular UI's YAML-submit path / kubectl-apply shape).
+                # Unwrap the envelope BEFORE resolving trial_template_ref so
+                # the ref works wherever the user put it — top level or
+                # inside the envelope's spec mapping.
+                payload = parse_spec_document(body)
+                if not isinstance(payload, dict):
+                    return self._send(
+                        {"error": "spec body must be a JSON or YAML mapping"},
+                        code=400,
+                    )
+                payload = unwrap_crd_envelope(payload)
                 ref = payload.pop("trial_template_ref", None)
                 if ref is not None:
                     tpl = ctrl.state.get_template(ref)
@@ -866,7 +881,7 @@ class _Handler(BaseHTTPRequestHandler):
                             {"error": f"trial_template_ref {ref!r} not found"}, code=400
                         )
                     payload["trialTemplate"] = tpl
-                spec = ExperimentSpec.from_json(json.dumps(payload))
+                spec = experiment_spec_from_mapping(payload)
                 exp = ctrl.create_experiment(spec)
 
                 def _run_quiet(name=exp.name):

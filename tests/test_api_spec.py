@@ -4,6 +4,8 @@ Models reference test files defaults_test.go and validator_test.go
 (test strategy SURVEY.md §4 tier 1).
 """
 
+import json
+
 import pytest
 
 
@@ -233,6 +235,80 @@ class TestRoundTrip:
         assert again.is_succeeded
         assert again.assignments_dict() == {"lr": "0.05"}
         assert again.start_time is not None and again.completion_time is not None
+
+
+class TestLoadExperimentDocument:
+    """JSON/YAML/CRD-envelope loader (reference kubectl-apply shape,
+    examples/v1beta1/hp-tuning/random.yaml)."""
+
+    PLAIN = {
+        "name": "doc-exp",
+        "parameters": [
+            {"name": "x", "parameterType": "double",
+             "feasibleSpace": {"min": "0", "max": "1"}}
+        ],
+        "objective": {"type": "maximize", "objectiveMetricName": "acc"},
+        "algorithm": {"algorithmName": "random"},
+        "trialTemplate": {"command": ["true"]},
+        "maxTrialCount": 2,
+    }
+
+    def test_plain_json(self):
+        from katib_tpu.api.spec import load_experiment_document
+
+        spec = load_experiment_document(json.dumps(self.PLAIN))
+        assert spec.name == "doc-exp" and spec.max_trial_count == 2
+
+    def test_plain_yaml(self):
+        import yaml
+
+        from katib_tpu.api.spec import load_experiment_document
+
+        spec = load_experiment_document(yaml.safe_dump(self.PLAIN))
+        assert spec.name == "doc-exp"
+        assert spec.parameters[0].feasible_space.min == "0"
+
+    def test_crd_envelope_carries_metadata_name(self):
+        import yaml
+
+        from katib_tpu.api.spec import load_experiment_document
+
+        body = {k: v for k, v in self.PLAIN.items() if k != "name"}
+        doc = {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Experiment",
+            "metadata": {"name": "enveloped"},
+            "spec": body,
+        }
+        spec = load_experiment_document(yaml.safe_dump(doc))
+        assert spec.name == "enveloped"
+        assert spec.algorithm.algorithm_name == "random"
+
+    def test_envelope_spec_name_wins_over_metadata(self):
+        from katib_tpu.api.spec import load_experiment_document
+
+        doc = {
+            "kind": "Experiment",
+            "metadata": {"name": "outer"},
+            "spec": dict(self.PLAIN),  # carries name=doc-exp
+        }
+        assert load_experiment_document(json.dumps(doc)).name == "doc-exp"
+
+    def test_non_mapping_rejected(self):
+        import pytest as _pytest
+
+        from katib_tpu.api.spec import load_experiment_document
+
+        with _pytest.raises(ValueError, match="mapping"):
+            load_experiment_document("[1, 2, 3]")
+
+    def test_garbage_rejected(self):
+        import pytest as _pytest
+
+        from katib_tpu.api.spec import load_experiment_document
+
+        with _pytest.raises(ValueError, match="neither JSON nor YAML"):
+            load_experiment_document("{unclosed: [")
 
 
 def test_trial_current_reason_tracks_recurring_conditions():

@@ -86,6 +86,71 @@ def test_cli_full_lifecycle(spec_path, tmp_path, capsys):
     assert main(["--root", root, "importance", "no-such-exp"]) == 1
 
 
+def test_cli_run_yaml_crd_envelope(tmp_path, capsys):
+    """`katib-tpu run <spec.yaml>` accepts the reference's kubectl-apply
+    shape (apiVersion/kind/metadata/spec envelope, YAML) — the format every
+    reference examples/v1beta1 file uses; metadata.name flows into the
+    spec."""
+    yaml_spec = f"""
+apiVersion: kubeflow.org/v1beta1
+kind: Experiment
+metadata:
+  name: cli-yaml-e2e
+spec:
+  objective:
+    type: minimize
+    objectiveMetricName: loss
+  algorithm:
+    algorithmName: random
+  parameters:
+    - name: lr
+      parameterType: double
+      feasibleSpace:
+        min: "0.1"
+        max: "0.9"
+  trialTemplate:
+    command:
+      - {sys.executable}
+      - -c
+      - print('loss=${{trialParameters.lr}}')
+    trialParameters:
+      - name: lr
+        reference: lr
+  maxTrialCount: 2
+  parallelTrialCount: 2
+"""
+    p = tmp_path / "spec.yaml"
+    p.write_text(yaml_spec)
+    root = str(tmp_path / "root")
+    rc = main(["--root", root, "run", str(p), "--timeout", "120"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "cli-yaml-e2e" in out and "2 succeeded" in out
+
+
+def test_cli_run_rejects_non_mapping_document(tmp_path, capsys):
+    p = tmp_path / "bad.yaml"
+    p.write_text("- just\n- a\n- list\n")
+    rc = main(["--root", str(tmp_path / "root"), "run", str(p)])
+    assert rc == 2
+    assert "must be a mapping" in capsys.readouterr().err
+
+
+def test_cli_run_malformed_spec_shape_is_friendly(tmp_path, capsys):
+    """A parseable document with a malformed spec shape (parameter entry
+    missing 'name') gets the friendly rc=2 message, not a traceback."""
+    p = tmp_path / "shape.yaml"
+    p.write_text(
+        "name: shape-bad\n"
+        "parameters:\n"
+        "  - parameterType: double\n"
+        "    feasibleSpace: {min: '0', max: '1'}\n"
+    )
+    rc = main(["--root", str(tmp_path / "root"), "run", str(p)])
+    assert rc == 2
+    assert "invalid experiment spec" in capsys.readouterr().err
+
+
 def test_cli_resume(tmp_path, capsys):
     """`katib-tpu resume <name>` finishes a persisted experiment in a fresh
     controller (FromVolume restart path)."""

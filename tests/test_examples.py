@@ -48,6 +48,34 @@ def test_examples_exist():
     assert len(EXAMPLES) >= 14
 
 
+YAML_EXAMPLES = sorted(
+    glob.glob(os.path.join(EXAMPLES_DIR, "**", "*.yaml"), recursive=True)
+)
+
+
+@pytest.mark.parametrize(
+    "path", YAML_EXAMPLES, ids=[os.path.basename(p) for p in YAML_EXAMPLES]
+)
+def test_yaml_example_spec_is_valid(path):
+    """YAML examples (Katib CRD envelope) load through the same
+    validate/default pipeline as the JSON ones."""
+    from katib_tpu.api.spec import load_experiment_document
+
+    with open(path) as f:
+        spec = load_experiment_document(f.read())
+    assert spec.name, path
+    set_defaults(spec)
+    validate_experiment(
+        spec,
+        known_algorithms=registered_algorithms(),
+        known_early_stopping=registered_early_stoppers(),
+    )
+
+
+def test_yaml_examples_exist():
+    assert len(YAML_EXAMPLES) >= 1
+
+
 RECORDS_DIR = os.path.join(EXAMPLES_DIR, "records")
 
 RECORDS = sorted(glob.glob(os.path.join(RECORDS_DIR, "*.json")))

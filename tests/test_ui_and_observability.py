@@ -338,6 +338,91 @@ class TestUIWriteEndpoints:
         status, _, _ = get_status(f"{base}/api/experiments/ui-posted")
         assert status == 404
 
+    def test_post_yaml_crd_envelope(self, stack):
+        """POST a YAML body in the Katib CRD envelope shape (the Angular
+        UI's YAML-submit / kubectl-apply format) — parsed, unwrapped, run."""
+        import time
+
+        base, ctrl, token = stack
+        yaml_body = """
+apiVersion: kubeflow.org/v1beta1
+kind: Experiment
+metadata:
+  name: ui-yaml-posted
+spec:
+  objective:
+    type: maximize
+    objectiveMetricName: score
+  algorithm:
+    algorithmName: random
+  parameters:
+    - name: x
+      parameterType: double
+      feasibleSpace:
+        min: "0"
+        max: "1"
+  trialTemplate:
+    command: ["python", "-c", "print('score=${trialParameters.x}')"]
+    trialParameters:
+      - name: x
+        reference: x
+  maxTrialCount: 1
+  parallelTrialCount: 1
+"""
+        req = urllib.request.Request(
+            f"{base}/api/experiments", data=yaml_body.encode(), method="POST",
+            headers={"Content-Type": "text/yaml",
+                     "Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+            assert json.loads(r.read())["created"] == "ui-yaml-posted"
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            _, _, body = get(f"{base}/api/experiments/ui-yaml-posted")
+            if json.loads(body)["status"]["conditions"][-1]["type"] == "Succeeded":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("YAML-posted experiment did not succeed in time")
+
+    def test_post_envelope_with_template_ref_inside_spec(self, stack):
+        """trial_template_ref placed inside the CRD envelope's spec mapping
+        (the natural spot for a spec field) resolves — the envelope is
+        unwrapped before ref resolution."""
+        base, ctrl, token = stack
+        ctrl.state.put_template(
+            "env-tpl",
+            {"command": ["python", "-c", "print('score=${trialParameters.x}')"],
+             "trialParameters": [{"name": "x", "reference": "x"}]},
+        )
+        doc = {
+            "kind": "Experiment",
+            "metadata": {"name": "ui-env-ref"},
+            "spec": {
+                "objective": {"type": "maximize", "objectiveMetricName": "score"},
+                "algorithm": {"algorithmName": "random"},
+                "parameters": [
+                    {"name": "x", "parameterType": "double",
+                     "feasibleSpace": {"min": "0", "max": "1"}}
+                ],
+                "trial_template_ref": "env-tpl",
+                "maxTrialCount": 1,
+                "parallelTrialCount": 1,
+            },
+        }
+        req = urllib.request.Request(
+            f"{base}/api/experiments", data=json.dumps(doc).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        exp = ctrl.state.get_experiment("ui-env-ref")
+        assert exp is not None
+        assert exp.spec.trial_template.command is not None
+
     def test_post_invalid_spec_rejected(self, stack):
         base, ctrl, token = stack
         req = urllib.request.Request(
