@@ -574,6 +574,15 @@ def _bench_flash_vs_dense(jax, np):
 def child_main(platform: str) -> None:
     if platform == "cpu":
         _force_cpu()
+    else:
+        # TPU child trains on the calibrated harder knob set, when populated
+        # (set-if-unset, before any katib_tpu.utils.datasets import), so the
+        # e2e rung's trial-accuracy distribution discriminates at the TPU
+        # scale; the CPU child stays at the datasets.py defaults its records
+        # were calibrated for. Timing stages are content-independent.
+        from katib_tpu.utils.synth_calibration import apply_tpu_rung_knobs
+
+        apply_tpu_rung_knobs()
     import jax
     import numpy as np
 

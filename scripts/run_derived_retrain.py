@@ -34,6 +34,14 @@ def main() -> None:
     if not args.tpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        # SAME dataset knobs as the --tpu search record this reproduces
+        # (set-if-unset, before the datasets import below): stage 2 on the
+        # default-knob (easier) task would extract a different genotype and
+        # append an accuracy incomparable with the record's distribution
+        from katib_tpu.utils.synth_calibration import apply_tpu_rung_knobs
+
+        apply_tpu_rung_knobs()
 
     import jax
 

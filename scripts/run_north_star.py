@@ -70,6 +70,14 @@ def main() -> None:
     if not args.tpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        # the TPU rung runs the calibrated harder knob set, when populated
+        # (set-if-unset, BEFORE datasets.py is imported anywhere), so the
+        # 50-trial distribution discriminates instead of saturating — the
+        # dataset provenance string records whatever values end up in force
+        from katib_tpu.utils.synth_calibration import apply_tpu_rung_knobs
+
+        apply_tpu_rung_knobs()
 
     import jax
 
@@ -81,6 +89,16 @@ def main() -> None:
     enable_compilation_cache()
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
+    if args.tpu and not on_tpu:
+        # fail loudly (bench.py's tpu child does the same): proceeding would
+        # run the CPU scale with the harder TPU knob set already in the
+        # environment and overwrite the default-knob CPU record series with
+        # an incomparable artifact
+        raise SystemExit(
+            "--tpu requested but JAX initialized a CPU backend "
+            "(tunnel wedged / accelerator init fell back); refusing to "
+            "write a CPU record under the TPU knob set"
+        )
     if on_tpu:
         # 192 search steps/trial: enough for good w_lr/momentum settings to
         # learn the calibrated task (CNN probe: ~0.96 reachable; tiny-scale

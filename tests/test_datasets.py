@@ -26,6 +26,38 @@ from katib_tpu.utils.datasets import (
 pytestmark = pytest.mark.smoke
 
 
+class TestTpuRungKnobs:
+    def test_apply_is_set_if_unset(self):
+        """Operator-exported KATIB_TPU_SYNTH_* values always win over the
+        calibrated TPU-rung set; unset keys are filled in."""
+        from katib_tpu.utils import synth_calibration as sc
+
+        knobs = {"KATIB_TPU_SYNTH_NOISE": "9.9", "KATIB_TPU_SYNTH_VARIANTS": "7"}
+        orig = sc.TPU_RUNG_KNOBS
+        sc.TPU_RUNG_KNOBS = knobs
+        try:
+            env = {"KATIB_TPU_SYNTH_NOISE": "0.1"}  # operator override
+            applied = sc.apply_tpu_rung_knobs(env)
+            assert env["KATIB_TPU_SYNTH_NOISE"] == "0.1"
+            assert env["KATIB_TPU_SYNTH_VARIANTS"] == "7"
+            assert applied == {"KATIB_TPU_SYNTH_VARIANTS": "7"}
+        finally:
+            sc.TPU_RUNG_KNOBS = orig
+
+    def test_knob_keys_are_real_dataset_knobs(self):
+        """Every calibrated key must be one datasets.py actually reads —
+        a typo would silently change nothing."""
+        from katib_tpu.utils import synth_calibration as sc
+
+        valid = {
+            "KATIB_TPU_SYNTH_NOISE",
+            "KATIB_TPU_SYNTH_DISTRACTOR",
+            "KATIB_TPU_SYNTH_VARIANTS",
+            "KATIB_TPU_SYNTH_LABEL_NOISE",
+        }
+        assert set(sc.TPU_RUNG_KNOBS) <= valid
+
+
 class TestGeneration:
     def test_shapes_dtypes_and_determinism(self):
         x1, y1 = load_cifar10("train", n=64, seed=3)
