@@ -255,6 +255,33 @@ def test_tpu_timeout_does_not_retry(bench, monkeypatch, capsys):
     assert calls == ["tpu", "cpu"]
 
 
+def test_darts_mfu_oom_retries_once_with_remat(bench, monkeypatch):
+    """HBM exhaustion on the plain reference-scale step triggers exactly one
+    retry with remat_cells=1; a second failure reports the remat-specific
+    memory note instead of recursing again."""
+    import katib_tpu.models.darts_trainer as dt
+
+    seen = []
+
+    class FakeSearch:
+        def __init__(self, primitives, num_layers, settings):
+            seen.append(dict(settings))
+            self.settings = settings
+
+        def build(self, shape, steps):
+            if self.settings.get("remat_cells") == "1":
+                raise RuntimeError("RESOURCE_EXHAUSTED: still 2.1G over")
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+
+    monkeypatch.setattr(dt, "DartsSearch", FakeSearch)
+    monkeypatch.setenv("BENCH_CHILD_DEADLINE", str(time.time() + 3600))
+    out = bench._bench_darts_mfu(None, __import__("numpy"))
+    assert len(seen) == 2
+    assert seen[0].get("remat_cells") is None
+    assert seen[1].get("remat_cells") == "1"
+    assert "error" in out and "even with remat_cells=1" in out["memory_note"]
+
+
 def test_checkpoint_and_salvage_roundtrip(bench, tmp_path, monkeypatch):
     """_checkpoint_stage writes atomically; _salvage recovers it and tags
     the payload as partial."""
