@@ -213,11 +213,12 @@ def main() -> int:
                 # bounded so one hang cannot eat the rest
                 for label, argv, need_s, timeout_s in (
                     # round-5 mandates: ENAS + hyperband records (review
-                    # item 8) and the dispersion-carrying flash A/B (item 7)
-                    ("capability records (enas+hyperband)",
+                    # item 8) and the dispersion-carrying flash A/B (item 7);
+                    # --which all adds the PBT protocol record
+                    ("capability records (enas+hyperband+pbt)",
                      [sys.executable,
                       os.path.join(REPO, "scripts", "run_capability_records.py"),
-                      "--tpu", "--timeout", "1200"],
+                      "--tpu", "--timeout", "1200", "--which", "all"],
                      1800, 2700),
                     ("real-digits HPO (real-data axis)",
                      [sys.executable,
