@@ -812,7 +812,17 @@ def _run_child(platform: str, timeout_s: float, extra_env=None):
     """Returns (parsed_json | None, diagnostic_str | None)."""
     import tempfile
 
-    env = dict(os.environ)
+    if platform == "cpu":
+        # strip the axon pool var AT SPAWN: the child's sitecustomize
+        # otherwise dials the tunnel before child_main()'s _force_cpu can
+        # run, and a wedged tunnel blocks jax init even under
+        # JAX_PLATFORMS=cpu — the CPU fallback must survive exactly the
+        # wedge that sent us here (katib_tpu/utils/platform_force.py)
+        from katib_tpu.utils.platform_force import cpu_child_env
+
+        env = cpu_child_env()
+    else:
+        env = dict(os.environ)
     env.update(extra_env or {})
     env["BENCH_CHILD_DEADLINE"] = str(time.time() + timeout_s)
     result_file = os.path.join(

@@ -590,7 +590,16 @@ class MultiHostExecutor(SubprocessExecutor):
         else:
             cmd = [_sys.executable, "-m", "katib_tpu.runtime.host_worker"]
 
-        base_env = dict(os.environ)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # CPU-pinned controller: strip the axon pool var AT SPAWN so a
+            # wedged tunnel can't hang the worker's jax init — the worker's
+            # own in-process pop (host_worker.py) runs only after its
+            # sitecustomize already dialed (katib_tpu/utils/platform_force.py)
+            from ..utils.platform_force import cpu_child_env
+
+            base_env = cpu_child_env()
+        else:
+            base_env = dict(os.environ)
         base_env.update(template.env)
         # workers must import katib_tpu regardless of their cwd
         repo_root = os.path.dirname(

@@ -1,0 +1,47 @@
+"""Force a process onto the CPU backend even when the TPU tunnel is wedged.
+
+Popping ``PALLAS_AXON_POOL_IPS`` inside a running interpreter is NOT
+sufficient: the axon sitecustomize has already read it at interpreter start
+and dialed the tunnel, and with that connection pending a wedged tunnel
+blocks JAX's plugin initialization even under ``JAX_PLATFORMS=cpu``.
+Measured 2026-08-01 on a fully wedged tunnel: the in-process env dance hung
+past a 1200s timeout at the first jax import, while the same workload with
+the variable stripped at process start finished in 15s.
+
+``ensure_cpu_process()`` is the one correct way for a script to force CPU:
+call it BEFORE anything imports jax. If the pool variable was present at
+interpreter start it re-execs the current process once with the variable
+stripped (the env mutation makes the second pass fall through, so no exec
+loop). Child-process spawners should instead build the child env with
+``cpu_child_env()`` so the child never sees the variable at all.
+
+This module must stay import-light (stdlib only) — it runs before JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Optional
+
+POOL_VAR = "PALLAS_AXON_POOL_IPS"
+
+
+def ensure_cpu_process() -> None:
+    """Pin this process to XLA:CPU; re-exec once if the axon pool variable
+    was present at interpreter start (see module docstring). Call before
+    any jax import; after it returns, ``import jax`` is wedge-proof."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if POOL_VAR in os.environ:
+        env = {k: v for k, v in os.environ.items() if k != POOL_VAR}
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def cpu_child_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for spawning a CPU-pinned child process: the axon pool
+    variable stripped (so its sitecustomize never dials the tunnel) and
+    ``JAX_PLATFORMS=cpu`` set."""
+    env = dict(os.environ if base is None else base)
+    env.pop(POOL_VAR, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

@@ -101,9 +101,14 @@ def main() -> None:
         [int(i) for i in args.sets.split(",")] if args.sets
         else range(len(CANDIDATES))
     )
+    if args.cpu:
+        # strip the axon pool var AT SPAWN (platform_force.py: popping it
+        # inside the child is too late under a wedged tunnel)
+        sys.path.insert(0, REPO)
+        from katib_tpu.utils.platform_force import cpu_child_env
     for i in idxs:
         noise, distractor, variants = CANDIDATES[i]
-        env = dict(os.environ)
+        env = cpu_child_env() if args.cpu else dict(os.environ)
         env.update({
             "KATIB_TPU_SYNTH_NOISE": str(noise),
             "KATIB_TPU_SYNTH_DISTRACTOR": str(distractor),

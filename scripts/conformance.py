@@ -47,8 +47,9 @@ def main() -> int:
     args = ap.parse_args()
 
     if not args.tpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        from katib_tpu.utils.platform_force import ensure_cpu_process
+
+        ensure_cpu_process()
     import jax
 
     if not args.tpu:

@@ -32,8 +32,9 @@ def main() -> None:
     args = ap.parse_args()
 
     if not args.tpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        from katib_tpu.utils.platform_force import ensure_cpu_process
+
+        ensure_cpu_process()
     else:
         # SAME dataset knobs as the --tpu search record this reproduces
         # (set-if-unset, before the datasets import below): stage 2 on the

@@ -68,8 +68,9 @@ def main() -> None:
     args = ap.parse_args()
 
     if not args.tpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        from katib_tpu.utils.platform_force import ensure_cpu_process
+
+        ensure_cpu_process()
     else:
         # the TPU rung runs the calibrated harder knob set, when populated
         # (set-if-unset, BEFORE datasets.py is imported anywhere), so the

@@ -174,9 +174,12 @@ def main() -> int:
 
     force_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     if force_cpu:
-        # honor an explicit CPU request: the axon sitecustomize otherwise
-        # pins the TPU platform and a wedged tunnel hangs backend init
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        # honor an explicit CPU request: re-exec with the axon pool var
+        # stripped when needed — popping it in-process is too late under a
+        # wedged tunnel (katib_tpu/utils/platform_force.py)
+        from katib_tpu.utils.platform_force import ensure_cpu_process
+
+        ensure_cpu_process()
 
     import numpy as np
 

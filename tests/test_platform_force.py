@@ -1,0 +1,53 @@
+"""platform_force: wedge-proof CPU forcing (see module docstring there —
+popping the axon pool var in-process is too late once the sitecustomize has
+dialed a wedged tunnel; measured 2026-08-01)."""
+
+import os
+
+import pytest
+
+from katib_tpu.utils import platform_force as pf
+
+pytestmark = pytest.mark.smoke
+
+
+def test_cpu_child_env_strips_pool_var_and_pins_cpu():
+    base = {"PALLAS_AXON_POOL_IPS": "10.0.0.1", "OTHER": "x"}
+    env = pf.cpu_child_env(base)
+    assert pf.POOL_VAR not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["OTHER"] == "x"
+    assert base["PALLAS_AXON_POOL_IPS"] == "10.0.0.1"  # input untouched
+
+
+def test_cpu_child_env_defaults_to_os_environ(monkeypatch):
+    monkeypatch.setenv(pf.POOL_VAR, "10.0.0.9")
+    env = pf.cpu_child_env()
+    assert pf.POOL_VAR not in env and env["JAX_PLATFORMS"] == "cpu"
+    assert os.environ[pf.POOL_VAR] == "10.0.0.9"  # os.environ untouched
+
+
+def test_ensure_cpu_process_reexecs_once_when_pool_var_present(monkeypatch):
+    monkeypatch.setenv(pf.POOL_VAR, "10.0.0.9")
+    # pre-seed via monkeypatch so teardown restores the suite's real value
+    # (the function mutates os.environ directly)
+    monkeypatch.setenv("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+    calls = []
+    monkeypatch.setattr(os, "execve", lambda exe, argv, env: calls.append((exe, argv, env)))
+    pf.ensure_cpu_process()
+    assert len(calls) == 1
+    exe, argv, env = calls[0]
+    assert pf.POOL_VAR not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert argv[0] == exe  # re-exec of this interpreter
+
+
+def test_ensure_cpu_process_no_reexec_without_pool_var(monkeypatch):
+    monkeypatch.delenv(pf.POOL_VAR, raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+    monkeypatch.setattr(
+        os, "execve",
+        lambda *a: (_ for _ in ()).throw(AssertionError("must not exec")),
+    )
+    pf.ensure_cpu_process()
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
