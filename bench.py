@@ -772,6 +772,7 @@ def _north_star_summary(relpath: str):
         "platform": rec.get("platform"),
         "best_val_acc": rec.get("best_val_acc"),
         "median_val_acc": rec.get("median_val_acc"),
+        "acc_quartiles": rec.get("acc_quartiles"),
         "derived_retrain_val_acc": (rec.get("derived_retrain") or {}).get(
             "retrain_val_acc"
         ),
