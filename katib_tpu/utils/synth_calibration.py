@@ -25,7 +25,20 @@ from typing import Dict, MutableMapping, Optional
 # Chosen by scripts/calibrate_tpu_objective.py (good/mid/bad optimizer
 # probes at the exact north-star TPU scale). Values are strings because
 # they land in os.environ.
-TPU_RUNG_KNOBS: Dict[str, str] = {}
+#
+# Current set: candidate 1 (noise 1.0 / distractor 0.6 / variants 6).
+# Provenance: the on-chip sweep measured candidate 0 (0.8/0.5/6) still
+# saturating at the optimum (supernet good-probe 0.983) before the tunnel
+# wedged; a CPU CNN-proxy sweep (2026-08-01, /tmp sweep recorded in the
+# round-5 map) placed candidate 1 at 3x candidate 0's difficulty (CNN
+# good-probe 0.596 -> 0.203) with candidates 2-3 at chance, bracketing
+# the sub-saturating ceiling between 1 and 2. On-chip confirmation
+# re-stamps this block when a tunnel window opens.
+TPU_RUNG_KNOBS: Dict[str, str] = {
+    "KATIB_TPU_SYNTH_NOISE": "1.0",
+    "KATIB_TPU_SYNTH_DISTRACTOR": "0.6",
+    "KATIB_TPU_SYNTH_VARIANTS": "6",
+}
 
 
 def apply_tpu_rung_knobs(

@@ -36,13 +36,33 @@ def main() -> None:
 
         ensure_cpu_process()
     else:
-        # SAME dataset knobs as the --tpu search record this reproduces
-        # (set-if-unset, before the datasets import below): stage 2 on the
-        # default-knob (easier) task would extract a different genotype and
-        # append an accuracy incomparable with the record's distribution
-        from katib_tpu.utils.synth_calibration import apply_tpu_rung_knobs
+        # SAME dataset knobs as the search record this reproduces — taken
+        # from the RECORD's own provenance string, not the repo's current
+        # TPU-rung set (which may have been recalibrated since the record
+        # was captured): stage 2 on a different-difficulty task would
+        # extract a different genotype and append an accuracy incomparable
+        # with the record's distribution. Must happen before any
+        # katib_tpu.utils.datasets import (knobs are read there at import).
+        import re
 
-        apply_tpu_rung_knobs()
+        with open(args.record) as f:
+            _prov = json.load(f).get("dataset", "")
+        _knobs = {
+            "KATIB_TPU_SYNTH_NOISE": r"noise=([\d.]+)",
+            "KATIB_TPU_SYNTH_DISTRACTOR": r"distractor=([\d.]+)",
+            "KATIB_TPU_SYNTH_VARIANTS": r"variants=(\d+)",
+            "KATIB_TPU_SYNTH_LABEL_NOISE": r"train_label_noise=([\d.]+)",
+        }
+        _parsed = {k: m.group(1) for k, pat in _knobs.items()
+                   if (m := re.search(pat, _prov))}
+        if _parsed:
+            os.environ.update(_parsed)
+        else:
+            # real-CIFAR or legacy record with no knob provenance: fall
+            # back to the current TPU-rung set (set-if-unset)
+            from katib_tpu.utils.synth_calibration import apply_tpu_rung_knobs
+
+            apply_tpu_rung_knobs()
 
     import jax
 
