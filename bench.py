@@ -423,6 +423,100 @@ def _bench_e2e_experiment(jax, np, on_tpu: bool, darts=None):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _bench_pack_throughput(jax, np):
+    """Vmapped trial packing (controller/packing.py): N small MNIST-CNN
+    trials run twice THROUGH the framework — sequentially (pack_size=1,
+    parallel=1, each trial paying its own dispatch + compile) and as one
+    packed vmapped program (pack_size=N) — and the trials/sec ratio is the
+    packing win. Per-trial objective metrics must be bit-identical between
+    the two runs (same member program, K=1 vs K=N; tests/test_packing.py
+    pins the same invariant at smaller N)."""
+    import shutil
+    import tempfile
+
+    from katib_tpu.api import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
+    )
+    from katib_tpu.api.spec import TrialResources
+    from katib_tpu.controller.experiment import ExperimentController
+
+    n_trials = int(os.environ.get("BENCH_PACK_TRIALS", "16"))
+    lrs = ["%0.4f" % (0.005 + 0.005 * i) for i in range(n_trials)]
+
+    def run(pack_size: int):
+        root = tempfile.mkdtemp(prefix="bench-pack-")
+        ctrl = ExperimentController(root_dir=root)
+        try:
+            spec = ExperimentSpec(
+                name="bench-pack-throughput",
+                parameters=[
+                    ParameterSpec(
+                        "lr", ParameterType.DISCRETE, FeasibleSpace(list=lrs)
+                    ),
+                    # shape-affecting knobs: single-value spaces, uniform
+                    # across the pack (docs/trial-packing.md)
+                    ParameterSpec(
+                        "num_train_examples", ParameterType.DISCRETE,
+                        FeasibleSpace(list=["256"]),
+                    ),
+                    ParameterSpec(
+                        "batch_size", ParameterType.DISCRETE,
+                        FeasibleSpace(list=["64"]),
+                    ),
+                    ParameterSpec(
+                        "conv1_channels", ParameterType.DISCRETE,
+                        FeasibleSpace(list=["8"]),
+                    ),
+                    ParameterSpec(
+                        "conv2_channels", ParameterType.DISCRETE,
+                        FeasibleSpace(list=["16"]),
+                    ),
+                    ParameterSpec(
+                        "hidden_size", ParameterType.DISCRETE,
+                        FeasibleSpace(list=["64"]),
+                    ),
+                ],
+                objective=ObjectiveSpec(
+                    type=ObjectiveType.MAXIMIZE,
+                    objective_metric_name="accuracy",
+                    additional_metric_names=["loss"],
+                ),
+                algorithm=AlgorithmSpec("grid"),
+                trial_template=TrialTemplate(
+                    entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial_packed",
+                    resources=TrialResources(pack_size=pack_size),
+                ),
+                max_trial_count=n_trials,
+                parallel_trial_count=max(pack_size, 1),
+            )
+            ctrl.create_experiment(spec)
+            t0 = time.time()
+            ctrl.run("bench-pack-throughput", timeout=_child_remaining() - 20.0)
+            wall = time.time() - t0
+            metrics = {}
+            for t in ctrl.state.list_trials("bench-pack-throughput"):
+                logs = ctrl.obs_store.get_observation_log(t.name, metric_name="accuracy")
+                metrics[t.assignments_dict()["lr"]] = [l.value for l in logs]
+            return wall, metrics
+        finally:
+            ctrl.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    seq_wall, seq_metrics = run(1)
+    pack_wall, pack_metrics = run(n_trials)
+    return {
+        "n_trials": n_trials,
+        "workload": "small mnist-cnn 8/16/64 (256 train examples, batch 64, 1 epoch)",
+        "sequential_s": round(seq_wall, 2),
+        "packed_s": round(pack_wall, 2),
+        "sequential_trials_per_s": round(n_trials / seq_wall, 3),
+        "packed_trials_per_s": round(n_trials / pack_wall, 3),
+        "speedup": round(seq_wall / pack_wall, 2),
+        "bit_identical_metrics": seq_metrics == pack_metrics,
+    }
+
+
 def _bench_darts_mfu(jax, np, remat: bool = False):
     """TPU-only: the DARTS supernet at the REFERENCE search configuration —
     8 cells, 4 nodes, init_channels 16, batch 128, the full 7-op primitive
@@ -687,6 +781,13 @@ def child_main(platform: str) -> None:
             })
         except Exception as e:
             extras["lm"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if os.environ.get("BENCH_SKIP_PACK") != "1" and gate("pack_throughput", 150.0):
+        try:
+            extras["pack_throughput"] = _bench_pack_throughput(jax, np)
+        except Exception as e:
+            extras["pack_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
     # darts_mfu runs BEFORE the cheaper lm_large/flash stages: it is the

@@ -540,6 +540,11 @@ class TrialResources:
     num_devices: int = 1          # TPU chips (or virtual CPU devices in tests)
     num_hosts: int = 1            # multi-host slice width (DCN processes)
     topology: Optional[str] = None  # e.g. "2x2" — default ctx.mesh() shape
+    # Vmapped trial packing (controller/packing.py): up to pack_size pending
+    # in-process trials with identical templates and all-scalar assignments
+    # share ONE device allocation and ONE compiled (vmap'ed) train loop.
+    # 1 = no packing; requires an in-process single-host template.
+    pack_size: int = 1
 
     def topology_dims(self) -> Optional[List[int]]:
         return parse_topology(self.topology)
@@ -548,6 +553,8 @@ class TrialResources:
         d: Dict[str, Any] = {"numDevices": self.num_devices, "numHosts": self.num_hosts}
         if self.topology:
             d["topology"] = self.topology
+        if self.pack_size != 1:
+            d["packSize"] = self.pack_size
         return d
 
     @classmethod
@@ -556,6 +563,7 @@ class TrialResources:
             num_devices=int(d.get("numDevices", 1)),
             num_hosts=int(d.get("numHosts", 1)),
             topology=d.get("topology"),
+            pack_size=int(d.get("packSize", 1)),
         )
 
 

@@ -236,6 +236,23 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
 
     if t.resources.num_hosts < 1:
         errs.append("trialTemplate.resources.numHosts must be >= 1")
+    if t.resources.pack_size < 1:
+        errs.append("trialTemplate.resources.packSize must be >= 1")
+    elif t.resources.pack_size > 1:
+        # packing vmaps an in-process train loop over the member population;
+        # a subprocess has nothing to vmap and a multi-host gang already owns
+        # its own process group (controller/packing.py packability rules)
+        if t.command is not None:
+            errs.append(
+                "trialTemplate.resources.packSize > 1 requires an in-process "
+                "template (entryPoint or function) — command templates run "
+                "as subprocesses and cannot be vmapped"
+            )
+        if t.resources.num_hosts > 1:
+            errs.append(
+                "trialTemplate.resources.packSize > 1 is incompatible with "
+                "numHosts > 1"
+            )
     if t.resources.topology:
         dims = t.resources.topology_dims()
         if dims is None:

@@ -211,8 +211,47 @@ def _free_port() -> int:
         return port
 
 
+class _AdaptivePoll:
+    """Adaptive sleep for the subprocess wait loops: the base interval while
+    the trial shows signs of life (process exit checks stay cheap), doubling
+    toward a 1s ceiling once the trial has been quiet — no exit, no tailed
+    metric lines, no fresh scrape rows — for ``backoff_after`` seconds. A
+    long-running silent trial shouldn't cost the controller 10 wakeups/sec
+    per trial. ``adaptive=False`` (an explicit poll_interval override) pins
+    the base interval."""
+
+    def __init__(
+        self,
+        base: float,
+        backoff_after: float = 30.0,
+        maximum: float = 1.0,
+        adaptive: bool = True,
+    ):
+        self.base = base
+        self.backoff_after = backoff_after
+        self.maximum = max(maximum, base)
+        self.adaptive = adaptive
+        self._quiet_since = time.time()
+        self._delay = base
+
+    def activity(self, now: Optional[float] = None) -> None:
+        self._quiet_since = time.time() if now is None else now
+        self._delay = self.base
+
+    def next_delay(self, now: Optional[float] = None) -> float:
+        if not self.adaptive:
+            return self.base
+        now = time.time() if now is None else now
+        if now - self._quiet_since < self.backoff_after:
+            return self.base
+        self._delay = min(self._delay * 2, self.maximum)
+        return self._delay
+
+
 class SubprocessExecutor:
     POLL_INTERVAL = 0.1
+    POLL_BACKOFF_AFTER = 30.0  # seconds of quiet before backoff engages
+    POLL_BACKOFF_MAX = 1.0     # backoff ceiling
 
     def __init__(self, obs_store: ObservationStore, db_path: Optional[str] = None):
         self.obs_store = obs_store
@@ -346,7 +385,9 @@ class SubprocessExecutor:
     ) -> Optional[ExecutionResult]:
         """Poll for exit; tail output applying stop rules (the reference
         sidecar's watchMetricsFile loop); scrape the trial's Prometheus
-        endpoint when the collector kind asks for it."""
+        endpoint when the collector kind asks for it. The poll interval
+        adapts: 0.1s while the trial emits output/metrics, backing off
+        exponentially to 1s after 30s of quiet (see _AdaptivePoll)."""
         watch_path = metrics_file or stdout_path
         scrape = (
             spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
@@ -356,6 +397,7 @@ class SubprocessExecutor:
         last_scrape = 0.0
         last_scraped: Dict[str, Any] = {}  # metric -> (value, recorded_at)
         tailer = self._make_stop_tailer(spec, watch_path) if monitor else None
+        poll = self._make_poll()
         try:
             while True:
                 if handle.kill_requested:
@@ -364,12 +406,18 @@ class SubprocessExecutor:
                 rc = proc.poll()
                 if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
                     last_scrape = time.time()
+                    before = len(prom_logs)
                     stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    if len(prom_logs) > before:
+                        poll.activity()
                     if stopped is not None:
                         self._terminate(proc)
                         return stopped
                 if tailer is not None:
-                    for name, raw, _idx in tailer.poll():
+                    parsed = tailer.poll()
+                    if parsed:
+                        poll.activity()
+                    for name, raw, _idx in parsed:
                         try:
                             value = float(raw)
                         except ValueError:
@@ -386,10 +434,21 @@ class SubprocessExecutor:
                         # README metrics-collector notes.)
                         self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
                     return None
-                time.sleep(self.POLL_INTERVAL)
+                time.sleep(poll.next_delay())
         finally:
             if tailer is not None:
                 tailer.close()
+
+    def _make_poll(self) -> _AdaptivePoll:
+        # an explicit poll_interval override (KatibConfig
+        # metrics_poll_interval — the scheduler sets the INSTANCE attribute)
+        # pins the interval and disables backoff
+        return _AdaptivePoll(
+            self.POLL_INTERVAL,
+            backoff_after=self.POLL_BACKOFF_AFTER,
+            maximum=self.POLL_BACKOFF_MAX,
+            adaptive="POLL_INTERVAL" not in self.__dict__,
+        )
 
     @staticmethod
     def _make_stop_tailer(spec: ExperimentSpec, watch_path: str):
@@ -760,7 +819,8 @@ class MultiHostExecutor(SubprocessExecutor):
         handle: TrialExecution,
         prom_logs: List[MetricLog],
     ) -> Optional[ExecutionResult]:
-        """Poll the gang; returns None only when EVERY worker exited 0."""
+        """Poll the gang; returns None only when EVERY worker exited 0.
+        Same adaptive backoff as the single-process wait loop."""
         watch_path = metrics_file or stdout_path
         scrape = (
             spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
@@ -769,6 +829,7 @@ class MultiHostExecutor(SubprocessExecutor):
         last_scrape = 0.0
         last_scraped: Dict[str, Any] = {}
         tailer = self._make_stop_tailer(spec, watch_path) if monitor else None
+        poll = self._make_poll()
         try:
             while True:
                 if handle.kill_requested:
@@ -787,12 +848,18 @@ class MultiHostExecutor(SubprocessExecutor):
                         )
                 if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
                     last_scrape = time.time()
+                    before = len(prom_logs)
                     stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
+                    if len(prom_logs) > before:
+                        poll.activity()
                     if stopped is not None:
                         self._terminate_gang(procs)
                         return stopped
                 if tailer is not None:
-                    for name, raw, _idx in tailer.poll():
+                    parsed = tailer.poll()
+                    if parsed:
+                        poll.activity()
+                    for name, raw, _idx in parsed:
                         try:
                             value = float(raw)
                         except ValueError:
@@ -804,7 +871,7 @@ class MultiHostExecutor(SubprocessExecutor):
                     if scrape:
                         self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
                     return None
-                time.sleep(self.POLL_INTERVAL)
+                time.sleep(poll.next_delay())
         finally:
             if tailer is not None:
                 tailer.close()

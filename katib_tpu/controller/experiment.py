@@ -235,12 +235,19 @@ class ExperimentController:
         requests = len(trials) + add_count - incomplete_es
 
         assignments = self.suggestions.sync_assignments(exp, trials, requests)
+        # Deferred dispatch: queue the whole batch first, then one dispatch
+        # pass — pack formation (controller/packing.py) needs the batch's
+        # packable trials waiting TOGETHER, or the first would start solo on
+        # free devices before its pack-mates are submitted.
         for assignment in assignments[:add_count]:
             trial = Trial.from_assignment(assignment, exp.name)
             trial.labels["katib-tpu/experiment"] = exp.name
             self.state.create_trial(trial)
             checkpoint_dir = self._checkpoint_dir_for(exp, trial)
-            self.scheduler.submit(exp, trial, checkpoint_dir=checkpoint_dir)
+            self.scheduler.submit(
+                exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
+            )
+        self.scheduler.dispatch()
 
     @staticmethod
     def _observation_available(exp: Experiment, trial: Trial) -> bool:

@@ -1,0 +1,235 @@
+"""Trial packing — run K compatible HPO trials as one compiled program.
+
+The scheduler half of vmapped trial packing (the runtime half is
+katib_tpu.runtime.packed): pack *formation* rules decide which pending
+trials may share a device allocation and a compiled train loop, and the
+:class:`PackedTrialExecutor` runs a formed pack to completion, producing one
+independent :class:`ExecutionResult` per member.
+
+Packability (docs/trial-packing.md):
+
+- the trial template is in-process (``function`` or ``entry_point`` — a
+  subprocess/command trial has nothing to vmap) and single-host;
+- the experiment opted in (``resources.pack_size > 1``) or the resolved
+  trial function declares ``supports_packing = True`` (auto-detection, pack
+  size then defaults to :data:`AUTO_PACK_SIZE`);
+- every parameter assignment is a runtime scalar (parses as float) — a
+  shape-affecting or categorical parameter would force per-member
+  recompilation, defeating the point;
+- members come from the same experiment/template: mixed templates never
+  pack (plan_packs groups by experiment name + template identity).
+
+Fallback is strict: a trial that fails any check runs through the existing
+``InProcessExecutor`` unchanged, and a *member* failure (ctx.fail_member,
+per-member kill, early-stop) fails/finalizes only that member. Only an
+exception escaping the pack function itself — one shared program, so there
+is genuinely no per-member blame to assign — fails every still-active
+member.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.status import Experiment, Trial
+from ..db.store import ObservationStore
+from ..runtime.metrics import EarlyStopped, TrialKilled, set_current_reporter
+from ..runtime.packed import PackedTrialContext, PackFrozen
+from .executor import (
+    ExecutionResult,
+    TrialExecution,
+    TrialOutcome,
+    resolve_entry_point,
+)
+
+# Pack size used when packability is auto-detected (supports_packing on the
+# trial function) but the spec left resources.pack_size at 1.
+AUTO_PACK_SIZE = 8
+
+# Label stamped on every packed member: pack id + occupancy, so the UI and
+# postmortems can tell which trials shared a program.
+PACK_LABEL = "katib-tpu/pack"
+
+
+def _resolved_function(exp: Experiment):
+    """The in-process callable this template runs, or None (command
+    template, or an entry point that fails to import — the latter will fail
+    loudly in the normal executor path, not here)."""
+    template = exp.spec.trial_template
+    if template.command is not None:
+        return None
+    try:
+        return resolve_entry_point(template)
+    except Exception:
+        return None
+
+
+def pack_capacity(exp: Experiment) -> int:
+    """Effective pack size K for this experiment: the spec opt-in wins;
+    otherwise auto-detected packability (supports_packing on the trial
+    function) packs at AUTO_PACK_SIZE; else 1 (no packing)."""
+    res = exp.spec.trial_template.resources
+    if res.num_hosts > 1:
+        return 1
+    if res.pack_size > 1:
+        return res.pack_size
+    fn = _resolved_function(exp)
+    if fn is not None and getattr(fn, "supports_packing", False):
+        return AUTO_PACK_SIZE
+    return 1
+
+
+def unpackable_reason(exp: Experiment, trial: Trial) -> Optional[str]:
+    """None when this trial may join a pack, else a human-readable reason —
+    the strict-fallback predicate. Checked per trial because packability
+    depends on the *assignments* (all runtime scalars), not just the
+    template."""
+    template = exp.spec.trial_template
+    if template.command is not None:
+        return "command templates run as subprocesses"
+    if template.resources.num_hosts > 1:
+        return "multi-host trials form their own gang"
+    if pack_capacity(exp) <= 1:
+        return "experiment did not opt into packing"
+    for a in trial.parameter_assignments:
+        try:
+            float(a.value)
+        except (TypeError, ValueError):
+            return (
+                f"parameter {a.name}={a.value!r} is not a runtime scalar"
+            )
+    return None
+
+
+def plan_packs(
+    waiting: Sequence[Tuple[Experiment, Trial]],
+) -> List[Tuple[Experiment, List[Trial]]]:
+    """Group the waiting queue into dispatch units, preserving order.
+
+    Returns ``[(exp, [trial, ...]), ...]`` where a singleton list is a solo
+    dispatch (normal executor) and a longer list is a pack. Members are
+    grouped by (experiment name, template identity) — mixed templates never
+    pack — and capped at the experiment's pack capacity K."""
+    units: List[Tuple[Experiment, List[Trial]]] = []
+    open_packs: Dict[Tuple[str, int], Tuple[int, int]] = {}  # key -> (unit idx, K)
+    for exp, trial in waiting:
+        key = (exp.name, id(exp.spec.trial_template))
+        if unpackable_reason(exp, trial) is not None:
+            units.append((exp, [trial]))
+            continue
+        k = pack_capacity(exp)
+        slot = open_packs.get(key)
+        if slot is not None and len(units[slot[0]][1]) < slot[1]:
+            units[slot[0]][1].append(trial)
+            continue
+        units.append((exp, [trial]))
+        open_packs[key] = (len(units) - 1, k)
+    return units
+
+
+def stack_assignments(trials: Sequence[Trial]) -> Dict[str, np.ndarray]:
+    """Stack K members' scalar assignments into ``{name: float32 [K]}``.
+    Members may have different parameter *sets* only if a name is missing
+    everywhere or present everywhere (same search space ⇒ always true)."""
+    names: List[str] = []
+    for t in trials:
+        for a in t.parameter_assignments:
+            if a.name not in names:
+                names.append(a.name)
+    out: Dict[str, np.ndarray] = {}
+    for name in names:
+        col = []
+        for t in trials:
+            value = t.assignments_dict().get(name)
+            if value is None:
+                raise ValueError(
+                    f"pack member {t.name} is missing parameter {name!r}"
+                )
+            col.append(float(value))
+        out[name] = np.asarray(col, dtype=np.float32)
+    return out
+
+
+class PackedTrialExecutor:
+    """Run one formed pack: a single call of the pack-aware trial function
+    over the stacked population, then per-member outcome derivation from the
+    context's masking state."""
+
+    def __init__(self, obs_store: ObservationStore):
+        self.obs_store = obs_store
+        self._cache_enabled = False
+
+    def execute(
+        self,
+        exp: Experiment,
+        trials: Sequence[Trial],
+        ctx: PackedTrialContext,
+        handles: Sequence[TrialExecution],
+    ) -> List[ExecutionResult]:
+        if not self._cache_enabled:
+            self._cache_enabled = True
+            try:
+                from ..utils.compilation import enable_compilation_cache
+
+                enable_compilation_cache()
+            except Exception:
+                pass
+        fn = resolve_entry_point(exp.spec.trial_template)
+        pack_error: Optional[str] = None
+        # no contextvar reporter: report_metrics() inside a pack-aware fn
+        # would have no member to demux to — the fn must go through ctx
+        token = set_current_reporter(None)
+        try:
+            result = fn(ctx.assignments, ctx)
+            if isinstance(result, dict):
+                numeric = {
+                    k: v
+                    for k, v in result.items()
+                    if isinstance(v, (int, float, np.ndarray))
+                }
+                if numeric:
+                    ctx.report(**numeric)
+        except (PackFrozen, EarlyStopped, TrialKilled):
+            pass  # every member already carries its own terminal mask
+        except Exception:
+            # one shared compiled program: an escaping exception has no
+            # per-member blame, so every still-ACTIVE member fails; members
+            # already frozen (stopped/killed/failed earlier) keep their own
+            # outcome — a member failure never fails the pack, but a pack
+            # failure necessarily fails its survivors
+            pack_error = traceback.format_exc(limit=10)
+        finally:
+            from ..runtime import metrics as _m
+
+            _m._current_reporter.reset(token)
+
+        results: List[ExecutionResult] = []
+        for i, (stopped, killed, failed, fail_msg) in enumerate(
+            ctx.member_outcomes()
+        ):
+            if failed:
+                results.append(
+                    ExecutionResult(TrialOutcome.FAILED, fail_msg, exit_code=1)
+                )
+            elif killed:
+                results.append(
+                    ExecutionResult(TrialOutcome.KILLED, "kill requested")
+                )
+            elif stopped:
+                results.append(ExecutionResult(TrialOutcome.EARLY_STOPPED))
+            elif pack_error is not None:
+                results.append(
+                    ExecutionResult(TrialOutcome.FAILED, pack_error, exit_code=1)
+                )
+            elif handles[i].kill_requested:
+                results.append(
+                    ExecutionResult(TrialOutcome.KILLED, "kill requested")
+                )
+            else:
+                results.append(
+                    ExecutionResult(TrialOutcome.COMPLETED, exit_code=0)
+                )
+        return results

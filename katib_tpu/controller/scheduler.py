@@ -126,7 +126,18 @@ class TrialScheduler:
 
     LINEAGE_LABEL = "checkpoint-lineage"
 
-    def submit(self, exp: Experiment, trial: Trial, checkpoint_dir: Optional[str] = None) -> None:
+    def submit(
+        self,
+        exp: Experiment,
+        trial: Trial,
+        checkpoint_dir: Optional[str] = None,
+        dispatch: bool = True,
+    ) -> None:
+        """Queue a trial. ``dispatch=False`` defers the dispatch pass so a
+        caller submitting a batch (one reconcile's worth of suggestions) can
+        queue them all first and call :meth:`dispatch` once — without this,
+        the first packable trial of a batch would start solo before its
+        pack-mates arrive (controller/packing.py)."""
         if checkpoint_dir:
             # Persisted marker (the _checkpoint_dirs entry is transient —
             # popped on start): this trial trains FROM a parent checkpoint,
@@ -159,6 +170,12 @@ class TrialScheduler:
             return
         with self._lock:
             self._waiting.append((exp, trial))
+        if dispatch:
+            self._dispatch()
+
+    def dispatch(self) -> None:
+        """Start every waiting trial/pack whose gang allocation fits (the
+        public form of the internal dispatch pass, for deferred submits)."""
         self._dispatch()
 
     def _reuse_duplicate(self, exp: Experiment, trial: Trial) -> bool:
@@ -289,28 +306,90 @@ class TrialScheduler:
     # -- dispatch loop -------------------------------------------------------
 
     def _dispatch(self) -> None:
-        """Start every waiting trial whose gang allocation fits."""
+        """Start every waiting trial/pack whose gang allocation fits.
+
+        Waiting trials are first grouped into dispatch units by
+        packing.plan_packs: packable same-template trials of one experiment
+        merge into packs of up to K = pack_capacity(exp) members sharing ONE
+        gang allocation and one compiled program; everything else dispatches
+        solo through the unchanged per-trial path."""
+        from .packing import plan_packs
+
         with self._lock:
             self._threads = [t for t in self._threads if t.is_alive()]
-            still_waiting = []
-            for exp, trial in self._waiting:
-                n = max(exp.spec.trial_template.resources.num_devices, 1)
-                n = min(n, self.allocator.total)  # clamp to the machine
+            units = plan_packs(self._waiting)
+            self._waiting = []
+            for exp, members in units:
+                requested = max(exp.spec.trial_template.resources.num_devices, 1)
+                n = min(requested, self.allocator.total)  # clamp to the machine
                 devices = self.allocator.acquire(n)
                 if devices is None:
-                    still_waiting.append((exp, trial))
+                    self._waiting.extend((exp, t) for t in members)
                     continue
-                handle = TrialExecution()
-                self._handles[trial.name] = handle
-                th = threading.Thread(
-                    target=self._run_trial,
-                    args=(exp, trial, devices, handle),
-                    name=f"trial-{trial.name}",
-                    daemon=True,
-                )
+                if n < requested:
+                    for t in members:
+                        self._devices_clamped(exp, t, requested, n)
+                if len(members) == 1:
+                    trial = members[0]
+                    handle = TrialExecution()
+                    self._handles[trial.name] = handle
+                    th = threading.Thread(
+                        target=self._run_trial,
+                        args=(exp, trial, devices, handle),
+                        name=f"trial-{trial.name}",
+                        daemon=True,
+                    )
+                else:
+                    handles = [TrialExecution() for _ in members]
+                    for t, h in zip(members, handles):
+                        self._handles[t.name] = h
+                    self._record_pack_formed(exp, members)
+                    th = threading.Thread(
+                        target=self._run_pack,
+                        args=(exp, members, devices, handles),
+                        name=f"trial-pack-{members[0].name}",
+                        daemon=True,
+                    )
                 self._threads.append(th)
                 th.start()
-            self._waiting = still_waiting
+
+    def _devices_clamped(
+        self, exp: Experiment, trial: Trial, requested: int, granted: int
+    ) -> None:
+        """An allocation the machine cannot satisfy is clamped rather than
+        wedged forever — but silently shrinking a gang hides undersized
+        hardware from the operator, so make it visible."""
+        log.warning(
+            "trial %s requested %d devices but the machine has %d; "
+            "allocation clamped", trial.name, requested, granted,
+        )
+        if self.recorder is not None:
+            self.recorder.event(
+                exp.name, "Trial", trial.name, "TrialDevicesClamped",
+                f"requested {requested} devices, machine total is {granted}; "
+                "allocation clamped to the machine",
+                warning=True,
+            )
+
+    def _record_pack_formed(self, exp: Experiment, members: Sequence[Trial]) -> None:
+        from .packing import pack_capacity
+
+        k = max(pack_capacity(exp), 1)
+        if self.metrics_registry is not None:
+            self.metrics_registry.inc("katib_pack_formed_total", experiment=exp.name)
+            self.metrics_registry.inc(
+                "katib_trial_packed_total", value=float(len(members)),
+                experiment=exp.name,
+            )
+            self.metrics_registry.set_gauge(
+                "katib_pack_occupancy", len(members) / k, experiment=exp.name
+            )
+        if self.recorder is not None:
+            self.recorder.event(
+                exp.name, "Trial", members[0].name, "PackFormed",
+                f"packed {len(members)}/{k} trials into one program: "
+                + ", ".join(t.name for t in members),
+            )
 
     def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
         restarted = False
@@ -380,6 +459,183 @@ class TrialScheduler:
                 self._restarts.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
+
+    def _run_pack(
+        self,
+        exp: Experiment,
+        trials: List[Trial],
+        devices,
+        handles: List[TrialExecution],
+    ) -> None:
+        """Run one formed pack to completion: K trials, one gang allocation,
+        one PackedTrialExecutor call, then per-trial condition fan-out —
+        each member is classified/finalized independently, exactly like K
+        solo trials would be."""
+        from .packing import PACK_LABEL, PackedTrialExecutor
+
+        timer = None
+        abandoned: Optional[threading.Thread] = None
+        timed_out = threading.Event()
+        pack_id = f"{trials[0].name}x{len(trials)}"
+        try:
+            for t in trials:
+                t.labels[PACK_LABEL] = pack_id
+                t.set_condition(
+                    TrialCondition.RUNNING, "TrialRunning",
+                    f"Trial is running (packed, {len(trials)} members)",
+                )
+                self.state.update_trial(t)
+
+            if self.trial_timeout:
+                def _deadline():
+                    timed_out.set()
+                    for h in handles:
+                        h.kill()
+
+                timer = threading.Timer(self.trial_timeout, _deadline)
+                timer.daemon = True
+                timer.start()
+
+            ctx = self._build_pack_context(exp, trials, devices, handles)
+            executor = PackedTrialExecutor(self.obs_store)
+            results, abandoned = self._execute_pack_bounded(
+                executor, exp, trials, ctx, handles, timed_out
+            )
+            for trial, result in zip(trials, results):
+                if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
+                    result = ExecutionResult(
+                        TrialOutcome.FAILED,
+                        f"trial exceeded timeout of {self.trial_timeout}s",
+                    )
+                result, observation = self._classify(exp, trial, result)
+                restarted = self._maybe_restart(exp, trial, result)
+                if not restarted:
+                    self._finalize(exp, trial, result, observation)
+                    self._checkpoint_dirs.pop(trial.name, None)
+                    self._restarts.pop(trial.name, None)
+        except Exception:
+            tb = traceback.format_exc(limit=5)
+            for t in trials:
+                if not t.is_terminal:
+                    t.set_condition(TrialCondition.FAILED, "TrialFailed", tb)
+                    self.state.update_trial(t)
+        finally:
+            if timer is not None:
+                timer.cancel()
+            if abandoned is not None and abandoned.is_alive():
+                self._quarantine(pack_id, devices, abandoned)
+            else:
+                self.allocator.release(devices)
+            for t in trials:
+                self._handles.pop(t.name, None)
+                self.events.put(TrialEvent(exp.name, t.name, t.condition))
+            self._dispatch()
+
+    def _execute_pack_bounded(
+        self,
+        executor,
+        exp: Experiment,
+        trials: List[Trial],
+        ctx,
+        handles: List[TrialExecution],
+        timed_out: threading.Event,
+    ) -> "tuple[List[ExecutionResult], Optional[threading.Thread]]":
+        """Pack counterpart of _execute_bounded. Individual member kills are
+        cooperative (frozen at the next ctx.report); the grace/abandon
+        machinery engages only when EVERY member was asked to stop (timeout
+        or shutdown) and the shared program still refuses to exit — there is
+        one program, so there is one thread to abandon."""
+        box: Dict[str, Any] = {}
+
+        def _exec():
+            try:
+                box["results"] = executor.execute(exp, trials, ctx, handles)
+            except BaseException:
+                box["error"] = traceback.format_exc(limit=5)
+
+        worker = threading.Thread(
+            target=_exec, name=f"pack-exec-{trials[0].name}", daemon=True
+        )
+        worker.start()
+        abandon_at = None
+        while worker.is_alive():
+            worker.join(timeout=0.2)
+            if abandon_at is None and all(h.kill_requested for h in handles):
+                abandon_at = time.time() + self.KILL_GRACE_SECONDS
+            if abandon_at is not None and time.time() > abandon_at and worker.is_alive():
+                if timed_out.is_set():
+                    outcome, reason = (
+                        TrialOutcome.FAILED,
+                        f"trial exceeded timeout of {self.trial_timeout}s",
+                    )
+                else:
+                    outcome, reason = TrialOutcome.KILLED, "kill requested"
+                msg = (
+                    f"{reason}; pack did not stop within "
+                    f"{self.KILL_GRACE_SECONDS}s grace, abandoned"
+                )
+                return [ExecutionResult(outcome, msg) for _ in trials], worker
+        if "error" in box:
+            return (
+                [ExecutionResult(TrialOutcome.FAILED, box["error"]) for _ in trials],
+                None,
+            )
+        return box["results"], None
+
+    def _build_pack_context(
+        self,
+        exp: Experiment,
+        trials: List[Trial],
+        devices,
+        handles: List[TrialExecution],
+    ):
+        """Batched analogue of _build_context: per-member reporters (with
+        raise_on_stop=False — stopping is masking, not unwinding, and the
+        kill check belongs to the packed context so one member's kill can't
+        unwind the shared program), stacked assignments, and per-member
+        workdir/checkpoint-dir lists."""
+        from ..runtime.packed import PackedTrialContext
+        from .packing import stack_assignments
+
+        spec = exp.spec
+        reporters = []
+        for t in trials:
+            monitor = None
+            if t.early_stopping_rules:
+                monitor = EarlyStoppingMonitor(
+                    t.early_stopping_rules,
+                    spec.objective.objective_metric_name,
+                    spec.objective.type,
+                )
+            reporters.append(
+                MetricsReporter(
+                    store=self.obs_store,
+                    trial_name=t.name,
+                    monitor=monitor,
+                    raise_on_stop=False,
+                )
+            )
+        workdirs: List[Optional[str]] = []
+        for t in trials:
+            workdir = None
+            if self.workdir_root:
+                import os
+
+                workdir = os.path.join(self.workdir_root, exp.name, t.name)
+                os.makedirs(workdir, exist_ok=True)
+            workdirs.append(workdir)
+        return PackedTrialContext(
+            trial_names=[t.name for t in trials],
+            experiment_name=exp.name,
+            assignments=stack_assignments(trials),
+            reporters=reporters,
+            kill_events=[h.kill_event for h in handles],
+            workdirs=workdirs,
+            checkpoint_dirs=[self._checkpoint_dirs.get(t.name) for t in trials],
+            member_labels=[dict(t.labels) for t in trials],
+            devices=list(devices),
+            topology=spec.trial_template.resources.topology,
+        )
 
     KILL_GRACE_SECONDS = 30.0
 

@@ -52,3 +52,82 @@ def run_pbt_trial(assignments: Dict[str, str], ctx=None) -> None:
         ctx.report(**{"Validation-accuracy": score})
     else:
         print(f"Validation-accuracy={score}")
+
+
+def run_pbt_trial_packed(assignments, ctx=None) -> None:
+    """Pack-aware PBT workload: one vmapped+jitted program scores a whole
+    generation — K members with per-member lr AND per-member checkpoint
+    lineage (exploit children start from their parent's step/score). A
+    member whose checkpoint is unreadable is failed individually via
+    ``ctx.fail_member`` (member failure never fails the pack); the rest of
+    the generation keeps training. Runs solo as a K=1 population."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..runtime.packed import population_of, report_population
+
+    pop = population_of(assignments)
+    packed = ctx is not None and hasattr(ctx, "pack_size")
+    k = ctx.pack_size if packed else 1
+    lr = pop.get("lr")
+    if lr is None:
+        raise KeyError("lr")
+
+    if packed:
+        ckpt_dirs = list(ctx.checkpoint_dirs)
+    elif ctx is not None and ctx.checkpoint_dir:
+        ckpt_dirs = [ctx.checkpoint_dir]
+    else:
+        ckpt_dirs = [None] * k
+
+    steps = np.zeros((k,), dtype=np.int32)
+    scores = np.zeros((k,), dtype=np.float32)
+    ckpt_paths = [None] * k
+    for i, d in enumerate(ckpt_dirs):
+        if d is None:
+            continue
+        os.makedirs(d, exist_ok=True)
+        ckpt_paths[i] = os.path.join(d, "training.json")
+        if not os.path.exists(ckpt_paths[i]):
+            continue
+        try:
+            with open(ckpt_paths[i]) as f:
+                state = json.load(f)
+            steps[i], scores[i] = int(state["step"]), float(state["score"])
+        except (ValueError, KeyError, OSError) as e:
+            msg = f"corrupt checkpoint {ckpt_paths[i]}: {e}"
+            if packed:
+                ctx.fail_member(i, msg)
+                ckpt_paths[i] = None  # don't overwrite the evidence
+            else:
+                raise RuntimeError(msg)
+
+    period = 100
+
+    def member_round(lr_i, step0, score0):
+        def body(i, score):
+            step = step0 + i
+            phase = (step % period) / period
+            tri = jnp.where(phase < 0.5, 2.0 * phase, 2.0 * (1.0 - phase))
+            target = 0.02 * tri
+            return score + jnp.maximum(0.0, 1.0 - jnp.abs(lr_i - target) / 0.02) * 0.01
+
+        return jax.lax.fori_loop(0, _STEPS_PER_ROUND, body, score0)
+
+    new_scores = np.asarray(
+        jax.jit(jax.vmap(member_round))(
+            jnp.asarray(lr), jnp.asarray(steps, jnp.float32), jnp.asarray(scores)
+        )
+    )
+    new_steps = steps + _STEPS_PER_ROUND
+
+    for i, path in enumerate(ckpt_paths):
+        if path is None or (packed and not ctx.member_active(i)):
+            continue
+        with open(path, "w") as f:
+            json.dump({"step": int(new_steps[i]), "score": float(new_scores[i])}, f)
+
+    report_population(ctx, **{"Validation-accuracy": new_scores})
+
+
+run_pbt_trial_packed.supports_packing = True

@@ -1,0 +1,224 @@
+"""Batched trial context — the runtime half of vmapped trial packing.
+
+Podracer-style architectures (Anakin, arXiv:2104.06272) get their TPU
+throughput by batching many identical-shape learners into ONE compiled
+program; a population of same-architecture, different-scalar-hparam trials
+(PBT, random/grid sweeps over optimizer knobs) is exactly that workload.
+``PackedTrialContext`` is what a pack-aware trial function receives instead
+of a ``TrialContext``: the K members' scalar hyperparameters are stacked
+into arrays, and every ``report()`` carries per-member metric arrays that
+the context demuxes back into K independent observation logs.
+
+Member lifecycle is masking, not unwinding (ISSUE tentpole): a member whose
+early-stopping rules trip, whose kill was requested, or that the train fn
+marks failed is *frozen* — its reporter stops receiving demuxed rows, and
+``active_mask`` flips to False so the train fn can hold its state constant
+via ``jnp.where``. The pack's step loop keeps running for the remaining
+members; only when no member is active does the context raise
+:class:`PackFrozen` to end the loop early. Per-member terminal conditions
+are derived afterwards by the PackedTrialExecutor
+(katib_tpu.controller.packing).
+
+Pack-aware functions are written once and run in BOTH modes: solo (normal
+``InProcessExecutor`` fallback, string assignments, scalar reports) and
+packed. ``population_of`` / ``report_population`` normalize the two so the
+same vectorized math executes either way — which is also what makes the
+packed-vs-sequential parity guarantee testable (identical per-member
+programs, K=1 vs K>1).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .metrics import MetricsReporter
+
+
+class PackFrozen(Exception):
+    """Raised by PackedTrialContext.report when every member of the pack is
+    frozen (stopped/killed/failed) — ends the pack's step loop early, the
+    batched analogue of EarlyStopped/TrialKilled for a single trial."""
+
+
+def population_of(assignments: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Normalize assignments to ``{name: float32 array of shape [K]}``.
+
+    Packed mode already passes stacked arrays; solo mode (the
+    InProcessExecutor fallback) passes the usual ``{name: str}`` dict, which
+    becomes a K=1 population so the same vectorized code path runs."""
+    out: Dict[str, np.ndarray] = {}
+    for name, value in assignments.items():
+        arr = np.asarray(
+            [float(value)] if isinstance(value, (str, int, float)) else value,
+            dtype=np.float32,
+        )
+        out[name] = arr.reshape(-1)
+    return out
+
+
+def uniform_param(pop: Dict[str, np.ndarray], name: str, default: float) -> float:
+    """A shape-affecting parameter (batch size, epochs, ...) must be one
+    value across the whole pack — members with different shapes cannot share
+    a compiled program. Raises ValueError on a mixed pack so the failure is
+    loud instead of silently training K members at member 0's shape."""
+    arr = pop.get(name)
+    if arr is None:
+        return default
+    values = np.unique(arr)
+    if len(values) != 1:
+        raise ValueError(
+            f"shape-affecting parameter {name!r} differs across pack members "
+            f"({sorted(float(v) for v in values)}); packable trials must "
+            "agree on it (see docs/trial-packing.md)"
+        )
+    return float(values[0])
+
+
+def report_population(ctx, **metrics) -> None:
+    """Report per-member metric arrays through whichever context the trial
+    function got: a PackedTrialContext takes the arrays verbatim; a solo
+    TrialContext gets member 0's scalars; no context prints ``name=value``
+    lines for the stdout collector (same contract as report_metrics)."""
+    if ctx is not None and hasattr(ctx, "pack_size"):
+        ctx.report(**metrics)
+        return
+    scalars = {k: float(np.asarray(v).reshape(-1)[0]) for k, v in metrics.items()}
+    if ctx is not None:
+        ctx.report(**scalars)
+    else:
+        for k, v in scalars.items():
+            print(f"{k}={v}", flush=True)
+
+
+@dataclass
+class PackedTrialContext:
+    """What a pack-aware trial function receives for a pack of K trials.
+
+    ``assignments`` maps each parameter name to a float32 array of shape
+    [K] (member order == ``trial_names`` order). Per-member workdir /
+    checkpoint-dir / labels ride along as parallel lists — PBT packs need
+    the per-member checkpoint lineage directories.
+    """
+
+    trial_names: List[str]
+    experiment_name: str
+    assignments: Dict[str, np.ndarray]
+    reporters: List[MetricsReporter]
+    kill_events: List[Optional[threading.Event]]
+    workdirs: List[Optional[str]] = field(default_factory=list)
+    checkpoint_dirs: List[Optional[str]] = field(default_factory=list)
+    member_labels: List[Dict[str, str]] = field(default_factory=list)
+    devices: Optional[List[Any]] = None
+    topology: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        k = len(self.trial_names)
+        self._active = [True] * k
+        self._stopped = [False] * k
+        self._killed = [False] * k
+        self._failed = [False] * k
+        self._fail_messages: List[str] = [""] * k
+        if not self.workdirs:
+            self.workdirs = [None] * k
+        if not self.checkpoint_dirs:
+            self.checkpoint_dirs = [None] * k
+        if not self.member_labels:
+            self.member_labels = [{} for _ in range(k)]
+
+    @property
+    def pack_size(self) -> int:
+        return len(self.trial_names)
+
+    @property
+    def active_mask(self) -> np.ndarray:
+        """Bool [K]; True = member still training. Feed it to ``jnp.where``
+        to freeze stopped members' params/metrics instead of unwinding."""
+        self._sweep_kills()
+        return np.array(self._active, dtype=bool)
+
+    def member_active(self, i: int) -> bool:
+        self._sweep_kills()
+        return self._active[i]
+
+    def fail_member(self, i: int, message: str) -> None:
+        """Mark one member failed (bad checkpoint, invalid derived config,
+        non-finite loss ...) without failing the pack: the member freezes
+        and finalizes FAILED while the rest keep training."""
+        if self._active[i]:
+            self._active[i] = False
+            self._failed[i] = True
+            self._fail_messages[i] = message
+
+    def _sweep_kills(self) -> None:
+        for i, ev in enumerate(self.kill_events):
+            if self._active[i] and ev is not None and ev.is_set():
+                self._active[i] = False
+                self._killed[i] = True
+
+    def report(self, timestamp: Optional[float] = None, **metrics) -> None:
+        """Demux per-member metric arrays into per-trial observation logs.
+
+        Each value is an array of shape [K] (or a scalar, broadcast to all
+        members). Frozen members are skipped — their logs end at the report
+        where they stopped, exactly where a sequential run's would. After
+        the write, each member's kill event and early-stopping monitor are
+        applied (same order as MetricsReporter.report: a killed/stopped
+        member's final metrics are never lost). Raises PackFrozen when no
+        member remains active."""
+        k = self.pack_size
+        cols: Dict[str, np.ndarray] = {}
+        for name, value in metrics.items():
+            arr = np.asarray(value)
+            if arr.ndim == 0:
+                arr = np.full((k,), float(arr))
+            arr = arr.reshape(-1)
+            if arr.shape[0] != k:
+                raise ValueError(
+                    f"packed metric {name!r} has {arr.shape[0]} values for a "
+                    f"pack of {k}"
+                )
+            cols[name] = arr
+        # NO kill sweep before the write loop: like MetricsReporter.report,
+        # a killed member's in-flight metrics are written, THEN it freezes
+        # (a train fn that polls active_mask freezes earlier by choice)
+        for i in range(k):
+            if not self._active[i]:
+                continue
+            self.reporters[i].report(
+                timestamp=timestamp,
+                **{name: float(col[i]) for name, col in cols.items()},
+            )
+            ev = self.kill_events[i]
+            if ev is not None and ev.is_set():
+                self._active[i] = False
+                self._killed[i] = True
+                continue
+            if self.reporters[i].stopped:
+                self._active[i] = False
+                self._stopped[i] = True
+        if not any(self._active):
+            raise PackFrozen(
+                f"all {k} members of pack {self.trial_names} are frozen"
+            )
+
+    # -- terminal-state views consumed by the PackedTrialExecutor ------------
+
+    def member_outcomes(self):
+        """Per-member (stopped, killed, failed, fail_message) after the pack
+        function returned/unwound."""
+        self._sweep_kills()
+        return list(
+            zip(self._stopped, self._killed, self._failed, self._fail_messages)
+        )
+
+    def param_array(self, name: str, default: Optional[float] = None) -> np.ndarray:
+        arr = self.assignments.get(name)
+        if arr is not None:
+            return arr
+        if default is None:
+            raise KeyError(name)
+        return np.full((self.pack_size,), float(default), dtype=np.float32)

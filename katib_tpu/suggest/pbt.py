@@ -301,9 +301,22 @@ class PBT(Suggester):
         n = request.current_request_number
         if len(self.pending) < n:
             self._generate(n)
+        # Trial-packing wiring (controller/packing.py): when the template
+        # packs, a suggestion batch must not straddle a generation boundary —
+        # the controller submits one reply as one dispatch batch, and mixing
+        # generations would pack an exploit child with its parents' cohort.
+        # Stopping at the boundary keeps "one PBT generation == one packed
+        # program"; the next reconcile picks up the next generation.
+        pack_aligned = request.experiment.trial_template.resources.pack_size > 1
         assignments: List[TrialAssignment] = []
         for _ in range(n):
             if not self.pending:
+                break
+            if (
+                pack_aligned
+                and assignments
+                and self.pending[0].generation != self.running[assignments[0].name].generation
+            ):
                 break
             job = self.pending.pop(0)
             self.running[job.uid] = job
