@@ -517,6 +517,207 @@ def _bench_pack_throughput(jax, np):
     }
 
 
+def _bench_preemption_latency(jax, np):
+    """Fair-share preemption round trip (controller/fairshare.py) on 8
+    abstract device slots: a low-priority 8-chip trial checkpointing every
+    20ms is preempted by a high-priority 4-chip gang. Reported legs:
+    signal→requeue (submit of the gang to the victim's TrialPreempted
+    requeue, i.e. checkpoint + cooperative exit), requeue→resume (gang runs,
+    victim redispatches and restores), and the total turnaround."""
+    import shutil
+    import tempfile
+    import threading
+
+    from katib_tpu.api.spec import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialResources,
+        TrialTemplate,
+    )
+    from katib_tpu.api.status import Experiment, Trial, TrialCondition
+    from katib_tpu.controller.events import EventRecorder, MetricsRegistry
+    from katib_tpu.controller.scheduler import TrialScheduler
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import open_store
+
+    root = tempfile.mkdtemp(prefix="bench-preempt-")
+    stamps = {}
+    resumed = threading.Event()
+
+    def victim_fn(assignments, ctx):
+        store = ctx.checkpoint_store()
+        restored = store.restore()
+        start = int(restored["epoch"]) + 1 if restored else 0
+        if restored is not None:
+            stamps["resumed"] = time.time()
+            resumed.set()
+        limit = start + 3 if restored is not None else 2000
+        for epoch in range(start, limit):
+            store.save(epoch, {"epoch": epoch})
+            ctx.report(score=float(epoch))
+            time.sleep(0.02)
+
+    def urgent_fn(assignments, ctx):
+        stamps["gang_ran"] = time.time()
+        ctx.report(score=1.0)
+
+    def make_exp(name, fn, num_devices, priority):
+        return Experiment(spec=ExperimentSpec(
+            name=name,
+            parameters=[ParameterSpec(
+                "x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=fn, resources=TrialResources(num_devices=num_devices)),
+            priority_class=priority,
+        ))
+
+    recorder = EventRecorder()
+    sched = TrialScheduler(
+        ExperimentStateStore(None), open_store(None),
+        devices=list(range(8)), workdir_root=root,
+        events=recorder, metrics=MetricsRegistry(),
+    )
+    try:
+        lo = make_exp("bench-lo", victim_fn, 8, "low")
+        hi = make_exp("bench-hi", urgent_fn, 4, "high")
+        sched.state.create_experiment(lo)
+        sched.state.create_experiment(hi)
+        victim = Trial(name="bench-victim", experiment_name="bench-lo")
+        sched.state.create_trial(victim)
+        sched.submit(lo, victim)
+
+        def wait(cond, timeout=30.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.005)
+            return False
+
+        wait(lambda: "bench-victim" in sched._last_checkpoint)
+        t_signal = time.time()
+        urgent = Trial(name="bench-urgent", experiment_name="bench-hi")
+        sched.state.create_trial(urgent)
+        sched.submit(hi, urgent)
+        wait(lambda: any(
+            e.reason == "TrialPreempted" for e in recorder.list("bench-lo")))
+        requeue_event = next(
+            e for e in recorder.list("bench-lo") if e.reason == "TrialPreempted")
+        wait(lambda: resumed.is_set(), timeout=60)
+        wait(lambda: (sched.state.get_trial("bench-lo", "bench-victim")
+                      or victim).is_terminal, timeout=60)
+        t_resumed = stamps.get("resumed", time.time())
+        return {
+            "devices": 8,
+            "victim": "8-chip low-priority, checkpoint every 20ms",
+            "preemptor": "4-chip high-priority gang",
+            "signal_to_requeue_s": round(requeue_event.timestamp - t_signal, 4),
+            "requeue_to_resume_s": round(t_resumed - requeue_event.timestamp, 4),
+            "total_roundtrip_s": round(t_resumed - t_signal, 4),
+        }
+    finally:
+        sched.kill_all()
+        sched.join(timeout=10)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _bench_fairshare_throughput(jax, np):
+    """Mixed small/large gang traffic through the full controller, FIFO
+    baseline (no fair-share knobs) vs fair-share (large gangs high-priority):
+    with FIFO, 6-chip gangs starve behind 1-chip churn on an 8-slot machine;
+    the policy's ordering + reservation pulls their completion forward while
+    total trials/sec stays comparable."""
+    import shutil
+    import tempfile
+    import threading
+
+    from katib_tpu.api.spec import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialResources,
+        TrialTemplate,
+    )
+    from katib_tpu.controller.experiment import ExperimentController
+
+    def napping_trial(assignments, ctx):
+        time.sleep(0.03)
+        ctx.report(score=float(assignments["x"]))
+
+    def run(priorities: bool):
+        root = tempfile.mkdtemp(prefix="bench-fairshare-")
+        ctrl = ExperimentController(root_dir=root, devices=list(range(8)))
+        try:
+            def spec(name, num_devices, max_trials, parallel, priority=""):
+                return ExperimentSpec(
+                    name=name,
+                    parameters=[ParameterSpec(
+                        "x", ParameterType.DOUBLE,
+                        FeasibleSpace(min="0", max="1"))],
+                    objective=ObjectiveSpec(
+                        type=ObjectiveType.MAXIMIZE,
+                        objective_metric_name="score"),
+                    algorithm=AlgorithmSpec("random"),
+                    trial_template=TrialTemplate(
+                        function=napping_trial,
+                        resources=TrialResources(num_devices=num_devices)),
+                    priority_class=priority if priorities else "",
+                    max_trial_count=max_trials,
+                    parallel_trial_count=parallel,
+                )
+
+            ctrl.create_experiment(spec("bench-small", 1, 32, 8))
+            ctrl.create_experiment(spec("bench-large", 6, 4, 1, priority="high"))
+            done = {}
+
+            def drive(name):
+                done[name] = ctrl.run(name, timeout=90)
+
+            t0 = time.time()
+            threads = [
+                threading.Thread(target=drive, args=(n,), daemon=True)
+                for n in ("bench-small", "bench-large")
+            ]
+            for t in threads:
+                t.start()
+            large_done = None
+            for t in threads:
+                t.join(timeout=100)
+            wall = time.time() - t0
+            large = done.get("bench-large")
+            large_done = (
+                max(t.completion_time or 0.0
+                    for t in ctrl.state.list_trials("bench-large")) - t0
+                if large is not None else None
+            )
+            n_ok = sum(
+                1
+                for e in ("bench-small", "bench-large")
+                for t in ctrl.state.list_trials(e)
+                if t.is_succeeded
+            )
+            return wall, large_done, n_ok
+        finally:
+            ctrl.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    fifo_wall, fifo_large, fifo_ok = run(priorities=False)
+    fair_wall, fair_large, fair_ok = run(priorities=True)
+    return {
+        "workload": "32x 1-chip + 4x 6-chip (30ms trials, 8 slots)",
+        "fifo_wall_s": round(fifo_wall, 2),
+        "fairshare_wall_s": round(fair_wall, 2),
+        "fifo_trials_per_s": round(fifo_ok / fifo_wall, 2),
+        "fairshare_trials_per_s": round(fair_ok / fair_wall, 2),
+        "fifo_large_gangs_done_s": round(fifo_large, 2) if fifo_large else None,
+        "fairshare_large_gangs_done_s": round(fair_large, 2) if fair_large else None,
+        "large_gang_speedup": (
+            round(fifo_large / fair_large, 2)
+            if fifo_large and fair_large else None
+        ),
+    }
+
+
 def _bench_darts_mfu(jax, np, remat: bool = False):
     """TPU-only: the DARTS supernet at the REFERENCE search configuration —
     8 cells, 4 nodes, init_channels 16, batch 128, the full 7-op primitive
@@ -788,6 +989,17 @@ def child_main(platform: str) -> None:
             extras["pack_throughput"] = _bench_pack_throughput(jax, np)
         except Exception as e:
             extras["pack_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
+    if os.environ.get("BENCH_SKIP_FAIRSHARE") != "1" and gate("fairshare", 60.0):
+        try:
+            extras["preemption_latency"] = _bench_preemption_latency(jax, np)
+        except Exception as e:
+            extras["preemption_latency"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        try:
+            extras["fairshare_throughput"] = _bench_fairshare_throughput(jax, np)
+        except Exception as e:
+            extras["fairshare_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
     # darts_mfu runs BEFORE the cheaper lm_large/flash stages: it is the

@@ -545,6 +545,10 @@ class TrialResources:
     # share ONE device allocation and ONE compiled (vmap'ed) train loop.
     # 1 = no packing; requires an in-process single-host template.
     pack_size: int = 1
+    # Fair-share scheduling (controller/fairshare.py): cap on devices this
+    # experiment's trials may hold concurrently; None = unlimited. Must be
+    # >= num_devices or no trial could ever dispatch (validated).
+    device_quota: Optional[int] = None
 
     def topology_dims(self) -> Optional[List[int]]:
         return parse_topology(self.topology)
@@ -555,6 +559,8 @@ class TrialResources:
             d["topology"] = self.topology
         if self.pack_size != 1:
             d["packSize"] = self.pack_size
+        if self.device_quota is not None:
+            d["deviceQuota"] = self.device_quota
         return d
 
     @classmethod
@@ -564,6 +570,9 @@ class TrialResources:
             num_hosts=int(d.get("numHosts", 1)),
             topology=d.get("topology"),
             pack_size=int(d.get("packSize", 1)),
+            device_quota=(
+                int(d["deviceQuota"]) if d.get("deviceQuota") is not None else None
+            ),
         )
 
 
@@ -681,6 +690,12 @@ class ExperimentSpec:
     # different metrics per run, so the author must declare determinism).
     # Trials carrying checkpoint lineage (PBT exploit/explore) never reuse.
     reuse_duplicate_results: bool = False
+    # Fair-share scheduling (controller/fairshare.py): named priority class
+    # ("low" | "default" | "high" | "urgent"; "" = default) inherited by this
+    # experiment's trials, and the weight scaling its fair share of device
+    # time across concurrent experiments. Defaults preserve FIFO dispatch.
+    priority_class: str = ""
+    fair_share_weight: float = 1.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -704,6 +719,10 @@ class ExperimentSpec:
             d["nasConfig"] = self.nas_config.to_dict()
         if self.reuse_duplicate_results:
             d["reuseDuplicateResults"] = True
+        if self.priority_class:
+            d["priorityClass"] = self.priority_class
+        if self.fair_share_weight != 1.0:
+            d["fairShareWeight"] = self.fair_share_weight
         return d
 
     @classmethod
@@ -725,6 +744,8 @@ class ExperimentSpec:
             nas_config=NasConfig.from_dict(d["nasConfig"]) if d.get("nasConfig") else None,
             resume_policy=ResumePolicy(d.get("resumePolicy", "Never")),
             reuse_duplicate_results=bool(d.get("reuseDuplicateResults", False)),
+            priority_class=d.get("priorityClass", ""),
+            fair_share_weight=float(d.get("fairShareWeight", 1.0)),
         )
 
     def to_json(self) -> str:

@@ -88,6 +88,8 @@ def validate_experiment(
     if spec.resume_policy not in (ResumePolicy.NEVER, ResumePolicy.LONG_RUNNING, ResumePolicy.FROM_VOLUME):
         errs.append(f"invalid resumePolicy {spec.resume_policy!r}")
 
+    _validate_fairshare(spec, errs)
+
     _validate_trial_template(spec, errs)
 
     if not spec.parameters and spec.nas_config is None:
@@ -131,6 +133,31 @@ def _validate_restart(spec: ExperimentSpec, old: Experiment, errs: List[str]) ->
         b.pop(k, None)
     if a != b:
         errs.append("only parallelTrialCount, maxTrialCount and maxFailedTrialCount are editable")
+
+
+def _validate_fairshare(spec: ExperimentSpec, errs: List[str]) -> None:
+    """Fair-share scheduling knobs (controller/fairshare.py): an unknown
+    priority class or an unsatisfiable device quota must fail at admission,
+    not silently degrade in the dispatch loop."""
+    from ..controller.fairshare import PRIORITY_CLASSES
+
+    if spec.priority_class and spec.priority_class not in PRIORITY_CLASSES:
+        errs.append(
+            f"unknown priorityClass {spec.priority_class!r} "
+            f"(known: {sorted(c for c in PRIORITY_CLASSES if c)})"
+        )
+    if spec.fair_share_weight <= 0:
+        errs.append("fairShareWeight must be greater than 0")
+    quota = spec.trial_template.resources.device_quota
+    if quota is not None:
+        if quota < 1:
+            errs.append("trialTemplate.resources.deviceQuota must be >= 1")
+        elif quota < spec.trial_template.resources.num_devices:
+            errs.append(
+                f"trialTemplate.resources.deviceQuota ({quota}) is less than "
+                f"numDevices ({spec.trial_template.resources.num_devices}); "
+                "no trial could ever dispatch"
+            )
 
 
 def _validate_objective(spec: ExperimentSpec, errs: List[str]) -> None:

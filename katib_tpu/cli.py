@@ -10,6 +10,9 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
   list                     list experiments in a state root
   status <name>            experiment status + trial buckets + optimal trial
   trials <name>            per-trial table (the fetch_hp_job_info view)
+  queue                    fair-share scheduler queue (pending trials with
+                           priority, wait, deficit; --url asks a live
+                           controller's /api/queue, else persisted state)
   importance <name>        correlation-based parameter-importance table
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
@@ -121,6 +124,86 @@ def cmd_trials(args) -> int:
         rows.append((t.name, t.condition.value, t.current_reason,
                      json.dumps(t.assignments_dict()), metric))
     _table(["TRIAL", "STATUS", "REASON", "ASSIGNMENTS", "METRIC"], rows)
+    return 0
+
+
+def cmd_queue(args) -> int:
+    """Fair-share queue state (ISSUE 2 satellite): live from a running
+    controller's /api/queue when --url is given; otherwise reconstructed
+    from persisted state (pending trials + priorities from the spec, wait
+    from the Pending condition timestamp — live-only fields like the
+    fair-share deficit are then unavailable)."""
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url.rstrip("/") + "/api/queue") as r:
+            state = json.loads(r.read().decode())
+        d = state.get("devices", {})
+        print(
+            f"devices:   {d.get('free', '?')}/{d.get('total', '?')} free"
+            + (f" ({d.get('quarantined')} quarantined)" if d.get("quarantined") else "")
+        )
+        rows = [
+            (p["trial"], p["experiment"], p["priorityClass"],
+             f"{p['effectivePriority']:.2f}", f"{p['waitSeconds']:.1f}s",
+             str(p["numDevices"]),
+             "-" if p.get("deviceQuota") is None else str(p["deviceQuota"]),
+             f"{p['fairShareDeficit']:.2f}")
+            for p in state.get("pending", [])
+        ]
+        _table(
+            ["TRIAL", "EXPERIMENT", "CLASS", "EFF-PRIO", "WAIT", "DEVICES",
+             "QUOTA", "DEFICIT"],
+            rows,
+        )
+        running = state.get("running", [])
+        if running:
+            print()
+            _table(
+                ["RUNNING UNIT", "EXPERIMENT", "TRIALS", "DEVICES", "PRIO",
+                 "PREEMPTING", "ELAPSED"],
+                [
+                    (u["unit"], u["experiment"], str(len(u["trials"])),
+                     str(u["devices"]), str(u["priority"]),
+                     "yes" if u["preempting"] else "no",
+                     f"{u['runningSeconds']:.1f}s")
+                    for u in running
+                ],
+            )
+        return 0
+
+    import time as _time
+
+    from .api.status import TrialCondition
+    from .controller.fairshare import priority_of
+
+    ctrl = _controller(args.root)
+    _load_all(ctrl, args.root)
+    now = _time.time()
+    rows = []
+    for exp in ctrl.state.list_experiments():
+        for t in ctrl.state.list_trials(exp.name):
+            if t.condition != TrialCondition.PENDING:
+                continue
+            pending_since = next(
+                (c.last_transition_time for c in t.conditions
+                 if c.type == TrialCondition.PENDING.value),
+                None,
+            )
+            wait = f"{now - pending_since:.1f}s" if pending_since else "-"
+            rows.append(
+                (t.name, exp.name, exp.spec.priority_class or "default",
+                 str(priority_of(exp)), wait,
+                 str(max(exp.spec.trial_template.resources.num_devices, 1)),
+                 t.current_reason or "-")
+            )
+    _table(
+        ["TRIAL", "EXPERIMENT", "CLASS", "PRIO", "WAIT", "DEVICES", "REASON"],
+        rows,
+    )
+    if not rows:
+        print("(queue empty; use --url http://host:port for a live "
+              "controller's /api/queue view)")
     return 0
 
 
@@ -275,6 +358,18 @@ def main(argv=None) -> int:
     tr = sub.add_parser("trials", help="trial table for an experiment")
     tr.add_argument("name")
     tr.set_defaults(fn=cmd_trials)
+
+    qu = sub.add_parser(
+        "queue",
+        help="fair-share scheduler queue (pending trials with priority/wait)",
+    )
+    qu.add_argument(
+        "--url",
+        default=None,
+        help="base URL of a running 'katib-tpu ui' server for the live "
+        "/api/queue view (incl. fair-share deficits)",
+    )
+    qu.set_defaults(fn=cmd_queue)
 
     im = sub.add_parser("importance", help="parameter-importance table for an experiment")
     im.add_argument("name")

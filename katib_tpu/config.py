@@ -51,6 +51,10 @@ class RuntimeConfig:
     xla_cache_dir: Optional[str] = None
     devices_per_host: Optional[int] = None  # cap devices visible to the allocator
     metrics_poll_interval: float = 0.1
+    # fair-share scheduling (controller/fairshare.py)
+    queue_stall_seconds: float = 120.0     # TrialQueueStalled warning threshold
+    fairshare_aging_seconds: float = 60.0  # +1 effective priority per interval waited
+    preemption_grace_seconds: float = 30.0  # preempt signal -> kill escalation
 
 
 @dataclass

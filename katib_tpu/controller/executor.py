@@ -42,6 +42,7 @@ from ..runtime.metrics import (
     EarlyStopped,
     EarlyStoppingMonitor,
     TrialKilled,
+    TrialPreempted,
     parse_json_lines,
     parse_text_lines,
     set_current_reporter,
@@ -58,6 +59,7 @@ class TrialOutcome(str, Enum):
     EARLY_STOPPED = "early_stopped"
     FAILED = "failed"
     KILLED = "killed"
+    PREEMPTED = "preempted"       # yielded devices to higher-priority work
 
 
 @dataclass
@@ -119,13 +121,18 @@ def resolve_entry_point(template: TrialTemplate) -> Callable[..., Any]:
 
 
 class TrialExecution:
-    """Handle for one running trial; kill() requests termination."""
+    """Handle for one running trial; kill() requests termination, preempt()
+    requests a cooperative checkpoint-and-yield (fair-share scheduling)."""
 
     def __init__(self) -> None:
         self._kill_requested = threading.Event()
+        self._preempt_requested = threading.Event()
 
     def kill(self) -> None:
         self._kill_requested.set()
+
+    def preempt(self) -> None:
+        self._preempt_requested.set()
 
     @property
     def kill_requested(self) -> bool:
@@ -134,6 +141,14 @@ class TrialExecution:
     @property
     def kill_event(self) -> threading.Event:
         return self._kill_requested
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt_requested.is_set()
+
+    @property
+    def preempt_event(self) -> threading.Event:
+        return self._preempt_requested
 
 
 class InProcessExecutor:
@@ -174,6 +189,10 @@ class InProcessExecutor:
             return ExecutionResult(TrialOutcome.EARLY_STOPPED)
         except TrialKilled:
             return ExecutionResult(TrialOutcome.KILLED, "kill requested")
+        except TrialPreempted:
+            return ExecutionResult(
+                TrialOutcome.PREEMPTED, "preempted by higher-priority work"
+            )
         except Exception:
             return ExecutionResult(
                 TrialOutcome.FAILED, traceback.format_exc(limit=10), exit_code=1

@@ -96,6 +96,9 @@ class ExperimentController:
             max_trial_restarts=rt.max_trial_restarts,
             poll_interval=rt.metrics_poll_interval,
             devices_per_host=rt.devices_per_host,
+            queue_stall_seconds=rt.queue_stall_seconds,
+            aging_seconds=rt.fairshare_aging_seconds,
+            preemption_grace_seconds=rt.preemption_grace_seconds,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -405,6 +408,7 @@ class ExperimentController:
                 self.scheduler.kill(t.name)
             self.obs_store.delete_observation_log(t.name)
         self.suggestions.forget(name)
+        self.scheduler.forget_experiment(name)
         self._completed_seen.discard(name)
         self.metrics.inc("katib_experiment_deleted_total", experiment=name)
         self.state.delete_experiment(name)

@@ -1,0 +1,237 @@
+"""Fair-share scheduling policy — priorities, quotas, aging, backfill,
+checkpoint-preemption planning for the gang scheduler.
+
+The reference Katib delegates placement to kube-scheduler; our TPU-native
+scheduler (controller/scheduler.py) owns the device pool directly and until
+this subsystem dispatched strictly in arrival order. At Podracer-style
+utilization levels (PAPERS.md, arXiv:2104.06272) that discipline breaks
+down: a low-priority sweep can monopolize every chip while an urgent
+experiment starves, and PBT's generation-aligned trial bursts make fair
+multi-experiment sharing a correctness concern.
+
+This module is the *policy* half — pure decision logic, deterministic and
+unit-testable without threads or devices. The scheduler is the *mechanism*:
+it builds :class:`QueueEntry` / :class:`RunningUnit` snapshots, asks the
+policy for an ordering / victim set, and executes the answer (acquire,
+signal preemption, requeue).
+
+Semantics (docs/scheduling.md):
+
+- **Priority classes**: an experiment names a class (``priorityClass``);
+  trials inherit it. Higher classes dispatch first.
+- **Deficit-weighted fair share**: among equal effective priority, the
+  experiment with the lowest weight-normalized device-seconds consumed goes
+  first; ``fairShareWeight`` scales an experiment's fair share. The exported
+  ``katib_fairshare_deficit`` gauge is each experiment's gap to the
+  most-served competitor.
+- **Aging**: a pending unit's effective priority rises by one point per
+  ``aging_seconds`` waited, so a low class can never starve forever behind
+  a busy high class. Aging affects *ordering* only — it never grants
+  preemption rights.
+- **Backfill + reservation**: the first blocked unit in policy order
+  becomes the *reserving head*. Chips that were already free when it
+  blocked may be backfilled by smaller units behind it (small gangs flow
+  around a blocked large gang); every chip released *while it is blocked*
+  is credited to its reservation and is not backfillable, so the head's
+  progress toward its gang is monotone.
+- **Checkpoint preemption**: a blocked unit may reclaim chips from RUNNING
+  units of *strictly lower* base priority. Victims are chosen lowest
+  priority first, most-recent checkpoint first (least work lost), and are
+  signalled to checkpoint and exit cooperatively; the scheduler requeues
+  them as resumable. A pack preempts as one unit.
+- **FIFO compatibility**: when no experiment in the system sets a
+  priority, weight, or quota, the scheduler takes its legacy arrival-order
+  path untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..api.status import Experiment, Trial
+
+# Well-known priority classes (reference: K8s PriorityClass objects; here a
+# fixed table — validation.py rejects unknown names at admission). The gaps
+# are deliberately small relative to AGING so starvation relief is reachable:
+# one point per aging interval means a "low" unit outranks an endlessly
+# re-arriving "default" stream after 10 intervals.
+PRIORITY_CLASSES: Dict[str, int] = {
+    "": 0,
+    "default": 0,
+    "low": -10,
+    "high": 10,
+    "urgent": 100,
+}
+
+DEFAULT_AGING_SECONDS = 60.0
+
+
+def priority_of(exp: Experiment) -> int:
+    """Base (class) priority of an experiment's trials; unknown names fall
+    back to 0 — admission validation rejects them, but a spec edited on disk
+    must degrade, not crash the dispatch loop."""
+    return PRIORITY_CLASSES.get(getattr(exp.spec, "priority_class", "") or "", 0)
+
+
+def weight_of(exp: Experiment) -> float:
+    w = getattr(exp.spec, "fair_share_weight", 1.0) or 1.0
+    return w if w > 0 else 1.0
+
+
+def device_quota_of(exp: Experiment) -> Optional[int]:
+    """Max devices this experiment may hold concurrently (None = unlimited)."""
+    return getattr(exp.spec.trial_template.resources, "device_quota", None)
+
+
+def uses_fairshare(exp: Experiment) -> bool:
+    """True when any fair-share knob departs from its default — the gate
+    between the legacy FIFO dispatch path and the policy path."""
+    return bool(
+        (getattr(exp.spec, "priority_class", "") or "")
+        or getattr(exp.spec, "fair_share_weight", 1.0) != 1.0
+        or device_quota_of(exp) is not None
+    )
+
+
+@dataclass
+class QueueEntry:
+    """One pending dispatch unit: a solo trial or a formed pack sharing one
+    gang allocation (controller/packing.py plan_packs output)."""
+
+    exp: Experiment
+    trials: List[Trial]
+    needed: int          # devices after clamping to the machine
+    requested: int       # devices as specified
+    seq: int             # arrival order (min over pack members)
+    enqueued_at: float   # earliest member enqueue time
+    priority: int = 0    # base class priority
+
+    @property
+    def key(self) -> str:
+        return self.trials[0].name
+
+
+@dataclass
+class RunningUnit:
+    """One running gang allocation, as the policy sees it for victim
+    selection: a solo trial or a pack (which preempts as one unit)."""
+
+    key: str
+    experiment: str
+    trial_names: List[str]
+    n_devices: int
+    priority: int
+    preemptible: bool    # in-process single-host units only
+    started: float
+    fairshare: bool      # owning experiment uses any fair-share knob
+    handles: List[Any] = field(default_factory=list)
+    preempt_signaled: bool = False
+
+
+class FairSharePolicy:
+    """Deterministic ordering + preemption decisions over queue snapshots.
+
+    Thread-safety: the scheduler calls every method under its own dispatch
+    lock; the internal lock only guards the usage ledger, which release
+    paths charge from worker threads.
+    """
+
+    def __init__(self, aging_seconds: float = DEFAULT_AGING_SECONDS):
+        self.aging_seconds = max(aging_seconds, 1e-6)
+        self._lock = threading.Lock()
+        # weight-normalized device-seconds consumed, per experiment
+        self._usage: Dict[str, float] = {}
+
+    # -- fair-share ledger ---------------------------------------------------
+
+    def charge(self, experiment: str, device_seconds: float, weight: float = 1.0) -> None:
+        """Charge completed usage (devices x wall seconds, divided by the
+        experiment's fair-share weight) — called by the scheduler whenever a
+        gang allocation is released."""
+        with self._lock:
+            self._usage[experiment] = self._usage.get(experiment, 0.0) + (
+                max(device_seconds, 0.0) / max(weight, 1e-9)
+            )
+
+    def forget(self, experiment: str) -> None:
+        """Drop the ledger entry of a deleted experiment."""
+        with self._lock:
+            self._usage.pop(experiment, None)
+
+    def normalized_usage(self, experiment: str) -> float:
+        with self._lock:
+            return self._usage.get(experiment, 0.0)
+
+    def deficits(self, experiments: Sequence[str]) -> Dict[str, float]:
+        """Per-experiment fair-share deficit: the gap between the
+        most-served competitor's normalized usage and one's own. Positive =
+        behind fair share (served less than entitled); the most-served
+        experiment reads 0."""
+        with self._lock:
+            usages = {e: self._usage.get(e, 0.0) for e in experiments}
+        if not usages:
+            return {}
+        top = max(usages.values())
+        return {e: top - u for e, u in usages.items()}
+
+    # -- ordering ------------------------------------------------------------
+
+    def effective_priority(self, priority: float, enqueued_at: float, now: float) -> float:
+        """Base priority plus the aging boost: +1 per aging interval waited."""
+        return priority + max(now - enqueued_at, 0.0) / self.aging_seconds
+
+    def order(self, entries: Sequence[QueueEntry], now: Optional[float] = None) -> List[QueueEntry]:
+        """Dispatch order: effective priority desc, then weight-normalized
+        usage asc (deficit-weighted fair share — the least-served experiment
+        goes first), then arrival order."""
+        now = time.time() if now is None else now
+        with self._lock:
+            usage = dict(self._usage)
+        return sorted(
+            entries,
+            key=lambda e: (
+                -self.effective_priority(e.priority, e.enqueued_at, now),
+                usage.get(e.exp.name, 0.0),
+                e.seq,
+            ),
+        )
+
+    # -- preemption ----------------------------------------------------------
+
+    @staticmethod
+    def select_victims(
+        needed: int,
+        free: int,
+        priority: int,
+        candidates: Sequence[RunningUnit],
+        checkpoint_time: Callable[[str], float],
+    ) -> List[RunningUnit]:
+        """Victim set that unblocks a gang of ``needed`` devices, or [] when
+        preemption cannot help. Only units of strictly lower BASE priority
+        are eligible (the caller pre-filters preemptibility); among them the
+        ISSUE's discipline applies: lowest priority first, most-recent
+        checkpoint first (least progress lost), newest start last as the
+        final tie-break. All-or-nothing: if even preempting every candidate
+        leaves the gang short, nothing is preempted."""
+        eligible = [
+            u for u in candidates
+            if u.priority < priority and u.preemptible and not u.preempt_signaled
+        ]
+        if free + sum(u.n_devices for u in eligible) < needed:
+            return []
+
+        def unit_ckpt(u: RunningUnit) -> float:
+            return max((checkpoint_time(t) for t in u.trial_names), default=0.0)
+
+        eligible.sort(key=lambda u: (u.priority, -unit_ckpt(u), -u.started))
+        victims: List[RunningUnit] = []
+        reclaimed = free
+        for u in eligible:
+            if reclaimed >= needed:
+                break
+            victims.append(u)
+            reclaimed += u.n_devices
+        return victims

@@ -36,7 +36,12 @@ import numpy as np
 
 from ..api.status import Experiment, Trial
 from ..db.store import ObservationStore
-from ..runtime.metrics import EarlyStopped, TrialKilled, set_current_reporter
+from ..runtime.metrics import (
+    EarlyStopped,
+    TrialKilled,
+    TrialPreempted,
+    set_current_reporter,
+)
 from ..runtime.packed import PackedTrialContext, PackFrozen
 from .executor import (
     ExecutionResult,
@@ -192,7 +197,7 @@ class PackedTrialExecutor:
                 }
                 if numeric:
                     ctx.report(**numeric)
-        except (PackFrozen, EarlyStopped, TrialKilled):
+        except (PackFrozen, EarlyStopped, TrialKilled, TrialPreempted):
             pass  # every member already carries its own terminal mask
         except Exception:
             # one shared compiled program: an escaping exception has no
@@ -207,7 +212,7 @@ class PackedTrialExecutor:
             _m._current_reporter.reset(token)
 
         results: List[ExecutionResult] = []
-        for i, (stopped, killed, failed, fail_msg) in enumerate(
+        for i, (stopped, killed, failed, fail_msg, preempted) in enumerate(
             ctx.member_outcomes()
         ):
             if failed:
@@ -217,6 +222,13 @@ class PackedTrialExecutor:
             elif killed:
                 results.append(
                     ExecutionResult(TrialOutcome.KILLED, "kill requested")
+                )
+            elif preempted:
+                results.append(
+                    ExecutionResult(
+                        TrialOutcome.PREEMPTED,
+                        "preempted by higher-priority work",
+                    )
                 )
             elif stopped:
                 results.append(ExecutionResult(TrialOutcome.EARLY_STOPPED))

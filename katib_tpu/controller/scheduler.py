@@ -15,6 +15,13 @@ K8s jobs and mapping their conditions back via GJSON, the scheduler
 - pushes a completion event that wakes the experiment controller — replacing
   K8s watch events and the 1-second metrics requeue
   (trial_controller.go:182-185) with direct event delivery.
+
+Dispatch order is governed by the fair-share policy (controller/fairshare.py):
+priority classes, per-experiment device quotas, deficit-weighted fair-share
+ordering with aging, backfill around a blocked gang's reservation, and
+checkpoint-based preemption of lower-priority running trials. When no
+experiment sets any fair-share knob, the legacy arrival-order path runs
+unchanged.
 """
 
 from __future__ import annotations
@@ -91,7 +98,12 @@ class TrialScheduler:
         max_trial_restarts: int = 0,
         poll_interval: Optional[float] = None,
         devices_per_host: Optional[int] = None,
+        queue_stall_seconds: float = 120.0,
+        aging_seconds: float = 60.0,
+        preemption_grace_seconds: float = 30.0,
     ):
+        from .fairshare import FairSharePolicy
+
         self.recorder = events
         self.metrics_registry = metrics
         if devices is None:
@@ -121,6 +133,24 @@ class TrialScheduler:
         self._quarantined = 0  # devices held by abandoned zombie trials
         self._shutdown = threading.Event()
         self._intentional_kills: set = set()  # kill() targets, vs shutdown kills
+        # -- fair-share scheduling state (controller/fairshare.py) -----------
+        self.queue_stall_seconds = queue_stall_seconds
+        self.preemption_grace_seconds = preemption_grace_seconds
+        self._policy = FairSharePolicy(aging_seconds=aging_seconds)
+        self._seq_counter = 0                      # arrival order for the queue
+        self._enqueue_seq: Dict[str, int] = {}     # trial -> arrival seq
+        self._enqueued_at: Dict[str, float] = {}   # trial -> pending since
+        self._stall_emitted: set = set()           # TrialQueueStalled once/stint
+        self._usage: Dict[str, int] = {}           # experiment -> devices held
+        self._running: Dict[str, Any] = {}         # unit key -> RunningUnit
+        self._preempting: set = set()              # trials signalled to preempt
+        self._last_checkpoint: Dict[str, float] = {}  # trial -> last ckpt save
+        self._gauged_experiments: set = set()      # queue gauges to zero out
+        # backfill reservation: the first blocked unit in policy order
+        # earmarks every chip released while it stays blocked (its credits);
+        # backfill may only use free chips beyond the credits
+        self._head_key: Optional[str] = None
+        self._head_credits = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -169,9 +199,24 @@ class TrialScheduler:
             # trains FROM a parent checkpoint — same params, different run)
             return
         with self._lock:
+            self._stamp_enqueue(trial.name)
             self._waiting.append((exp, trial))
         if dispatch:
             self._dispatch()
+
+    def _stamp_enqueue(self, trial_name: str) -> None:
+        """Record arrival order + pending-since for the fair-share queue;
+        caller holds the scheduler lock."""
+        self._seq_counter += 1
+        self._enqueue_seq[trial_name] = self._seq_counter
+        self._enqueued_at[trial_name] = time.time()
+
+    def _clear_enqueue(self, trial_name: str) -> None:
+        """Drop a trial's queue bookkeeping (dispatched or killed while
+        pending); caller holds the scheduler lock."""
+        self._enqueue_seq.pop(trial_name, None)
+        self._enqueued_at.pop(trial_name, None)
+        self._stall_emitted.discard(trial_name)
 
     def dispatch(self) -> None:
         """Start every waiting trial/pack whose gang allocation fits (the
@@ -240,6 +285,7 @@ class TrialScheduler:
                 if t.name == trial_name:
                     self._waiting.pop(i)
                     self._checkpoint_dirs.pop(trial_name, None)
+                    self._clear_enqueue(trial_name)
                     t.set_condition(TrialCondition.KILLED, "TrialKilled", "killed while pending")
                     self.state.update_trial(t)
                     self.events.put(TrialEvent(exp.name, t.name, t.condition))
@@ -257,6 +303,10 @@ class TrialScheduler:
         with self._lock:
             waiting = list(self._waiting)
             self._waiting.clear()
+            self._enqueue_seq.clear()
+            self._enqueued_at.clear()
+            self._stall_emitted.clear()
+            self._head_key, self._head_credits = None, 0
         for exp, t in waiting:
             t.set_condition(TrialCondition.KILLED, "SchedulerShutdown", "scheduler shutdown")
             self.state.update_trial(t)
@@ -312,46 +362,227 @@ class TrialScheduler:
         packing.plan_packs: packable same-template trials of one experiment
         merge into packs of up to K = pack_capacity(exp) members sharing ONE
         gang allocation and one compiled program; everything else dispatches
-        solo through the unchanged per-trial path."""
+        solo through the unchanged per-trial path.
+
+        Units are then walked in fair-share policy order (priority + aging,
+        deficit-weighted fair share, arrival order — controller/fairshare.py)
+        with quota enforcement, backfill-vs-reservation, and preemption
+        planning. When no experiment in the system sets any fair-share knob,
+        the walk degenerates to the legacy path: arrival order, every unit
+        tries its allocation, misses requeue — FIFO preserved exactly."""
+        from . import fairshare as fs
         from .packing import plan_packs
 
+        now = time.time()
         with self._lock:
             self._threads = [t for t in self._threads if t.is_alive()]
             units = plan_packs(self._waiting)
             self._waiting = []
+            entries: List[fs.QueueEntry] = []
             for exp, members in units:
                 requested = max(exp.spec.trial_template.resources.num_devices, 1)
-                n = min(requested, self.allocator.total)  # clamp to the machine
+                entries.append(
+                    fs.QueueEntry(
+                        exp=exp,
+                        trials=members,
+                        needed=min(requested, self.allocator.total),
+                        requested=requested,
+                        seq=min(self._enqueue_seq.get(t.name, 0) for t in members),
+                        enqueued_at=min(
+                            self._enqueued_at.get(t.name, now) for t in members
+                        ),
+                        priority=fs.priority_of(exp),
+                    )
+                )
+            fairshare_on = any(fs.uses_fairshare(e.exp) for e in entries) or any(
+                u.fairshare for u in self._running.values()
+            )
+            ordered = self._policy.order(entries, now) if fairshare_on else entries
+            free = self.allocator.free_count
+            leftover: List[fs.QueueEntry] = []
+            head_seen = False
+            if not fairshare_on:
+                self._head_key, self._head_credits = None, 0
+            for e in ordered:
+                n = e.needed
+                quota = fs.device_quota_of(e.exp)
+                if quota is not None and self._usage.get(e.exp.name, 0) + n > quota:
+                    # quota-blocked: holds no reservation — units behind it
+                    # flow around freely
+                    leftover.append(e)
+                    continue
+                if fairshare_on:
+                    if not head_seen and free < n:
+                        # first blocked unit in policy order becomes the
+                        # reserving head: chips released while it stays
+                        # blocked accrue to its credits and cannot be
+                        # backfilled, so its gang assembles monotonically
+                        head_seen = True
+                        if self._head_key != e.key:
+                            self._head_key, self._head_credits = e.key, 0
+                        self._head_credits = min(self._head_credits, n)
+                        self._plan_preemption(e, free)
+                        leftover.append(e)
+                        continue
+                    reserved = min(self._head_credits, free) if head_seen else 0
+                    if free - reserved < n:
+                        leftover.append(e)
+                        continue
                 devices = self.allocator.acquire(n)
                 if devices is None:
-                    self._waiting.extend((exp, t) for t in members)
+                    leftover.append(e)
                     continue
-                if n < requested:
-                    for t in members:
-                        self._devices_clamped(exp, t, requested, n)
-                if len(members) == 1:
-                    trial = members[0]
-                    handle = TrialExecution()
-                    self._handles[trial.name] = handle
-                    th = threading.Thread(
-                        target=self._run_trial,
-                        args=(exp, trial, devices, handle),
-                        name=f"trial-{trial.name}",
-                        daemon=True,
+                free -= n
+                if e.key == self._head_key:
+                    self._head_key, self._head_credits = None, 0
+                self._start_unit(e, devices)
+            if fairshare_on and not head_seen:
+                # the previous head dispatched or left the queue
+                self._head_key, self._head_credits = None, 0
+            self._waiting = [(e.exp, t) for e in leftover for t in e.trials]
+            self._note_queue_state(leftover, now)
+
+    def _start_unit(self, entry, devices) -> None:
+        """Spawn the worker thread for one dispatch unit (solo or pack) and
+        register its running-unit record; caller holds the scheduler lock."""
+        from .fairshare import RunningUnit, priority_of, uses_fairshare
+
+        exp, members = entry.exp, entry.trials
+        n = len(devices)
+        if n < entry.requested:
+            for t in members:
+                self._devices_clamped(exp, t, entry.requested, n)
+        for t in members:
+            self._clear_enqueue(t.name)
+        self._usage[exp.name] = self._usage.get(exp.name, 0) + n
+        template = exp.spec.trial_template
+        if len(members) == 1:
+            trial = members[0]
+            handle = TrialExecution()
+            handles = [handle]
+            self._handles[trial.name] = handle
+            th = threading.Thread(
+                target=self._run_trial,
+                args=(exp, trial, devices, handle),
+                name=f"trial-{trial.name}",
+                daemon=True,
+            )
+        else:
+            handles = [TrialExecution() for _ in members]
+            for t, h in zip(members, handles):
+                self._handles[t.name] = h
+            self._record_pack_formed(exp, members)
+            th = threading.Thread(
+                target=self._run_pack,
+                args=(exp, members, devices, handles),
+                name=f"trial-pack-{members[0].name}",
+                daemon=True,
+            )
+        self._running[entry.key] = RunningUnit(
+            key=entry.key,
+            experiment=exp.name,
+            trial_names=[t.name for t in members],
+            n_devices=n,
+            priority=priority_of(exp),
+            # preemption is cooperative through ctx.report(): only
+            # in-process single-host units can checkpoint-and-yield
+            preemptible=template.command is None and template.resources.num_hosts <= 1,
+            started=time.time(),
+            fairshare=uses_fairshare(exp),
+            handles=handles,
+        )
+        self._threads.append(th)
+        th.start()
+
+    def _plan_preemption(self, entry, free: int) -> None:
+        """Ask the policy for a victim set that unblocks ``entry`` and
+        signal it: lowest priority first, most-recent checkpoint first.
+        Victims checkpoint-and-exit cooperatively at their next report; a
+        victim that ignores the signal past the grace window is killed (it
+        still requeues, resuming from its last checkpoint, if any). Caller
+        holds the scheduler lock."""
+        victims = self._policy.select_victims(
+            entry.needed,
+            free,
+            entry.priority,
+            list(self._running.values()),
+            lambda t: self._last_checkpoint.get(t, 0.0),
+        )
+        if not victims:
+            return
+        # preemption is actively clearing chips for this gang — earmark the
+        # currently-free chips too, so backfill can't take what the victims
+        # are about to deliver
+        self._head_credits = max(self._head_credits, min(entry.needed, free))
+        for u in victims:
+            u.preempt_signaled = True
+            self._preempting.update(u.trial_names)
+            for h in u.handles:
+                h.preempt()
+            log.info(
+                "preempting %s (%d device(s), priority %d) for %s "
+                "(%d device(s), priority %d)",
+                ",".join(u.trial_names), u.n_devices, u.priority,
+                entry.key, entry.needed, entry.priority,
+            )
+            if self.preemption_grace_seconds:
+                timer = threading.Timer(
+                    self.preemption_grace_seconds,
+                    lambda hs=list(u.handles): [h.kill() for h in hs],
+                )
+                timer.daemon = True
+                timer.start()
+
+    def _note_queue_state(self, leftover, now: float) -> None:
+        """Per-dispatch-pass queue observability: TrialQueueStalled warnings
+        for trials pending past the threshold, plus the katib_queue_depth /
+        katib_queue_wait_seconds / katib_fairshare_deficit gauges. Caller
+        holds the scheduler lock."""
+        depth: Dict[str, int] = {}
+        oldest: Dict[str, float] = {}
+        for e in leftover:
+            for t in e.trials:
+                depth[e.exp.name] = depth.get(e.exp.name, 0) + 1
+                wait = max(now - self._enqueued_at.get(t.name, now), 0.0)
+                oldest[e.exp.name] = max(oldest.get(e.exp.name, 0.0), wait)
+                if (
+                    self.queue_stall_seconds
+                    and wait > self.queue_stall_seconds
+                    and t.name not in self._stall_emitted
+                ):
+                    self._stall_emitted.add(t.name)
+                    log.warning(
+                        "trial %s has been pending %.0fs for %d device(s) "
+                        "(free: %d) — head-of-line blocking, quota, or "
+                        "starvation", t.name, wait, e.needed,
+                        self.allocator.free_count,
                     )
-                else:
-                    handles = [TrialExecution() for _ in members]
-                    for t, h in zip(members, handles):
-                        self._handles[t.name] = h
-                    self._record_pack_formed(exp, members)
-                    th = threading.Thread(
-                        target=self._run_pack,
-                        args=(exp, members, devices, handles),
-                        name=f"trial-pack-{members[0].name}",
-                        daemon=True,
-                    )
-                self._threads.append(th)
-                th.start()
+                    if self.recorder is not None:
+                        self.recorder.event(
+                            e.exp.name, "Trial", t.name, "TrialQueueStalled",
+                            f"pending for {wait:.0f}s waiting for {e.needed} "
+                            f"device(s) (free: {self.allocator.free_count}); "
+                            "see /api/queue for queue state",
+                            warning=True,
+                        )
+        if self.metrics_registry is not None:
+            names = set(depth) | self._gauged_experiments
+            deficits = self._policy.deficits(sorted({e.exp.name for e in leftover}))
+            for name in names:
+                self.metrics_registry.set_gauge(
+                    "katib_queue_depth", float(depth.get(name, 0)), experiment=name
+                )
+                self.metrics_registry.set_gauge(
+                    "katib_queue_wait_seconds",
+                    round(oldest.get(name, 0.0), 3),
+                    experiment=name,
+                )
+                self.metrics_registry.set_gauge(
+                    "katib_fairshare_deficit",
+                    round(deficits.get(name, 0.0), 3),
+                    experiment=name,
+                )
+            self._gauged_experiments = set(depth)
 
     def _devices_clamped(
         self, exp: Experiment, trial: Trial, requested: int, granted: int
@@ -393,6 +624,8 @@ class TrialScheduler:
 
     def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
         restarted = False
+        requeued = False
+        started = time.time()
         timer = None
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
@@ -431,32 +664,44 @@ class TrialScheduler:
                     TrialOutcome.FAILED,
                     f"trial exceeded timeout of {self.trial_timeout}s",
                 )
-            # Classify (observation fold + success/failure conditions) BEFORE
-            # the restart decision: a non-zero-exit trial a success_condition
-            # rescues must not burn max_trial_restarts attempts, and an rc=0
-            # trial a failure_condition flips to Failed must be retried like
-            # any other failure.
-            result, observation = self._classify(exp, trial, result)
-            restarted = self._maybe_restart(exp, trial, result)
-            if not restarted:
-                self._finalize(exp, trial, result, observation)
+            # Preemption first: a preempted trial is neither classified nor
+            # finalized — it requeues as resumable and its next run's fold
+            # continues the same observation log (checkpoint resume) or a
+            # clean one (no checkpoint).
+            if self._preempt_applies(trial, result):
+                requeued = self._requeue_preempted(exp, trial)
+            if not requeued:
+                # Classify (observation fold + success/failure conditions)
+                # BEFORE the restart decision: a non-zero-exit trial a
+                # success_condition rescues must not burn max_trial_restarts
+                # attempts, and an rc=0 trial a failure_condition flips to
+                # Failed must be retried like any other failure.
+                result, observation = self._classify(exp, trial, result)
+                restarted = self._maybe_restart(exp, trial, result)
+                if not restarted:
+                    self._finalize(exp, trial, result, observation)
         except Exception:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
             self.state.update_trial(trial)
         finally:
             if timer is not None:
                 timer.cancel()
+            with self._lock:
+                self._running.pop(trial.name, None)
+                if not requeued:
+                    self._preempting.discard(trial.name)
             if abandoned is not None and abandoned.is_alive():
                 # An abandoned in-process trial may still be running JAX work
                 # on these chips — quarantine them (don't hand them to the
                 # next trial) until the zombie thread actually exits.
-                self._quarantine(trial.name, devices, abandoned)
+                self._quarantine(trial.name, devices, abandoned, exp, started)
             else:
-                self.allocator.release(devices)
+                self._release_allocation(exp, devices, started)
             self._handles.pop(trial.name, None)
-            if not restarted:
+            if not restarted and not requeued:
                 self._checkpoint_dirs.pop(trial.name, None)
                 self._restarts.pop(trial.name, None)
+                self._last_checkpoint.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
 
@@ -474,6 +719,8 @@ class TrialScheduler:
         from .packing import PACK_LABEL, PackedTrialExecutor
 
         timer = None
+        started = time.time()
+        requeued: set = set()
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         pack_id = f"{trials[0].name}x{len(trials)}"
@@ -507,12 +754,19 @@ class TrialScheduler:
                         TrialOutcome.FAILED,
                         f"trial exceeded timeout of {self.trial_timeout}s",
                     )
+                # a pack preempts as one unit, but members requeue
+                # individually — they re-pack (or run solo) on redispatch
+                if self._preempt_applies(trial, result):
+                    if self._requeue_preempted(exp, trial):
+                        requeued.add(trial.name)
+                        continue
                 result, observation = self._classify(exp, trial, result)
                 restarted = self._maybe_restart(exp, trial, result)
                 if not restarted:
                     self._finalize(exp, trial, result, observation)
                     self._checkpoint_dirs.pop(trial.name, None)
                     self._restarts.pop(trial.name, None)
+                    self._last_checkpoint.pop(trial.name, None)
         except Exception:
             tb = traceback.format_exc(limit=5)
             for t in trials:
@@ -522,10 +776,15 @@ class TrialScheduler:
         finally:
             if timer is not None:
                 timer.cancel()
+            with self._lock:
+                self._running.pop(trials[0].name, None)
+                for t in trials:
+                    if t.name not in requeued:
+                        self._preempting.discard(t.name)
             if abandoned is not None and abandoned.is_alive():
-                self._quarantine(pack_id, devices, abandoned)
+                self._quarantine(pack_id, devices, abandoned, exp, started)
             else:
-                self.allocator.release(devices)
+                self._release_allocation(exp, devices, started)
             for t in trials:
                 self._handles.pop(t.name, None)
                 self.events.put(TrialEvent(exp.name, t.name, t.condition))
@@ -635,6 +894,7 @@ class TrialScheduler:
             member_labels=[dict(t.labels) for t in trials],
             devices=list(devices),
             topology=spec.trial_template.resources.topology,
+            preempt_events=[h.preempt_event for h in handles],
         )
 
     KILL_GRACE_SECONDS = 30.0
@@ -683,10 +943,17 @@ class TrialScheduler:
         return box["result"], None
 
     def _quarantine(
-        self, trial_name: str, devices: Sequence[Any], worker: threading.Thread
+        self,
+        trial_name: str,
+        devices: Sequence[Any],
+        worker: threading.Thread,
+        exp: Experiment,
+        started: float,
     ) -> None:
         """Hold the gang allocation of an abandoned (zombie) trial until its
-        worker thread actually exits, then release and re-dispatch."""
+        worker thread actually exits, then release and re-dispatch. The
+        zombie keeps burning the chips, so the experiment stays charged (and
+        quota-attributed) until the actual release."""
         with self._lock:
             self._quarantined += len(devices)
         log.warning(
@@ -702,12 +969,147 @@ class TrialScheduler:
                 "abandoned trial %s finally exited; releasing %d quarantined "
                 "device(s)", trial_name, len(devices),
             )
-            self.allocator.release(devices)
+            self._release_allocation(exp, devices, started)
             self._dispatch()
 
         threading.Thread(
             target=_reap, daemon=True, name=f"reap-{trial_name}"
         ).start()
+
+    def _release_allocation(self, exp: Experiment, devices: Sequence[Any], started: float) -> None:
+        """The one release path for gang allocations: fair-share usage is
+        charged (device-seconds / weight), the experiment's quota attribution
+        drops, and chips released while a blocked head holds the reservation
+        accrue to its backfill-proof credits."""
+        from .fairshare import weight_of
+
+        elapsed = max(time.time() - started, 0.0)
+        with self._lock:
+            self._usage[exp.name] = max(0, self._usage.get(exp.name, 0) - len(devices))
+            if self._head_key is not None:
+                self._head_credits += len(devices)
+        self._policy.charge(exp.name, len(devices) * elapsed, weight_of(exp))
+        self.allocator.release(devices)
+
+    def _note_checkpoint(self, trial_name: str) -> None:
+        """ctx.checkpoint_store() save hook: victim selection prefers
+        recently-checkpointed trials, and a preempted trial resumes (keeps
+        its observation log) only if it checkpointed at all."""
+        self._last_checkpoint[trial_name] = time.time()
+
+    def _preempt_applies(self, trial: Trial, result: ExecutionResult) -> bool:
+        """Did this trial end because the fair-share policy preempted it?
+        PREEMPTED is the cooperative exit; KILLED covers the grace-window
+        escalation. A deliberate kill() or a controller shutdown always wins
+        over a pending preemption, and a timeout (FAILED) stays a failure."""
+        if self._shutdown.is_set():
+            return False
+        with self._lock:
+            signaled = trial.name in self._preempting
+            deliberate = trial.name in self._intentional_kills
+        return (
+            signaled
+            and not deliberate
+            and result.outcome in (TrialOutcome.PREEMPTED, TrialOutcome.KILLED)
+        )
+
+    def _requeue_preempted(self, exp: Experiment, trial: Trial) -> bool:
+        """Requeue a preempted trial as resumable: PENDING again, back of
+        the fair-share queue (its lower priority keeps it behind the gang
+        that preempted it). With a checkpoint on record the observation log
+        is KEPT — the resumed run continues reporting where it stopped, so
+        the folded metrics are bit-identical to an unpreempted run; without
+        one the re-run starts from scratch and the log is dropped (the same
+        invariant as restart requeues)."""
+        with self._lock:
+            self._preempting.discard(trial.name)
+        has_checkpoint = trial.name in self._last_checkpoint
+        if not has_checkpoint:
+            self.obs_store.delete_observation_log(trial.name)
+        trial.set_condition(
+            TrialCondition.PENDING,
+            "TrialPreempted",
+            "preempted by higher-priority work; requeued"
+            + (" (resumes from checkpoint)" if has_checkpoint else ""),
+        )
+        self.state.update_trial(trial)
+        if self.metrics_registry is not None:
+            self.metrics_registry.inc(
+                "katib_trial_preempted_total", experiment=exp.name
+            )
+        if self.recorder is not None:
+            self.recorder.event(
+                exp.name, "Trial", trial.name, "TrialPreempted",
+                "trial preempted by higher-priority work and requeued"
+                + (" (resumes from checkpoint)" if has_checkpoint else ""),
+            )
+        with self._lock:
+            self._stamp_enqueue(trial.name)
+            self._waiting.append((exp, trial))
+        return True
+
+    def forget_experiment(self, name: str) -> None:
+        """Drop a deleted experiment's fair-share ledger + quota attribution
+        so a future namesake starts with a clean share."""
+        self._policy.forget(name)
+        with self._lock:
+            self._usage.pop(name, None)
+
+    def queue_state(self) -> Dict[str, Any]:
+        """Observable queue snapshot for /api/queue and the CLI: pending
+        trials with priority / wait / fair-share deficit, running units, and
+        the device pool."""
+        from . import fairshare as fs
+
+        now = time.time()
+        with self._lock:
+            waiting = list(self._waiting)
+            running = list(self._running.values())
+            enq = dict(self._enqueued_at)
+            quarantined = self._quarantined
+            usage = dict(self._usage)
+        deficits = self._policy.deficits(sorted({exp.name for exp, _ in waiting}))
+        pending = []
+        for exp, t in waiting:
+            enqueued = enq.get(t.name, now)
+            prio = fs.priority_of(exp)
+            pending.append(
+                {
+                    "trial": t.name,
+                    "experiment": exp.name,
+                    "priorityClass": exp.spec.priority_class or "default",
+                    "priority": prio,
+                    "effectivePriority": round(
+                        self._policy.effective_priority(prio, enqueued, now), 3
+                    ),
+                    "waitSeconds": round(max(now - enqueued, 0.0), 3),
+                    "numDevices": max(exp.spec.trial_template.resources.num_devices, 1),
+                    "deviceQuota": fs.device_quota_of(exp),
+                    "fairShareDeficit": round(deficits.get(exp.name, 0.0), 3),
+                }
+            )
+        pending.sort(key=lambda p: (-p["effectivePriority"], -p["waitSeconds"]))
+        return {
+            "devices": {
+                "total": self.allocator.total,
+                "free": self.allocator.free_count,
+                "quarantined": quarantined,
+                "usageByExperiment": usage,
+            },
+            "pending": pending,
+            "running": [
+                {
+                    "unit": u.key,
+                    "experiment": u.experiment,
+                    "trials": list(u.trial_names),
+                    "devices": u.n_devices,
+                    "priority": u.priority,
+                    "preempting": u.preempt_signaled,
+                    "runningSeconds": round(now - u.started, 3),
+                }
+                for u in running
+            ],
+        }
 
     @property
     def quarantined_count(self) -> int:
@@ -734,6 +1136,7 @@ class TrialScheduler:
         )
         self.state.update_trial(trial)
         with self._lock:
+            self._stamp_enqueue(trial.name)
             self._waiting.append((exp, trial))
         return True
 
@@ -753,6 +1156,7 @@ class TrialScheduler:
             trial_name=trial.name,
             monitor=monitor,
             kill_event=handle.kill_event if handle is not None else None,
+            preempt_event=handle.preempt_event if handle is not None else None,
         )
         workdir = None
         if self.workdir_root:
@@ -770,6 +1174,7 @@ class TrialScheduler:
             devices=list(devices),
             labels=dict(trial.labels),
             topology=spec.trial_template.resources.topology,
+            on_checkpoint=lambda step, _t=trial.name: self._note_checkpoint(_t),
         )
 
     CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions

@@ -25,6 +25,24 @@ import numpy as np
 AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "model")
 
 
+def distributed_initialized() -> bool:
+    """Is the jax.distributed client up? ``jax.distributed.is_initialized``
+    only exists on newer jax; older versions keep the state object in
+    ``jax._src.distributed`` — probe both rather than crash on a version
+    mismatch. Inspects only the distributed client, never the XLA backend."""
+    import jax
+
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -41,8 +59,8 @@ def initialize_distributed(
 
     # NOTE: must not touch jax.process_count()/jax.devices() here — those
     # initialize the XLA backend, after which jax.distributed.initialize()
-    # refuses to run. is_initialized() inspects only the distributed client.
-    if jax.distributed.is_initialized():
+    # refuses to run. distributed_initialized() inspects only the client.
+    if distributed_initialized():
         return
     addr = coordinator_address or os.environ.get("KATIB_TPU_COORDINATOR")
     nproc = num_processes or int(os.environ.get("KATIB_TPU_NUM_PROCESSES", "0"))

@@ -21,6 +21,8 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..parallel.mesh import distributed_initialized as _dist_init
+
 MAX_TO_KEEP = 3
 
 
@@ -75,7 +77,7 @@ class CheckpointStore:
                 use_orbax = True
             except ImportError:
                 use_orbax = False
-            if use_orbax and jax.distributed.is_initialized():
+            if use_orbax and _dist_init():
                 # Gang workers get INDEPENDENT per-rank stores (store_for:
                 # per-host workdirs / rank-<i> subdirs), but orbax's
                 # CheckpointManager runs sync_global_processes barriers that
@@ -101,6 +103,12 @@ class CheckpointStore:
         if self.use_orbax:
             import orbax.checkpoint as ocp
 
+            # numpy scalar leaves (np.int32(step)...) -> 0-d ndarrays: newer
+            # orbax StandardSave rejects numpy scalar types outright
+            state = jax.tree.map(
+                lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+                state,
+            )
             with self._manager() as mngr:
                 mngr.save(step, args=ocp.args.StandardSave(state))
                 mngr.wait_until_finished()
@@ -138,7 +146,10 @@ class CheckpointStore:
             with self._manager() as mngr:
                 if template is not None:
                     return mngr.restore(step, args=ocp.args.StandardRestore(template))
-                return mngr.restore(step)
+                # template-less StandardRestore: newer orbax refuses a bare
+                # restore() (KeyError: no CheckpointArgs); the explicit empty
+                # StandardRestore reconstructs from checkpoint metadata
+                return mngr.restore(step, args=ocp.args.StandardRestore())
         path = os.path.join(self.directory, f"ckpt_{step}.pkl")
         with open(path, "rb") as f:
             return pickle.load(f)["state"]

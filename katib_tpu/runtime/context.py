@@ -10,7 +10,7 @@ and the gang-allocated device set from which the trial builds its mesh.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from .metrics import MetricsReporter
 
@@ -26,11 +26,26 @@ class TrialContext:
     devices: Optional[List[Any]] = None  # jax devices gang-allocated to this trial
     labels: Dict[str, str] = field(default_factory=dict)
     topology: Optional[str] = None  # resources.topology — default mesh shape
+    # Scheduler hook stamped on every checkpoint save (fairshare victim
+    # selection prefers recently-checkpointed trials; resume-vs-restart on
+    # preemption hinges on whether a checkpoint exists at all).
+    on_checkpoint: Optional[Callable[[int], None]] = None
 
     def report(self, **metrics: float) -> None:
         """Push metrics; raises katib_tpu.runtime.metrics.EarlyStopped when all
-        early-stopping rules have tripped."""
+        early-stopping rules have tripped, TrialPreempted when the fair-share
+        policy needs this trial's chips (metrics are persisted first — save
+        your checkpoint BEFORE reporting and preemption loses nothing)."""
         self.reporter.report(**metrics)
+
+    @property
+    def preempt_requested(self) -> bool:
+        """True once the fair-share policy selected this trial as a
+        preemption victim. Long in-step loops that rarely report can poll
+        this, save a checkpoint, and call report() (which raises
+        TrialPreempted) to yield their devices promptly."""
+        ev = getattr(self.reporter, "preempt_event", None)
+        return ev is not None and ev.is_set()
 
     def profile(self, enabled: bool = True):
         """Context manager: capture a JAX profiler (xplane) trace of the
@@ -95,7 +110,16 @@ class TrialContext:
         instead of starting over."""
         from .checkpoints import store_for
 
-        return store_for(self.checkpoint_dir, self.workdir, subdir)
+        store = store_for(self.checkpoint_dir, self.workdir, subdir)
+        if self.on_checkpoint is not None:
+            notify, orig_save = self.on_checkpoint, store.save
+
+            def _save(step, state, _notify=notify, _orig=orig_save):
+                _orig(step, state)
+                _notify(step)
+
+            store.save = _save  # instance-level shadow; CheckpointStore API unchanged
+        return store
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.assignments.get(name, default)

@@ -54,6 +54,15 @@ class TrialKilled(Exception):
     of the reference sidecar killing the training process."""
 
 
+class TrialPreempted(Exception):
+    """Raised inside in-process trial code when the fair-share policy
+    (controller/fairshare.py) selected this trial as a preemption victim:
+    a higher-priority gang needs the chips. Raised AFTER the report's
+    metrics are persisted, so a trial that saves a checkpoint before each
+    report loses nothing — the scheduler requeues it as resumable and it
+    continues from its latest checkpoint when devices free up."""
+
+
 class EarlyStoppingMonitor:
     """Stateful rule tracker, mirroring updateStopRules (main.go:336-386)."""
 
@@ -123,6 +132,7 @@ class MetricsReporter:
     monitor: Optional[EarlyStoppingMonitor] = None
     raise_on_stop: bool = True
     kill_event: Optional[Any] = None  # threading.Event from the scheduler
+    preempt_event: Optional[Any] = None  # threading.Event — fairshare preemption
     _stopped: bool = False
 
     def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
@@ -133,9 +143,12 @@ class MetricsReporter:
             for k, f in fvals.items()
         ]
         self.store.report_observation_log(self.trial_name, logs)
-        # after the write, so a killed trial's final metrics are not lost
+        # after the write, so a killed trial's final metrics are not lost;
+        # kill is checked before preempt — it is the stronger signal
         if self.kill_event is not None and self.kill_event.is_set():
             raise TrialKilled(f"trial {self.trial_name} killed")
+        if self.preempt_event is not None and self.preempt_event.is_set():
+            raise TrialPreempted(f"trial {self.trial_name} preempted")
         if self.monitor is not None:
             for k, fv in fvals.items():
                 if self.monitor.observe(k, fv):

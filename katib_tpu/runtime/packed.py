@@ -114,6 +114,9 @@ class PackedTrialContext:
     member_labels: List[Dict[str, str]] = field(default_factory=list)
     devices: Optional[List[Any]] = None
     topology: Optional[str] = None
+    # fair-share preemption: a pack preempts as ONE unit (it holds one gang
+    # allocation), so the scheduler sets every member's event together
+    preempt_events: List[Optional[threading.Event]] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         k = len(self.trial_names)
@@ -121,6 +124,7 @@ class PackedTrialContext:
         self._stopped = [False] * k
         self._killed = [False] * k
         self._failed = [False] * k
+        self._preempted = [False] * k
         self._fail_messages: List[str] = [""] * k
         if not self.workdirs:
             self.workdirs = [None] * k
@@ -128,6 +132,8 @@ class PackedTrialContext:
             self.checkpoint_dirs = [None] * k
         if not self.member_labels:
             self.member_labels = [{} for _ in range(k)]
+        if not self.preempt_events:
+            self.preempt_events = [None] * k
 
     @property
     def pack_size(self) -> int:
@@ -153,11 +159,17 @@ class PackedTrialContext:
             self._failed[i] = True
             self._fail_messages[i] = message
 
-    def _sweep_kills(self) -> None:
+    def _sweep_kills(self, preempts: bool = True) -> None:
         for i, ev in enumerate(self.kill_events):
             if self._active[i] and ev is not None and ev.is_set():
                 self._active[i] = False
                 self._killed[i] = True
+        if not preempts:
+            return
+        for i, ev in enumerate(self.preempt_events):
+            if self._active[i] and ev is not None and ev.is_set():
+                self._active[i] = False
+                self._preempted[i] = True
 
     def report(self, timestamp: Optional[float] = None, **metrics) -> None:
         """Demux per-member metric arrays into per-trial observation logs.
@@ -197,6 +209,14 @@ class PackedTrialContext:
                 self._active[i] = False
                 self._killed[i] = True
                 continue
+            pev = self.preempt_events[i]
+            if pev is not None and pev.is_set():
+                # like a kill, the member's in-flight metrics were written
+                # first; the frozen member requeues and resumes from its
+                # checkpoint, its log continuing exactly where it stopped
+                self._active[i] = False
+                self._preempted[i] = True
+                continue
             if self.reporters[i].stopped:
                 self._active[i] = False
                 self._stopped[i] = True
@@ -208,11 +228,21 @@ class PackedTrialContext:
     # -- terminal-state views consumed by the PackedTrialExecutor ------------
 
     def member_outcomes(self):
-        """Per-member (stopped, killed, failed, fail_message) after the pack
-        function returned/unwound."""
-        self._sweep_kills()
+        """Per-member (stopped, killed, failed, fail_message, preempted)
+        after the pack function returned/unwound. Kills are swept one last
+        time, preempts are NOT: a member still active after the fn returned
+        finished its work, and completion beats a late preempt signal (same
+        race resolution as the solo InProcessExecutor) — marking it
+        preempted here would requeue and re-run a finished trial."""
+        self._sweep_kills(preempts=False)
         return list(
-            zip(self._stopped, self._killed, self._failed, self._fail_messages)
+            zip(
+                self._stopped,
+                self._killed,
+                self._failed,
+                self._fail_messages,
+                self._preempted,
+            )
         )
 
     def param_array(self, name: str, default: Optional[float] = None) -> np.ndarray:

@@ -20,6 +20,9 @@ same information surface:
   GET /api/algorithms                           registered algorithms
   GET /api/experiments/<name>/nas               NAS architecture graph (nas.go:109)
   GET /api/templates[/<name>]                   trial-template store
+  GET /api/queue                                fair-share queue state (pending
+                                                trials with priority/wait/
+                                                deficit, running units, devices)
   GET /metrics                                  Prometheus text exposition
   GET /                                         single-page HTML dashboard
   GET /experiment/<name>                        experiment detail page (live
@@ -631,6 +634,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(_DETAIL_PAGE, "text/html")
             if path == "/metrics":
                 return self._send(ctrl.metrics.render(), "text/plain; version=0.0.4")
+            if path == "/api/queue":
+                # fair-share queue state (controller/fairshare.py): pending
+                # trials with priority / wait / deficit, running units, and
+                # the device pool — the operator's starvation debugger
+                return self._send(ctrl.scheduler.queue_state())
             if path == "/api/algorithms":
                 from ..earlystop.medianstop import registered_early_stoppers
                 from ..suggest.base import registered_algorithms

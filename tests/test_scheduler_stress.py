@@ -154,6 +154,78 @@ def test_concurrent_experiments_share_allocator(tmp_path):
         c.close()
 
 
+def test_mixed_priority_experiments_under_contention(tmp_path):
+    """Fair-share extension (ISSUE 2 satellite): three experiments with
+    mixed priority classes, a device quota, and preemption-eligible gang
+    sizes hammer one 8-chip allocator concurrently. Every trial must land
+    SUCCEEDED (preempted trials requeue and finish), nothing leaks, and the
+    per-experiment accounting returns to zero."""
+    from katib_tpu.api import TrialResources
+
+    c = ExperimentController(root_dir=str(tmp_path), devices=list(range(8)))
+
+    def spec(name, priority, num_devices, max_trials, parallel, quota=None):
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(
+                function=_napping_trial,
+                resources=TrialResources(num_devices=num_devices, device_quota=quota),
+            ),
+            priority_class=priority,
+            max_trial_count=max_trials,
+            parallel_trial_count=parallel,
+        )
+
+    try:
+        c.create_experiment(spec("mix-high", "high", 2, 12, 4))
+        c.create_experiment(spec("mix-default", "", 1, 24, 8))
+        c.create_experiment(spec("mix-low", "low", 4, 6, 2, quota=4))
+
+        results = {}
+
+        def drive(name):
+            results[name] = c.run(name, timeout=110)
+
+        threads = [
+            threading.Thread(target=drive, args=(n,), daemon=True)
+            for n in ("mix-high", "mix-default", "mix-low")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(not t.is_alive() for t in threads), (
+            f"deadlock: free={c.scheduler.allocator.free_count} "
+            f"active={c.scheduler.active_count()} "
+            f"queue={c.scheduler.queue_state()}"
+        )
+
+        for name, n_trials in (("mix-high", 12), ("mix-default", 24), ("mix-low", 6)):
+            exp = results[name]
+            assert exp.status.is_succeeded, (name, exp.status.message)
+            trials = c.state.list_trials(name)
+            assert len(trials) == n_trials
+            assert all(t.condition == TrialCondition.SUCCEEDED for t in trials), [
+                (t.name, t.condition.value, t.message) for t in trials
+            ]
+
+        assert c.scheduler.allocator.free_count == 8
+        assert c.scheduler.quarantined_count == 0
+        assert c.scheduler.active_count() == 0
+        q = c.scheduler.queue_state()
+        assert q["pending"] == [] and q["running"] == []
+        assert all(v == 0 for v in q["devices"]["usageByExperiment"].values())
+    finally:
+        c.close()
+
+
 def test_500_trial_experiment_overhead(tmp_path):
     """Per-record state store at 10x the usual scale: 500 serial-ish trials
     must complete with O(1) per-trial persistence cost — measured 1.6s wall
