@@ -517,6 +517,125 @@ def _bench_pack_throughput(jax, np):
     }
 
 
+def _bench_obslog_report_throughput(smoke: bool = False):
+    """Observation data plane (db/store.py): rows/sec of single-row
+    ``ctx.report``-shaped appends, per-report commit (plain SQLite store)
+    vs the BufferedObservationStore group-commit pipeline. The buffered
+    number includes a final flush() barrier so both sides end durable;
+    read-your-writes is spot-checked mid-stream. ``smoke`` trims the row
+    count for the tier-1 wiring test (tests/test_bench_budget.py) — it
+    exercises the same end-to-end path without the timed-run budget."""
+    import shutil
+    import tempfile
+
+    from katib_tpu.db.store import (
+        BufferedObservationStore, MetricLog, SqliteObservationStore,
+    )
+
+    n_reports = 300 if smoke else int(os.environ.get("BENCH_OBSLOG_ROWS", "4000"))
+    root = tempfile.mkdtemp(prefix="bench-obslog-")
+    try:
+        sync = SqliteObservationStore(os.path.join(root, "sync.db"))
+        t0 = time.perf_counter()
+        for i in range(n_reports):
+            sync.report_observation_log(
+                "trial-sync", [MetricLog(float(i), "loss", str(float(i)))]
+            )
+        sync_s = time.perf_counter() - t0
+        sync.close()
+
+        buf = BufferedObservationStore(
+            SqliteObservationStore(os.path.join(root, "buffered.db"))
+        )
+        t0 = time.perf_counter()
+        for i in range(n_reports):
+            buf.report_observation_log(
+                "trial-buf", [MetricLog(float(i), "loss", str(float(i)))]
+            )
+            if i == n_reports // 2:
+                # read-your-writes: an unflushed append is already readable
+                assert buf.get_observation_log("trial-buf")[-1].timestamp == float(i)
+        buf.flush()
+        buffered_s = time.perf_counter() - t0
+        durable = len(buf.inner.get_observation_log("trial-buf"))
+        stats = buf.stats()
+        buf.close()
+        return {
+            "n_reports": n_reports,
+            "workload": "1-row report per call, WAL sqlite, tmpdir",
+            "sync_s": round(sync_s, 4),
+            "buffered_s": round(buffered_s, 4),
+            "sync_rows_per_s": round(n_reports / max(sync_s, 1e-9), 1),
+            "buffered_rows_per_s": round(n_reports / max(buffered_s, 1e-9), 1),
+            "speedup": round(sync_s / max(buffered_s, 1e-9), 2),
+            "durable_rows": durable,
+            "rows_complete": durable == n_reports,
+            "group_commits": stats["flush_total"],
+            "max_batch_rows": stats["flush_batch_rows_max"],
+            "smoke": smoke,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _bench_obslog_fold_latency(smoke: bool = False):
+    """Poll-path cost vs log size: folding a trial's observation log via the
+    incremental fold index (store.folded, O(metrics)) vs the
+    fold_observation rescan over get_observation_log (O(rows × metrics) —
+    what the scheduler's completion/poll sites paid before). Every size
+    asserts the two answers are identical (the property the index must
+    hold); the logs include non-numeric values and timestamp ties."""
+    import shutil
+    import tempfile
+
+    from katib_tpu.db.store import (
+        BufferedObservationStore, MetricLog, SqliteObservationStore,
+        fold_observation,
+    )
+
+    sizes = [200, 1000] if smoke else [1000, 10000, 50000]
+    names = ["accuracy", "loss", "note"]
+    root = tempfile.mkdtemp(prefix="bench-obslog-fold-")
+    out = []
+    try:
+        for n_rows in sizes:
+            store = BufferedObservationStore(
+                SqliteObservationStore(os.path.join(root, f"fold-{n_rows}.db"))
+            )
+            batch = []
+            for i in range(n_rows):
+                name = names[i % len(names)]
+                value = "warming-up" if name == "note" else str(0.1 + (i % 97) / 100.0)
+                # integer-div timestamps create ties within each quartet
+                batch.append(MetricLog(float(i // 4), name, value))
+                if len(batch) >= 256:
+                    store.report_observation_log("t", batch)
+                    batch = []
+            if batch:
+                store.report_observation_log("t", batch)
+            store.flush()
+            reps = 5 if smoke else 20
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                indexed = store.folded("t", names)
+            indexed_us = (time.perf_counter() - t0) / reps * 1e6
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                rescan = fold_observation(store.get_observation_log("t"), names)
+            rescan_us = (time.perf_counter() - t0) / reps * 1e6
+            store.close()
+            out.append({
+                "rows": n_rows,
+                "indexed_us": round(indexed_us, 1),
+                "rescan_us": round(rescan_us, 1),
+                "speedup": round(rescan_us / max(indexed_us, 1e-9), 1),
+                "identical": indexed == rescan,
+            })
+        return {"metrics_per_trial": len(names), "sizes": out, "smoke": smoke}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1002,6 +1121,17 @@ def child_main(platform: str) -> None:
             extras["fairshare_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
+    if os.environ.get("BENCH_SKIP_OBSLOG") != "1" and gate("obslog", 30.0):
+        try:
+            extras["obslog_report_throughput"] = _bench_obslog_report_throughput()
+        except Exception as e:
+            extras["obslog_report_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        try:
+            extras["obslog_fold_latency"] = _bench_obslog_fold_latency()
+        except Exception as e:
+            extras["obslog_fold_latency"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
     # darts_mfu runs BEFORE the cheaper lm_large/flash stages: it is the
     # review-mandated number (reference-scale supernet MFU) and its 8-cell
     # bilevel compile alone can take several minutes on a degraded tunnel —
@@ -1449,8 +1579,20 @@ def main() -> None:
     print(json.dumps(sentinel))
 
 
+# observation-data-plane scenarios runnable standalone (no JAX, no child
+# orchestration): `python bench.py obslog_report_throughput [--smoke]`.
+# --smoke trims sizes to the tier-1 wiring run (tests/test_bench_budget.py).
+OBSLOG_SCENARIOS = {
+    "obslog_report_throughput": _bench_obslog_report_throughput,
+    "obslog_fold_latency": _bench_obslog_fold_latency,
+}
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         child_main(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] in OBSLOG_SCENARIOS:
+        result = OBSLOG_SCENARIOS[sys.argv[1]](smoke="--smoke" in sys.argv[2:])
+        print(json.dumps({"metric": sys.argv[1], **result}))
     else:
         main()

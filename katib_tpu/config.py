@@ -48,6 +48,8 @@ class RuntimeConfig:
     max_trial_restarts: int = 0            # retries for failed trials (0 = off)
     trial_timeout_seconds: Optional[float] = None
     obslog_backend: str = "auto"           # sqlite | native | memory | auto
+    obslog_buffered: bool = True           # group-commit write-behind wrapper
+    obslog_buffer_rows: int = 8192         # backpressure bound (buffered rows)
     xla_cache_dir: Optional[str] = None
     devices_per_host: Optional[int] = None  # cap devices visible to the allocator
     metrics_poll_interval: float = 0.1
@@ -112,6 +114,9 @@ def load_config(path: Optional[str] = None) -> KatibConfig:
     env_backend = os.environ.get("KATIB_TPU_OBSLOG_BACKEND")
     if env_backend:
         cfg.runtime.obslog_backend = env_backend
+    env_buffered = os.environ.get("KATIB_TPU_OBSLOG_BUFFERED")
+    if env_buffered:
+        cfg.runtime.obslog_buffered = env_buffered.lower() not in ("0", "false", "off")
     env_cache = os.environ.get("KATIB_TPU_XLA_CACHE")
     if env_cache:
         cfg.runtime.xla_cache_dir = env_cache

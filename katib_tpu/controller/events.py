@@ -145,10 +145,16 @@ class MetricsRegistry:
         with self._lock:
             for (name, labels), value in sorted(self._counters.items()):
                 lines.append(f"# TYPE {name} counter") if f"# TYPE {name} counter" not in lines else None
-                label_s = ",".join(f'{k}="{v}"' for k, v in labels)
-                lines.append(f"{name}{{{label_s}}} {value}")
+                lines.append(f"{_series(name, labels)} {value}")
             for (name, labels), value in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {name} gauge") if f"# TYPE {name} gauge" not in lines else None
-                label_s = ",".join(f'{k}="{v}"' for k, v in labels)
-                lines.append(f"{name}{{{label_s}}} {value}")
+                lines.append(f"{_series(name, labels)} {value}")
         return "\n".join(lines) + "\n"
+
+
+def _series(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    """Series head; unlabelled series (the obslog pipeline counters) must
+    render bare — `name{}` trips strict exposition parsers."""
+    if not labels:
+        return name
+    return name + "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"

@@ -572,15 +572,15 @@ class SubprocessExecutor:
             self.obs_store.report_observation_log(trial.name, logs)
 
     def _drain_pushed(self, trial: Trial) -> None:
-        from ..db.store import SqliteObservationStore
+        from ..db.store import BufferedObservationStore, SqliteObservationStore
 
         if not self.db_path:
             return
-        if (
-            isinstance(self.obs_store, SqliteObservationStore)
-            and self.obs_store.path == self.db_path
-        ):
-            return  # same file: rows already visible
+        base = self.obs_store
+        if isinstance(base, BufferedObservationStore):
+            base = base.inner  # same-file check applies to the backing store
+        if isinstance(base, SqliteObservationStore) and base.path == self.db_path:
+            return  # same file: rows already visible (buffered reads merge)
         staging = SqliteObservationStore(self.db_path)
         try:
             rows = staging.get_observation_log(trial.name)

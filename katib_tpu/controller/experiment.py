@@ -35,7 +35,13 @@ from ..api.status import (
 )
 from ..api.validation import validate_experiment
 from ..db.state import ExperimentStateStore
-from ..db.store import ObservationStore, observation_available, open_store
+from ..db.store import (
+    BufferedObservationStore,
+    ObservationStore,
+    SqliteObservationStore,
+    observation_available,
+    open_store,
+)
 from ..earlystop.medianstop import registered_early_stoppers
 from ..suggest.base import registered_algorithms
 from .scheduler import TrialScheduler
@@ -70,13 +76,25 @@ class ExperimentController:
         state_root = os.path.join(root_dir, "state") if (root_dir and persist) else None
         db_path = os.path.join(root_dir, "observations.db") if root_dir else None
         self.state = ExperimentStateStore(state_root)
-        self.obs_store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
-        self.db_path = db_path
-        self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
         from .events import EventRecorder, MetricsRegistry
 
         self.events = EventRecorder()
         self.metrics = MetricsRegistry()
+        store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
+        if rt.obslog_buffered and isinstance(store, SqliteObservationStore):
+            # group-commit write-behind pipeline (docs/data-plane.md): the
+            # in-process hot path enqueues instead of paying a per-report
+            # commit. Subprocess env bindings and the native engine keep
+            # their direct-write paths; the memory store has no commit to
+            # amortize.
+            store = BufferedObservationStore(
+                store,
+                max_buffered_rows=rt.obslog_buffer_rows,
+                metrics=self.metrics,
+            )
+        self.obs_store: ObservationStore = store
+        self.db_path = db_path
+        self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
         self.metrics.set_collector(
             self._collect_current_gauges,
             names=("katib_experiments_current", "katib_trials_current"),

@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from ..api.spec import CollectorKind, UNAVAILABLE_METRIC_VALUE
 from ..api.status import Experiment, Trial, TrialCondition
 from ..db.state import ExperimentStateStore
-from ..db.store import ObservationStore, fold_observation
+from ..db.store import ObservationStore
 from ..runtime.context import TrialContext
 from ..runtime.metrics import EarlyStoppingMonitor, MetricsReporter
 from .executor import (
@@ -258,7 +258,9 @@ class TrialScheduler:
         logs = self.obs_store.get_observation_log(source.name)
         if logs:
             self.obs_store.report_observation_log(trial.name, logs)
-        trial.observation = fold_observation(logs, exp.spec.objective.all_metric_names())
+        trial.observation = self.obs_store.folded(
+            trial.name, exp.spec.objective.all_metric_names()
+        )
         # pass through RUNNING so start_time is stamped — rung-cohort
         # algorithms (hyperband) sort trials by start_time, and a None
         # there would silently misplace the reused trial in its bracket
@@ -1023,6 +1025,10 @@ class TrialScheduler:
         invariant as restart requeues)."""
         with self._lock:
             self._preempting.discard(trial.name)
+        # the cooperative exit already ran the reporter's flush barrier; this
+        # covers the grace-window kill escalation, where the victim's last
+        # report predates the preempt signal and may still sit in the buffer
+        self.obs_store.flush()
         has_checkpoint = trial.name in self._last_checkpoint
         if not has_checkpoint:
             self.obs_store.delete_observation_log(trial.name)
@@ -1255,9 +1261,12 @@ class TrialScheduler:
     def _classify(self, exp: Experiment, trial: Trial, result: ExecutionResult):
         """Fold the observation log and apply trial success/failure
         conditions; returns the (possibly re-classified) result plus the
-        folded observation. Runs before the restart decision in _run_trial."""
-        logs = self.obs_store.get_observation_log(trial.name)
-        observation = fold_observation(logs, exp.spec.objective.all_metric_names())
+        folded observation. Runs before the restart decision in _run_trial.
+        Answered from the store's incremental fold index (O(metrics));
+        stores without one fall back to the full-log rescan."""
+        observation = self.obs_store.folded(
+            trial.name, exp.spec.objective.all_metric_names()
+        )
         trial.observation = observation
         return self._apply_conditions(exp, result, observation), observation
 

@@ -1,4 +1,5 @@
 from .store import (  # noqa: F401
+    BufferedObservationStore,
     InMemoryObservationStore,
     MetricLog,
     ObservationStore,

@@ -13,10 +13,27 @@ A thread-safe in-memory implementation backs unit tests.
 
 Folding an observation log into per-metric {min,max,latest} honoring
 timestamps mirrors trial_controller_util.go:165-217 (getMetrics).
+
+Two throughput layers sit on top of the row stores (docs/data-plane.md):
+
+- :class:`BufferedObservationStore` — a group-commit write-behind wrapper.
+  ``report_observation_log`` appends to a bounded in-memory queue and
+  returns; a background flusher drains the queue into ONE transaction per
+  batch (``report_many``). Podracer-style decoupling (arXiv:2104.06272): the
+  trial hot loop never waits on an fsync. Reads merge the pending buffer
+  (read-your-writes), ``flush()`` is an explicit durability barrier, and a
+  full buffer applies backpressure instead of growing without bound.
+- an **incremental fold index**: running {min, max, latest, latest_ts} per
+  (trial, metric) maintained on append, so ``folded()`` answers the
+  getMetrics fold in O(metrics) instead of re-scanning O(rows × metrics).
+  ``fold_observation`` over the raw rows remains the fallback/verification
+  path; the two are property-tested byte-identical
+  (tests/test_obslog_pipeline.py).
 """
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 import sqlite3
@@ -32,6 +49,8 @@ from ..api.spec import (
     Observation,
     ObjectiveSpec,
 )
+
+log = logging.getLogger("katib_tpu.obslog")
 
 
 @dataclass
@@ -53,17 +72,36 @@ class ObservationStore:
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
         raise NotImplementedError
 
+    def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
+        """Append several trials' rows in one call — the group-commit unit.
+        Backends that can batch (SQLite: one transaction) override this;
+        the default preserves per-trial append semantics."""
+        for trial_name, logs in entries:
+            if logs:
+                self.report_observation_log(trial_name, logs)
+
     def get_observation_log(
         self,
         trial_name: str,
         metric_name: Optional[str] = None,
         start_time: Optional[float] = None,
         end_time: Optional[float] = None,
+        limit: Optional[int] = None,
     ) -> List[MetricLog]:
         raise NotImplementedError
 
+    def folded(self, trial_name: str, metric_names: Sequence[str]) -> Observation:
+        """Per-metric {min,max,latest} for this trial. The base path re-reads
+        and re-folds the raw log (O(rows × metrics)); stores with an
+        incremental fold index answer in O(metrics)."""
+        return fold_observation(self.get_observation_log(trial_name), metric_names)
+
     def delete_observation_log(self, trial_name: str) -> None:
         raise NotImplementedError
+
+    def flush(self) -> None:
+        """Durability barrier: returns once every previously-appended row is
+        persisted in the backing store. No-op for synchronous stores."""
 
     def close(self) -> None:
         pass
@@ -86,10 +124,12 @@ class InMemoryObservationStore(ObservationStore):
         metric_name: Optional[str] = None,
         start_time: Optional[float] = None,
         end_time: Optional[float] = None,
+        limit: Optional[int] = None,
     ) -> List[MetricLog]:
         with self._lock:
             rows = list(self._logs.get(trial_name, []))
-        return _filter_logs(rows, metric_name, start_time, end_time)
+        out = _filter_logs(rows, metric_name, start_time, end_time)
+        return out[:limit] if limit is not None else out
 
     def delete_observation_log(self, trial_name: str) -> None:
         with self._lock:
@@ -119,6 +159,12 @@ class SqliteObservationStore(ObservationStore):
             self._conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs(trial_name, time)"
             )
+            # metric-filtered reads (medianstop's first-k objective rows, the
+            # CLI --metric tail) hit this instead of scanning the trial range
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_obs_trial_metric"
+                " ON observation_logs(trial_name, metric_name, time)"
+            )
             self._conn.commit()
 
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
@@ -129,12 +175,36 @@ class SqliteObservationStore(ObservationStore):
             )
             self._conn.commit()
 
+    def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
+        """Group commit: every trial's rows in ONE explicit transaction —
+        one fsync for the whole drained batch instead of one per report."""
+        rows = [
+            (trial_name, l.timestamp, l.metric_name, l.value)
+            for trial_name, logs in entries
+            for l in logs
+        ]
+        if not rows:
+            return
+        with self._lock:
+            self._conn.execute("BEGIN")
+            try:
+                self._conn.executemany(
+                    "INSERT INTO observation_logs(trial_name, time, metric_name, value)"
+                    " VALUES (?,?,?,?)",
+                    rows,
+                )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
     def get_observation_log(
         self,
         trial_name: str,
         metric_name: Optional[str] = None,
         start_time: Optional[float] = None,
         end_time: Optional[float] = None,
+        limit: Optional[int] = None,
     ) -> List[MetricLog]:
         q = "SELECT time, metric_name, value FROM observation_logs WHERE trial_name = ?"
         args: List = [trial_name]
@@ -148,6 +218,9 @@ class SqliteObservationStore(ObservationStore):
             q += " AND time <= ?"
             args.append(end_time)
         q += " ORDER BY time ASC"
+        if limit is not None:
+            q += " LIMIT ?"
+            args.append(int(limit))
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         return [MetricLog(timestamp=r[0], metric_name=r[1], value=r[2]) for r in rows]
@@ -160,6 +233,296 @@ class SqliteObservationStore(ObservationStore):
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+class _FoldEntry:
+    """Running fold state for one (trial, metric): updated on append, read by
+    folded(). Mirrors the fold_observation scan exactly — 'latest' is the
+    last-appended value among the max-timestamp rows, min/max ignore
+    non-numeric values."""
+
+    __slots__ = ("count", "lo", "hi", "has_numeric", "latest", "best_ts")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.lo = math.inf
+        self.hi = -math.inf
+        self.has_numeric = False
+        self.latest: str = UNAVAILABLE_METRIC_VALUE
+        self.best_ts = -math.inf
+
+    def add(self, row: MetricLog) -> None:
+        self.count += 1
+        if row.timestamp >= self.best_ts:
+            self.best_ts = row.timestamp
+            self.latest = row.value
+        f = _parse_float(row.value)
+        if f is not None:
+            self.has_numeric = True
+            self.lo = min(self.lo, f)
+            self.hi = max(self.hi, f)
+
+    def metric(self, name: str) -> Metric:
+        if self.count == 0:
+            return Metric(name=name)
+        return Metric(
+            name=name,
+            min=repr(self.lo) if self.has_numeric else UNAVAILABLE_METRIC_VALUE,
+            max=repr(self.hi) if self.has_numeric else UNAVAILABLE_METRIC_VALUE,
+            latest=self.latest,
+        )
+
+
+class BufferedObservationStore(ObservationStore):
+    """Write-behind wrapper: bounded buffer + background group commit.
+
+    Contract (docs/data-plane.md):
+
+    - **append is cheap**: ``report_observation_log`` enqueues and returns;
+      the flusher thread drains everything pending into one
+      ``inner.report_many`` transaction per batch.
+    - **read-your-writes**: reads merge the pending/in-flight buffer, so
+      callers (observation folds, early stopping, the UI) never observe a
+      gap between an acknowledged report and the durable log.
+    - **bounded**: at most ``max_buffered_rows`` rows buffer; a producer
+      hitting the bound blocks until the flusher drains (backpressure, not
+      unbounded memory). A single oversized batch is admitted alone.
+    - **flush() barrier**: returns once every row appended before the call
+      is durable in ``inner`` — the hook MetricsReporter uses before
+      raising TrialPreempted/TrialKilled so a requeued victim loses
+      nothing.
+    - **incremental fold index**: folded() answers from running per-(trial,
+      metric) state seeded lazily from pre-existing rows on first touch.
+      Single-writer per db file, like the WAL topology it wraps.
+
+    A flusher write failure is recorded and re-raised from the next
+    flush()/report (loud, not silent); the failed batch is dropped.
+    """
+
+    def __init__(
+        self,
+        inner: ObservationStore,
+        max_buffered_rows: int = 8192,
+        flush_interval: float = 0.05,
+        metrics=None,
+    ) -> None:
+        self.inner = inner
+        self.max_buffered_rows = max(1, int(max_buffered_rows))
+        self.flush_interval = flush_interval
+        self.metrics_registry = metrics
+        self._cv = threading.Condition()
+        # serializes reads against an in-flight group commit so the merged
+        # (buffer + inner) view never duplicates or drops the moving batch
+        self._io_lock = threading.Lock()
+        self._pending: List[Tuple[str, List[MetricLog]]] = []
+        self._pending_rows = 0
+        self._inflight: List[Tuple[str, List[MetricLog]]] = []
+        self._inflight_rows = 0
+        self._seq = 0          # rows accepted
+        self._durable_seq = 0  # rows handed off to inner (or dropped on error)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._index: Dict[Tuple[str, str], _FoldEntry] = {}
+        self._seeded: set = set()
+        self._stats = {
+            "flush_total": 0,
+            "flush_batch_rows": 0,
+            "flush_batch_rows_max": 0,
+            "last_flush_seconds": 0.0,
+        }
+        self._flusher = threading.Thread(
+            target=self._flush_loop, daemon=True, name="obslog-flusher"
+        )
+        self._flusher.start()
+
+    # -- write path ----------------------------------------------------------
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        self.report_many([(trial_name, logs)])
+
+    def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
+        batch = [(t, list(ls)) for t, ls in entries if ls]
+        n = sum(len(ls) for _, ls in batch)
+        if n == 0:
+            return
+        with self._cv:
+            self._raise_error_locked()
+            if self._closed:
+                raise RuntimeError("observation store is closed")
+            # backpressure: wait for the flusher rather than buffer without
+            # bound; an oversized batch is admitted once the buffer is empty
+            while (
+                self._pending_rows + self._inflight_rows + n > self.max_buffered_rows
+                and self._pending_rows + self._inflight_rows > 0
+            ):
+                self._cv.notify_all()
+                self._cv.wait(timeout=1.0)
+                self._raise_error_locked()
+                if self._closed:
+                    raise RuntimeError("observation store is closed")
+            for trial_name, logs in batch:
+                self._seed_locked(trial_name)
+                for row in logs:
+                    self._index.setdefault(
+                        (trial_name, row.metric_name), _FoldEntry()
+                    ).add(row)
+            self._pending.extend(batch)
+            self._pending_rows += n
+            self._seq += n
+            buffered = self._pending_rows + self._inflight_rows
+            self._cv.notify_all()
+        if self.metrics_registry is not None:
+            self.metrics_registry.set_gauge("katib_obslog_buffered_rows", float(buffered))
+
+    # -- read path -----------------------------------------------------------
+
+    def get_observation_log(
+        self,
+        trial_name: str,
+        metric_name: Optional[str] = None,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> List[MetricLog]:
+        # _io_lock: no group commit is mid-transaction, so inner ∪ buffer is
+        # exactly the full log (no torn batch, no duplicates)
+        with self._io_lock:
+            with self._cv:
+                buffered = [
+                    row
+                    for t, logs in self._inflight + self._pending
+                    if t == trial_name
+                    for row in logs
+                ]
+            # limit pushes down: the true first-k of (inner ∪ buffer) is a
+            # subset of (first-k of inner) ∪ buffer, so the merge stays exact
+            rows = self.inner.get_observation_log(
+                trial_name, metric_name=metric_name,
+                start_time=start_time, end_time=end_time, limit=limit,
+            )
+        if buffered:
+            rows = rows + _filter_logs(buffered, metric_name, start_time, end_time)
+            rows.sort(key=lambda r: r.timestamp)  # stable: appended-later stays later
+        return rows[:limit] if limit is not None else rows
+
+    def folded(self, trial_name: str, metric_names: Sequence[str]) -> Observation:
+        with self._cv:
+            if trial_name in self._seeded:
+                return Observation(
+                    metrics=[
+                        self._index.get((trial_name, name), _FoldEntry()).metric(name)
+                        for name in metric_names
+                    ]
+                )
+        # The index only owns trials whose rows arrive through this wrapper.
+        # Anything else (subprocess trials pushing straight into the SQLite
+        # file via the env binding) may gain rows the wrapper never sees, so
+        # cache nothing and fall back to the verification rescan.
+        return fold_observation(self.get_observation_log(trial_name), metric_names)
+
+    def _seed_locked(self, trial_name: str) -> None:
+        """First APPEND for a trial through this wrapper: fold rows already
+        durable in inner (a store reopened over an existing db, a subprocess
+        trial's direct pushes before collection) into the index, then let
+        incremental updates own it. Runs before the new rows are applied, so
+        buffered rows are never double-counted. Caller holds _cv."""
+        if trial_name in self._seeded:
+            return
+        self._seeded.add(trial_name)
+        for row in self.inner.get_observation_log(trial_name):
+            self._index.setdefault((trial_name, row.metric_name), _FoldEntry()).add(row)
+
+    # -- lifecycle / barriers ------------------------------------------------
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        self.flush()
+        with self._io_lock:
+            with self._cv:
+                for key in [k for k in self._index if k[0] == trial_name]:
+                    del self._index[key]
+                # back to unowned: the next append re-seeds from inner, the
+                # next folded() rescans — external writers stay visible
+                self._seeded.discard(trial_name)
+            self.inner.delete_observation_log(trial_name)
+
+    def flush(self) -> None:
+        """Block until every row appended before this call is durable."""
+        with self._cv:
+            target = self._seq
+            self._cv.notify_all()
+            while self._durable_seq < target:
+                if not self._flusher.is_alive():
+                    break
+                self._cv.wait(timeout=1.0)
+            self._raise_error_locked()
+
+    def _raise_error_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"observation-log flush failed: {err}") from err
+
+    def stats(self) -> Dict[str, float]:
+        with self._cv:
+            out = dict(self._stats)
+            out["buffered_rows"] = self._pending_rows + self._inflight_rows
+            return out
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._flusher.join(timeout=5.0)
+            self.inner.close()
+
+    # -- flusher -------------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait(timeout=self.flush_interval)
+                if not self._pending and self._closed:
+                    return
+                self._inflight = self._pending
+                self._inflight_rows = self._pending_rows
+                self._pending = []
+                self._pending_rows = 0
+                batch, rows = self._inflight, self._inflight_rows
+            t0 = time.perf_counter()
+            err: Optional[BaseException] = None
+            with self._io_lock:
+                try:
+                    self.inner.report_many(batch)
+                except BaseException as e:  # surface via the next barrier
+                    err = e
+                with self._cv:
+                    self._inflight = []
+                    self._inflight_rows = 0
+                    self._durable_seq += rows
+                    if err is not None:
+                        self._error = err
+                    else:
+                        dt = time.perf_counter() - t0
+                        self._stats["flush_total"] += 1
+                        self._stats["flush_batch_rows"] += rows
+                        self._stats["flush_batch_rows_max"] = max(
+                            self._stats["flush_batch_rows_max"], rows
+                        )
+                        self._stats["last_flush_seconds"] = dt
+                    buffered = self._pending_rows
+                    self._cv.notify_all()
+            if err is not None:
+                log.error("observation-log group commit failed (%d rows dropped): %s", rows, err)
+            elif self.metrics_registry is not None:
+                self.metrics_registry.inc("katib_obslog_flush_total")
+                self.metrics_registry.inc("katib_obslog_flush_batch_rows", value=float(rows))
+                self.metrics_registry.set_gauge(
+                    "katib_obslog_flush_latency_seconds", round(dt, 6)
+                )
+                self.metrics_registry.set_gauge("katib_obslog_buffered_rows", float(buffered))
 
 
 def _filter_logs(
@@ -195,36 +558,20 @@ def fold_observation(logs: Sequence[MetricLog], metric_names: Sequence[str]) -> 
     value with the greatest timestamp (ties: last reported); min/max ignore
     non-numeric values; a metric with no parseable value at all reports
     'unavailable' everywhere.
+
+    Single pass over the rows building every requested metric at once (the
+    old shape rescanned the full row list once per metric name). This is
+    the fallback/verification path for stores without the incremental fold
+    index; BufferedObservationStore.folded must stay byte-identical to it.
     """
-    metrics: List[Metric] = []
-    for name in metric_names:
-        rows = [r for r in logs if r.metric_name == name]
-        latest: str = UNAVAILABLE_METRIC_VALUE
-        best_ts = -math.inf
-        lo = math.inf
-        hi = -math.inf
-        has_numeric = False
-        for r in rows:
-            if r.timestamp >= best_ts:
-                best_ts = r.timestamp
-                latest = r.value
-            f = _parse_float(r.value)
-            if f is not None:
-                has_numeric = True
-                lo = min(lo, f)
-                hi = max(hi, f)
-        if not rows:
-            metrics.append(Metric(name=name))
-            continue
-        metrics.append(
-            Metric(
-                name=name,
-                min=repr(lo) if has_numeric else UNAVAILABLE_METRIC_VALUE,
-                max=repr(hi) if has_numeric else UNAVAILABLE_METRIC_VALUE,
-                latest=latest,
-            )
-        )
-    return Observation(metrics=metrics)
+    entries: Dict[str, _FoldEntry] = {name: _FoldEntry() for name in metric_names}
+    for row in logs:
+        entry = entries.get(row.metric_name)
+        if entry is not None:
+            entry.add(row)
+    return Observation(
+        metrics=[entries[name].metric(name) for name in metric_names]
+    )
 
 
 def objective_value(
@@ -277,6 +624,10 @@ def open_store(path: Optional[str], backend: str = "auto") -> ObservationStore:
     'sqlite', 'memory', or 'native' (C++ engine, katib_tpu/native/obslog.cc —
     single-writer-process; subprocess trials must push via gRPC or stdout
     rather than opening the same file).
+
+    The controller wraps the SQLite store in BufferedObservationStore
+    (ExperimentController, config runtime.obslog_buffered); subprocess env
+    bindings and the native engine keep their direct-write paths.
     """
     import os
 

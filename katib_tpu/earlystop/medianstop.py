@@ -76,8 +76,12 @@ class MedianStop(EarlyStopper):
         for trial in trials:
             if trial.name in self._avg_history or trial.condition != TrialCondition.SUCCEEDED:
                 continue
-            logs = store.get_observation_log(trial.name, metric_name=objective_metric)
-            first = logs[:start_step]
+            # limit pushes the first-start_step read down to the store: with
+            # the composite (trial, metric, time) index this is O(start_step)
+            # instead of a scan of the trial's whole objective history
+            first = store.get_observation_log(
+                trial.name, metric_name=objective_metric, limit=start_step
+            )
             values = []
             for log in first:
                 try:

@@ -76,6 +76,7 @@ class NativeObservationStore(ObservationStore):
         metric_name: Optional[str] = None,
         start_time: Optional[float] = None,
         end_time: Optional[float] = None,
+        limit: Optional[int] = None,
     ) -> List[MetricLog]:
         size = ctypes.c_int64(0)
         with self._lock:
@@ -104,6 +105,8 @@ class NativeObservationStore(ObservationStore):
             value = raw[pos : pos + vlen].decode()
             pos += vlen
             out.append(MetricLog(timestamp=t, metric_name=metric, value=value))
+            if limit is not None and len(out) >= limit:
+                break  # C ABI takes no limit; rows arrive time-ordered
         return out
 
     def delete_observation_log(self, trial_name: str) -> None:

@@ -38,6 +38,14 @@ class TrialContext:
         your checkpoint BEFORE reporting and preemption loses nothing)."""
         self.reporter.report(**metrics)
 
+    def flush_metrics(self) -> None:
+        """Durability barrier for write-behind observation stores
+        (db/store.py BufferedObservationStore): returns once every metric
+        reported so far is persisted. The runtime calls it on checkpoint
+        save and before TrialPreempted/TrialKilled unwind; trial code only
+        needs it around its own external side effects."""
+        self.reporter.store.flush()
+
     @property
     def preempt_requested(self) -> bool:
         """True once the fair-share policy selected this trial as a
@@ -111,14 +119,17 @@ class TrialContext:
         from .checkpoints import store_for
 
         store = store_for(self.checkpoint_dir, self.workdir, subdir)
-        if self.on_checkpoint is not None:
-            notify, orig_save = self.on_checkpoint, store.save
+        notify, orig_save = self.on_checkpoint, store.save
 
-            def _save(step, state, _notify=notify, _orig=orig_save):
-                _orig(step, state)
+        def _save(step, state, _notify=notify, _orig=orig_save):
+            _orig(step, state)
+            if _notify is not None:
                 _notify(step)
+            # every save is a durability point: a preemption decided against
+            # this freshly-checkpointed trial must find its metrics on disk
+            self.flush_metrics()
 
-            store.save = _save  # instance-level shadow; CheckpointStore API unchanged
+        store.save = _save  # instance-level shadow; CheckpointStore API unchanged
         return store
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:

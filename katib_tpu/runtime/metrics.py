@@ -23,13 +23,15 @@ Early-stopping rule enforcement matches the sidecar watcher
 
 from __future__ import annotations
 
+import atexit
 import contextvars
 import json
 import os
 import re
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..api.spec import ComparisonType, EarlyStoppingRule, ObjectiveType
 from ..db.store import MetricLog, ObservationStore, open_store
@@ -136,25 +138,44 @@ class MetricsReporter:
     _stopped: bool = False
 
     def report(self, timestamp: Optional[float] = None, **metrics: float) -> None:
+        fvals, logs = self.build_logs(metrics, timestamp=timestamp)
+        self.store.report_observation_log(self.trial_name, logs)
+        # after the write, so a killed trial's final metrics are not lost;
+        # kill is checked before preempt — it is the stronger signal. The
+        # flush() barrier makes buffered stores durable BEFORE the unwind:
+        # PR 2's invariant that a preempted/killed trial's metrics are
+        # persisted when the scheduler requeues it must survive write-behind.
+        if self.kill_event is not None and self.kill_event.is_set():
+            self.store.flush()
+            raise TrialKilled(f"trial {self.trial_name} killed")
+        if self.preempt_event is not None and self.preempt_event.is_set():
+            self.store.flush()
+            raise TrialPreempted(f"trial {self.trial_name} preempted")
+        self.absorb(fvals)
+        if self._stopped and self.raise_on_stop:
+            raise EarlyStopped(f"trial {self.trial_name} early stopped")
+
+    def build_logs(
+        self, metrics: Dict[str, Any], timestamp: Optional[float] = None
+    ) -> "tuple[Dict[str, float], List[MetricLog]]":
+        """Validate + normalize one report into rows without writing them —
+        the packed demux (runtime/packed.py) builds every member's rows via
+        this and appends them in ONE store batch."""
         fvals = {k: validate_metric_value(k, v) for k, v in metrics.items()}
         ts = timestamp if timestamp is not None else time.time()
         logs = [
             MetricLog(timestamp=ts, metric_name=k, value=str(f))
             for k, f in fvals.items()
         ]
-        self.store.report_observation_log(self.trial_name, logs)
-        # after the write, so a killed trial's final metrics are not lost;
-        # kill is checked before preempt — it is the stronger signal
-        if self.kill_event is not None and self.kill_event.is_set():
-            raise TrialKilled(f"trial {self.trial_name} killed")
-        if self.preempt_event is not None and self.preempt_event.is_set():
-            raise TrialPreempted(f"trial {self.trial_name} preempted")
+        return fvals, logs
+
+    def absorb(self, fvals: Dict[str, float]) -> None:
+        """Feed already-written values to the early-stopping monitor (no
+        raise — packed mode masks instead of unwinding)."""
         if self.monitor is not None:
             for k, fv in fvals.items():
                 if self.monitor.observe(k, fv):
                     self._stopped = True
-            if self._stopped and self.raise_on_stop:
-                raise EarlyStopped(f"trial {self.trial_name} early stopped")
 
     @property
     def stopped(self) -> bool:
@@ -188,12 +209,47 @@ def validate_metric_value(name: str, value) -> float:
         ) from None
 
 
+# One store handle per (pid, db-path) for the subprocess env binding: the
+# old shape opened and closed a fresh SQLite connection on EVERY report —
+# connection setup + PRAGMA + index DDL per metric row. The pid key makes a
+# fork start clean (a SQLite connection must never cross fork), and atexit
+# closes whatever this process opened.
+_env_store_lock = threading.Lock()
+_env_stores: Dict[Tuple[int, str], ObservationStore] = {}
+
+
+def _close_env_stores() -> None:
+    with _env_store_lock:
+        stores = list(_env_stores.values())
+        _env_stores.clear()
+    for store in stores:
+        try:
+            store.close()
+        except Exception:
+            pass
+
+
+def _env_bound_store(db_path: str) -> ObservationStore:
+    # Always SQLite here: the native engine is single-writer-process and
+    # the controller may hold it open; SQLite handles cross-process writes.
+    key = (os.getpid(), db_path)
+    with _env_store_lock:
+        store = _env_stores.get(key)
+        if store is None:
+            if not _env_stores:
+                atexit.register(_close_env_stores)
+            store = open_store(db_path, backend="sqlite")
+            _env_stores[key] = store
+        return store
+
+
 def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> None:
     """SDK push entry point, reference sdk report_metrics.py:24+.
 
     Works in three bindings:
     1. in-process trial: a contextvar reporter was installed by the runtime;
-    2. subprocess trial with env binding: opens the store at $KATIB_TPU_DB_PATH;
+    2. subprocess trial with env binding: pushes to the cached store handle
+       for $KATIB_TPU_DB_PATH (one connection per process, closed at exit);
     3. bare subprocess: prints ``name=value`` lines for the stdout collector.
     """
     merged = dict(metrics or {})
@@ -205,13 +261,8 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
     trial = os.environ.get(ENV_TRIAL_NAME)
     db = os.environ.get(ENV_DB_PATH)
     if trial and db:
-        # Always SQLite here: the native engine is single-writer-process and
-        # the controller may hold it open; SQLite handles cross-process writes.
-        store = open_store(db, backend="sqlite")
-        try:
-            MetricsReporter(store=store, trial_name=trial).report(**merged)
-        finally:
-            store.close()
+        store = _env_bound_store(db)
+        MetricsReporter(store=store, trial_name=trial).report(**merged)
         return
     for k, v in merged.items():
         # normalized so the stdout collector's numeric TEXT filter matches

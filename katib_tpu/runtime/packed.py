@@ -30,6 +30,7 @@ programs, K=1 vs K>1).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -176,11 +177,16 @@ class PackedTrialContext:
 
         Each value is an array of shape [K] (or a scalar, broadcast to all
         members). Frozen members are skipped — their logs end at the report
-        where they stopped, exactly where a sequential run's would. After
-        the write, each member's kill event and early-stopping monitor are
-        applied (same order as MetricsReporter.report: a killed/stopped
-        member's final metrics are never lost). Raises PackFrozen when no
-        member remains active."""
+        where they stopped, exactly where a sequential run's would. All
+        active members' rows land in ONE store batch (``report_many``) —
+        K member appends per step would re-serialize the pack on the store
+        lock that vmapping just removed from the compute. After the write,
+        each member's kill event and early-stopping monitor are applied
+        (same order as MetricsReporter.report: a killed/stopped member's
+        final metrics are never lost), with a flush barrier before any
+        member freezes on kill/preempt so its metrics are durable when the
+        scheduler requeues it. Raises PackFrozen when no member remains
+        active."""
         k = self.pack_size
         cols: Dict[str, np.ndarray] = {}
         for name, value in metrics.items():
@@ -194,20 +200,30 @@ class PackedTrialContext:
                     f"pack of {k}"
                 )
             cols[name] = arr
-        # NO kill sweep before the write loop: like MetricsReporter.report,
+        # NO kill sweep before the write: like MetricsReporter.report,
         # a killed member's in-flight metrics are written, THEN it freezes
         # (a train fn that polls active_mask freezes earlier by choice)
+        ts = timestamp if timestamp is not None else time.time()
+        store = self.reporters[0].store if self.reporters else None
+        batch = []
+        written: List[tuple] = []  # (member index, fvals)
         for i in range(k):
             if not self._active[i]:
                 continue
-            self.reporters[i].report(
-                timestamp=timestamp,
-                **{name: float(col[i]) for name, col in cols.items()},
+            fvals, logs = self.reporters[i].build_logs(
+                {name: float(col[i]) for name, col in cols.items()}, timestamp=ts
             )
+            batch.append((self.reporters[i].trial_name, logs))
+            written.append((i, fvals))
+        if batch and store is not None:
+            store.report_many(batch)
+        freeze_barrier = False
+        for i, fvals in written:
             ev = self.kill_events[i]
             if ev is not None and ev.is_set():
                 self._active[i] = False
                 self._killed[i] = True
+                freeze_barrier = True
                 continue
             pev = self.preempt_events[i]
             if pev is not None and pev.is_set():
@@ -216,11 +232,21 @@ class PackedTrialContext:
                 # checkpoint, its log continuing exactly where it stopped
                 self._active[i] = False
                 self._preempted[i] = True
+                freeze_barrier = True
                 continue
+            self.reporters[i].absorb(fvals)
             if self.reporters[i].stopped:
                 self._active[i] = False
                 self._stopped[i] = True
+        if freeze_barrier and store is not None:
+            # killed/preempted members leave the pack here; their final
+            # metrics must be durable before the scheduler's requeue path
+            # observes the freeze (same barrier MetricsReporter.report runs
+            # before raising TrialKilled/TrialPreempted)
+            store.flush()
         if not any(self._active):
+            if store is not None:
+                store.flush()
             raise PackFrozen(
                 f"all {k} members of pack {self.trial_names} are frozen"
             )

@@ -138,14 +138,21 @@ class ApiServicer:
         # for genuine observations (collectors stamp scrape/log time).
         assert self.store is not None
         trial = payload["trialName"]
-        existing = {
-            (r.timestamp, r.metric_name, r.value)
-            for r in self.store.get_observation_log(trial)
-        }
         logs = [
             MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
             for l in payload.get("metricLogs", [])
         ]
+        if not logs:
+            return {}
+        # a duplicate of an incoming row necessarily shares its timestamp,
+        # so the dedup read only needs rows from the batch's window — the
+        # (trial, time) index answers it without rescanning the full log
+        existing = {
+            (r.timestamp, r.metric_name, r.value)
+            for r in self.store.get_observation_log(
+                trial, start_time=min(l.timestamp for l in logs)
+            )
+        }
         fresh = [l for l in logs if (l.timestamp, l.metric_name, l.value) not in existing]
         if fresh:
             self.store.report_observation_log(trial, fresh)
@@ -158,6 +165,7 @@ class ApiServicer:
             metric_name=payload.get("metricName"),
             start_time=payload.get("startTime"),
             end_time=payload.get("endTime"),
+            limit=payload.get("limit"),
         )
         return {
             "metricLogs": [
@@ -165,6 +173,16 @@ class ApiServicer:
                 for r in rows
             ]
         }
+
+    def get_folded_observation(self, payload: Dict) -> Dict:
+        """Folded {min,max,latest} per requested metric — O(metrics) on
+        stores with the incremental fold index, so remote pollers stop
+        shipping (and re-folding) whole observation logs per poll."""
+        assert self.store is not None
+        obs = self.store.folded(
+            payload["trialName"], list(payload.get("metricNames", []))
+        )
+        return {"metrics": [m.to_dict() for m in obs.metrics]}
 
     def delete_observation_log(self, payload: Dict) -> Dict:
         assert self.store is not None
@@ -181,6 +199,7 @@ class ApiServicer:
         "SetTrialStatus": set_trial_status,
         "ReportObservationLog": report_observation_log,
         "GetObservationLog": get_observation_log,
+        "GetFoldedObservation": get_folded_observation,
         "DeleteObservationLog": delete_observation_log,
     }
 
@@ -367,7 +386,9 @@ class RemoteObservationStore(ObservationStore):
             },
         )
 
-    def get_observation_log(self, trial_name, metric_name=None, start_time=None, end_time=None):
+    def get_observation_log(
+        self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None
+    ):
         out = self.client._call(
             "GetObservationLog",
             {
@@ -375,12 +396,23 @@ class RemoteObservationStore(ObservationStore):
                 "metricName": metric_name,
                 "startTime": start_time,
                 "endTime": end_time,
+                "limit": limit,
             },
         )
         return [
             MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
             for l in out.get("metricLogs", [])
         ]
+
+    def folded(self, trial_name, metric_names):
+        """Server-side fold: one small reply instead of the whole log."""
+        from ..api.spec import Metric, Observation
+
+        out = self.client._call(
+            "GetFoldedObservation",
+            {"trialName": trial_name, "metricNames": list(metric_names)},
+        )
+        return Observation(metrics=[Metric.from_dict(m) for m in out.get("metrics", [])])
 
     def delete_observation_log(self, trial_name: str) -> None:
         self.client._call("DeleteObservationLog", {"trialName": trial_name})

@@ -776,8 +776,19 @@ class _Handler(BaseHTTPRequestHandler):
                         parameter_importance(exp, ctrl.state.list_trials(name))
                     )
             if len(parts) == 5 and parts[1] == "api" and parts[2] == "trials" and parts[4] == "metrics":
-                logs = ctrl.obs_store.get_observation_log(parts[3])
                 q = parse_qs(urlparse(self.path).query)
+                if q.get("folded", ["0"])[0] in ("1", "true"):
+                    # folded {min,max,latest} summary from the store's
+                    # incremental fold index — O(metrics), no raw-log ship
+                    names = q.get("metric", [])
+                    if not names:
+                        for e in ctrl.state.list_experiments():
+                            if ctrl.state.get_trial(e.name, parts[3]) is not None:
+                                names = e.spec.objective.all_metric_names()
+                                break
+                    obs = ctrl.obs_store.folded(parts[3], names)
+                    return self._send({"metrics": [m.to_dict() for m in obs.metrics]})
+                logs = ctrl.obs_store.get_observation_log(parts[3])
                 limit = q.get("limit", [None])[0]
                 if limit is not None and limit.isdigit():
                     logs = logs[-int(limit):]  # tail: the recent records

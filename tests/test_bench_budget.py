@@ -514,6 +514,46 @@ def test_freshest_tpu_capture_summarizes_watcher_record(bench):
     assert cap["mfu_small"] or cap["headline_value_s"]
 
 
+def test_obslog_report_throughput_smoke_exercises_buffered_path(bench):
+    """--smoke mode of the obslog_report_throughput scenario: the full
+    sync-vs-buffered pipeline (enqueue, group commit, read-your-writes
+    spot-check, flush barrier) runs end-to-end at a trimmed row count. No
+    speed assertion here — CI contention would make a ratio flaky; the ≥5x
+    target is the timed run's acceptance number."""
+    out = bench._bench_obslog_report_throughput(smoke=True)
+    assert out["smoke"] is True
+    assert out["rows_complete"] and out["durable_rows"] == out["n_reports"]
+    assert out["group_commits"] >= 1
+    assert out["max_batch_rows"] >= 1
+    assert out["sync_rows_per_s"] > 0 and out["buffered_rows_per_s"] > 0
+
+
+def test_obslog_fold_latency_smoke_identical(bench):
+    """--smoke mode of obslog_fold_latency: the incremental fold index must
+    be byte-identical to the fold_observation rescan at every log size
+    (non-numeric values and timestamp ties included in the generated logs)."""
+    out = bench._bench_obslog_fold_latency(smoke=True)
+    assert out["smoke"] is True and out["sizes"]
+    assert all(s["identical"] for s in out["sizes"])
+    assert all(s["indexed_us"] > 0 and s["rescan_us"] > 0 for s in out["sizes"])
+
+
+def test_obslog_scenarios_run_standalone_via_cli():
+    """`python bench.py obslog_report_throughput --smoke` prints one JSON
+    line — the documented entry point for the data-plane scenarios."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "obslog_report_throughput", "--smoke"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["metric"] == "obslog_report_throughput"
+    assert parsed["rows_complete"] is True
+
+
 def test_sentinel_carries_freshest_capture(bench, monkeypatch, capsys):
     """Even the all-dead sentinel line ships the labeled watcher numbers."""
     monkeypatch.setenv("BENCH_TOTAL_BUDGET", "40")  # too small for anything
