@@ -14,6 +14,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            priority, wait, deficit; --url asks a live
                            controller's /api/queue, else persisted state)
   importance <name>        correlation-based parameter-importance table
+  trace <experiment> <trial>  indented lifecycle span tree with durations and
+                           % of trial wall-clock (--url asks a live
+                           controller; else the persisted trace under
+                           <root>/traces/)
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
   ui                       serve the web dashboard + REST API
@@ -235,6 +239,47 @@ def cmd_importance(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Trial lifecycle span tree (ISSUE 4 tentpole): where did this trial's
+    wall-clock go — queue wait, compile, steps, checkpointing, flush
+    barriers, preemption. Live from a running controller's trace endpoint
+    when --url is given; otherwise from the trace persisted at trial end."""
+    import os
+
+    from .tracing import Span, render_tree
+
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = (
+            args.url.rstrip("/")
+            + f"/api/experiments/{args.experiment}/trials/{args.trial}/trace"
+        )
+        try:
+            with urllib.request.urlopen(url) as r:
+                trace = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"no trace: HTTP {e.code} from {url}", file=sys.stderr)
+            return 1
+    else:
+        path = os.path.join(args.root, "traces", args.experiment, f"{args.trial}.json")
+        if not os.path.exists(path):
+            print(
+                f"no persisted trace at {path} (did the trial run with "
+                "tracing on and a --root?); use --url for a live controller",
+                file=sys.stderr,
+            )
+            return 1
+        with open(path) as f:
+            trace = json.load(f)
+    spans = [Span.from_dict(s) for s in trace.get("spans", [])]
+    print(f"trace {trace.get('traceId', '?')} — "
+          f"{args.experiment}/{args.trial} ({len(spans)} spans)")
+    print(render_tree(spans))
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -374,6 +419,20 @@ def main(argv=None) -> int:
     im = sub.add_parser("importance", help="parameter-importance table for an experiment")
     im.add_argument("name")
     im.set_defaults(fn=cmd_importance)
+
+    tc = sub.add_parser(
+        "trace",
+        help="trial lifecycle span tree (durations + %% of trial wall-clock)",
+    )
+    tc.add_argument("experiment")
+    tc.add_argument("trial")
+    tc.add_argument(
+        "--url",
+        default=None,
+        help="base URL of a running 'katib-tpu ui' server for the live "
+        "trace (else reads the persisted trace under <root>/traces/)",
+    )
+    tc.set_defaults(fn=cmd_trace)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")
     me.add_argument("trial")

@@ -50,6 +50,8 @@ class RuntimeConfig:
     obslog_backend: str = "auto"           # sqlite | native | memory | auto
     obslog_buffered: bool = True           # group-commit write-behind wrapper
     obslog_buffer_rows: int = 8192         # backpressure bound (buffered rows)
+    tracing: bool = True                   # trial lifecycle spans (tracing.py)
+    trace_ring_spans: int = 4096           # per-experiment span ring bound
     xla_cache_dir: Optional[str] = None
     devices_per_host: Optional[int] = None  # cap devices visible to the allocator
     metrics_poll_interval: float = 0.1
@@ -120,4 +122,7 @@ def load_config(path: Optional[str] = None) -> KatibConfig:
     env_cache = os.environ.get("KATIB_TPU_XLA_CACHE")
     if env_cache:
         cfg.runtime.xla_cache_dir = env_cache
+    env_tracing = os.environ.get("KATIB_TPU_TRACING")
+    if env_tracing:
+        cfg.runtime.tracing = env_tracing.lower() not in ("0", "false", "off")
     return cfg

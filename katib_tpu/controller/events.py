@@ -30,6 +30,7 @@ class Event:
     event_type: str    # Normal | Warning
     reason: str
     message: str
+    experiment: str = ""  # owning experiment — the cross-experiment view key
 
     def to_dict(self):
         return {
@@ -39,6 +40,7 @@ class Event:
             "type": self.event_type,
             "reason": self.reason,
             "message": self.message,
+            "experiment": self.experiment,
         }
 
 
@@ -64,6 +66,7 @@ class EventRecorder:
             event_type="Warning" if warning else "Normal",
             reason=reason,
             message=message,
+            experiment=experiment,
         )
         with self._lock:
             q = self._events.setdefault(experiment, collections.deque(maxlen=self.max_events))
@@ -73,21 +76,69 @@ class EventRecorder:
         with self._lock:
             return list(self._events.get(experiment, ()))
 
+    def list_all(
+        self, limit: Optional[int] = None, warning_only: bool = False
+    ) -> List[Event]:
+        """Cross-experiment event view, oldest first: queue stalls,
+        preemptions and flusher errors are queryable without knowing which
+        experiment raised them (GET /api/events?warning=1)."""
+        with self._lock:
+            merged = [e for q in self._events.values() for e in q]
+        merged.sort(key=lambda e: e.timestamp)
+        if warning_only:
+            merged = [e for e in merged if e.event_type == "Warning"]
+        if limit is not None:
+            merged = merged[-limit:] if limit > 0 else []
+        return merged
+
+
+class _Histogram:
+    """Fixed-bucket histogram state: per-bucket counts (non-cumulative in
+    memory, rendered cumulatively), running sum and count."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+                break
+
 
 class MetricsRegistry:
-    """Counters/gauges labelled by experiment, Prometheus text format.
+    """Counters/gauges/histograms labelled by experiment, Prometheus text
+    format.
 
     Metric names mirror the reference: katib_experiment_created_total,
     katib_experiment_succeeded_total, katib_experiment_failed_total,
     katib_trial_created_total, katib_trial_succeeded_total,
     katib_trial_failed_total, katib_trial_early_stopped_total, plus running
-    gauges (prometheus_metrics.go).
+    gauges (prometheus_metrics.go). Histograms (no reference counterpart —
+    its exporter is counters/gauges only) render the full
+    ``_bucket``/``_sum``/``_count`` exposition series; the tracing layer
+    feeds katib_span_duration_seconds{stage=...} through observe().
     """
+
+    # latency-shaped default buckets: 1ms .. 10min, roughly log-spaced
+    DEFAULT_BUCKETS: Tuple[float, ...] = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+    )
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}
+        self._help: Dict[str, str] = {}
         self._collector = None  # per-scrape gauge recompute hook
         self._collector_names: Tuple[str, ...] = ()
         self._collector_error_logged = False
@@ -101,6 +152,31 @@ class MetricsRegistry:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets: Optional[Tuple[float, ...]] = None,
+        **labels: str,
+    ) -> None:
+        """Record one histogram observation. The bucket layout is fixed by
+        the first observation of a series; later ``buckets`` arguments are
+        ignored (exposition series must keep a stable layout)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = _Histogram(
+                    tuple(buckets) if buckets else self.DEFAULT_BUCKETS
+                )
+            h.observe(value)
+
+    def set_help(self, name: str, text: str) -> None:
+        """One-line # HELP text for a metric name (single line; newlines
+        would corrupt the exposition)."""
+        with self._lock:
+            self._help[name] = " ".join(str(text).split())
 
     @staticmethod
     def gauge_key(name: str, **labels: str) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
@@ -142,13 +218,40 @@ class MetricsRegistry:
                         del self._gauges[key]
                     self._gauges.update(collected)
         lines: List[str] = []
+        # O(1) dedup of the per-name metadata lines — the old
+        # `lines.append(...) if ... not in lines else None` idiom was an
+        # O(n²) membership scan wrapped in an expression statement
+        seen: set = set()
+
+        def _meta(name: str, kind: str) -> None:
+            if name in seen:
+                return
+            seen.add(name)
+            lines.append(f"# HELP {name} {self._help.get(name, _default_help(name, kind))}")
+            lines.append(f"# TYPE {name} {kind}")
+
         with self._lock:
             for (name, labels), value in sorted(self._counters.items()):
-                lines.append(f"# TYPE {name} counter") if f"# TYPE {name} counter" not in lines else None
+                _meta(name, "counter")
                 lines.append(f"{_series(name, labels)} {value}")
             for (name, labels), value in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {name} gauge") if f"# TYPE {name} gauge" not in lines else None
+                _meta(name, "gauge")
                 lines.append(f"{_series(name, labels)} {value}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                _meta(name, "histogram")
+                cumulative = 0
+                for le, count in zip(h.buckets, h.counts):
+                    cumulative += count
+                    lines.append(
+                        f"{_series(name + '_bucket', labels + (('le', _fmt_le(le)),))} "
+                        f"{float(cumulative)}"
+                    )
+                lines.append(
+                    f"{_series(name + '_bucket', labels + (('le', '+Inf'),))} "
+                    f"{float(h.count)}"
+                )
+                lines.append(f"{_series(name + '_sum', labels)} {h.sum}")
+                lines.append(f"{_series(name + '_count', labels)} {float(h.count)}")
         return "\n".join(lines) + "\n"
 
 
@@ -158,3 +261,43 @@ def _series(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return name
     return name + "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+def _fmt_le(le: float) -> str:
+    """Prometheus-conventional bucket bound rendering: 0.005, 1, 30."""
+    return f"{le:g}"
+
+
+# HELP text for the katib_* catalog (docs/observability.md); names outside
+# the catalog get a generated one-liner so every family still carries HELP.
+_HELP_CATALOG: Dict[str, str] = {
+    "katib_experiment_created_total": "Experiments created.",
+    "katib_experiment_succeeded_total": "Experiments that completed successfully.",
+    "katib_experiment_failed_total": "Experiments that completed failed.",
+    "katib_experiment_deleted_total": "Experiments deleted.",
+    "katib_experiments_current": "Experiments by current status (recomputed per scrape).",
+    "katib_trial_created_total": "Trials created.",
+    "katib_trial_succeeded_total": "Trials that succeeded.",
+    "katib_trial_failed_total": "Trials that failed.",
+    "katib_trial_killed_total": "Trials killed.",
+    "katib_trial_early_stopped_total": "Trials early-stopped.",
+    "katib_trial_metrics_unavailable_total": "Trials finishing without objective metrics.",
+    "katib_trial_completed_total": "Trials completed (other terminal states).",
+    "katib_trial_preempted_total": "Trial preemptions by the fair-share policy.",
+    "katib_trials_current": "Trials by current condition (recomputed per scrape).",
+    "katib_queue_depth": "Pending trials per experiment after the last dispatch pass.",
+    "katib_queue_wait_seconds": "Oldest pending trial's wait per experiment.",
+    "katib_fairshare_deficit": "Fair-share deficit (normalized device-seconds) per experiment.",
+    "katib_pack_formed_total": "Trial packs formed (vmapped multi-trial programs).",
+    "katib_trial_packed_total": "Trials dispatched as pack members.",
+    "katib_pack_occupancy": "Members / capacity of the most recent pack.",
+    "katib_obslog_flush_total": "Group-commit flushes of the buffered observation store.",
+    "katib_obslog_flush_batch_rows": "Rows drained by buffered-store flushes.",
+    "katib_obslog_flush_latency_seconds": "Latency of the last buffered-store flush.",
+    "katib_obslog_buffered_rows": "Rows currently buffered in the write-behind store.",
+    "katib_span_duration_seconds": "Trial lifecycle stage durations from tracing spans, by stage.",
+}
+
+
+def _default_help(name: str, kind: str) -> str:
+    return _HELP_CATALOG.get(name, f"katib-tpu {kind} {name}.")

@@ -171,6 +171,7 @@ class InProcessExecutor:
                 pass
         fn = resolve_entry_point(exp.spec.trial_template)
         token = set_current_reporter(ctx.reporter)
+        ctx._trace_fn_start()  # compile boundary: first report closes it
         try:
             result = fn(ctx.assignments, ctx)
             # convenience: a returned dict of floats is auto-reported
@@ -198,6 +199,7 @@ class InProcessExecutor:
                 TrialOutcome.FAILED, traceback.format_exc(limit=10), exit_code=1
             )
         finally:
+            ctx._trace_fn_end()
             from ..runtime import metrics as _m
 
             _m._current_reporter.reset(token)
@@ -290,6 +292,13 @@ class SubprocessExecutor:
         env[ENV_TRIAL_NAME] = trial.name
         if self.db_path:
             env[ENV_DB_PATH] = self.db_path
+        if ctx.trace_id and ctx.trace_parent:
+            # W3C-traceparent-style context: the child's report_metrics spans
+            # rejoin this trial's controller trace (katib_tpu.tracing)
+            from ..tracing import ENV_TRACEPARENT, format_traceparent
+
+            env[ENV_TRACEPARENT] = format_traceparent(ctx.trace_id, ctx.trace_parent)
+            env.setdefault("KATIB_TPU_EXPERIMENT", trial.experiment_name)
         metrics_file = None
         mc = spec.metrics_collector_spec
         if mc.collector_kind == CollectorKind.FILE and mc.source and mc.source.file_path:
@@ -688,6 +697,12 @@ class MultiHostExecutor(SubprocessExecutor):
         ).rstrip(os.pathsep)
         base_env[ENV_TRIAL_NAME] = trial.name
         base_env["KATIB_TPU_EXPERIMENT"] = trial.experiment_name
+        if ctx.trace_id and ctx.trace_parent:
+            from ..tracing import ENV_TRACEPARENT, format_traceparent
+
+            base_env[ENV_TRACEPARENT] = format_traceparent(
+                ctx.trace_id, ctx.trace_parent
+            )
         # coordinator endpoint: auto-assigned unless the template/env pins it
         # (a cluster launcher spanning machines). Auto ports come from a
         # probe-close-bind cycle, so an unrelated process can steal the port

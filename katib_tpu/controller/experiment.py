@@ -94,6 +94,14 @@ class ExperimentController:
             )
         self.obs_store: ObservationStore = store
         self.db_path = db_path
+        from ..tracing import Tracer
+
+        self.tracer = Tracer(
+            enabled=rt.tracing,
+            metrics=self.metrics,
+            ring_size=rt.trace_ring_spans,
+            persist_dir=os.path.join(root_dir, "traces") if root_dir else None,
+        )
         self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
         self.metrics.set_collector(
             self._collect_current_gauges,
@@ -117,6 +125,7 @@ class ExperimentController:
             queue_stall_seconds=rt.queue_stall_seconds,
             aging_seconds=rt.fairshare_aging_seconds,
             preemption_grace_seconds=rt.preemption_grace_seconds,
+            tracer=self.tracer,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -255,7 +264,9 @@ class ExperimentController:
         )
         requests = len(trials) + add_count - incomplete_es
 
+        suggest_start = time.time()
         assignments = self.suggestions.sync_assignments(exp, trials, requests)
+        suggest_end = time.time()
         # Deferred dispatch: queue the whole batch first, then one dispatch
         # pass — pack formation (controller/packing.py) needs the batch's
         # packable trials waiting TOGETHER, or the first would start solo on
@@ -264,6 +275,20 @@ class ExperimentController:
             trial = Trial.from_assignment(assignment, exp.name)
             trial.labels["katib-tpu/experiment"] = exp.name
             self.state.create_trial(trial)
+            if self.tracer.enabled:
+                # the trial's trace starts where its lifecycle did: at the
+                # suggestion batch that produced it. Every trial of the
+                # batch carries the same `suggestion` child span window.
+                root = self.tracer.begin_trial(
+                    exp.name, trial.name, start=suggest_start
+                )
+                if root is not None:
+                    self.tracer.record_span(
+                        "suggestion", exp.name, root.trace_id, root.span_id,
+                        start=suggest_start, end=suggest_end,
+                        algorithm=exp.spec.algorithm.algorithm_name,
+                        batch=len(assignments),
+                    )
             checkpoint_dir = self._checkpoint_dir_for(exp, trial)
             self.scheduler.submit(
                 exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
@@ -427,6 +452,7 @@ class ExperimentController:
             self.obs_store.delete_observation_log(t.name)
         self.suggestions.forget(name)
         self.scheduler.forget_experiment(name)
+        self.tracer.forget(name)
         self._completed_seen.discard(name)
         self.metrics.inc("katib_experiment_deleted_total", experiment=name)
         self.state.delete_experiment(name)

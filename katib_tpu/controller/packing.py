@@ -187,6 +187,7 @@ class PackedTrialExecutor:
         # no contextvar reporter: report_metrics() inside a pack-aware fn
         # would have no member to demux to — the fn must go through ctx
         token = set_current_reporter(None)
+        ctx._trace_fn_start()  # compile boundary in the gang trace
         try:
             result = fn(ctx.assignments, ctx)
             if isinstance(result, dict):
@@ -207,6 +208,7 @@ class PackedTrialExecutor:
             # failure necessarily fails its survivors
             pack_error = traceback.format_exc(limit=10)
         finally:
+            ctx._trace_fn_end()
             from ..runtime import metrics as _m
 
             _m._current_reporter.reset(token)

@@ -101,11 +101,16 @@ class TrialScheduler:
         queue_stall_seconds: float = 120.0,
         aging_seconds: float = 60.0,
         preemption_grace_seconds: float = 30.0,
+        tracer=None,
     ):
         from .fairshare import FairSharePolicy
+        from ..tracing import install_log_context
 
+        install_log_context()  # experiment=/trial=/trace_id= log stamping
         self.recorder = events
         self.metrics_registry = metrics
+        self.tracer = tracer  # katib_tpu.tracing.Tracer (None = no tracing)
+        self._queue_spans: Dict[str, Any] = {}  # trial -> open queue_wait span
         if devices is None:
             devices = list(range(8))  # abstract slots when JAX not involved
         if devices_per_host:
@@ -156,6 +161,22 @@ class TrialScheduler:
 
     LINEAGE_LABEL = "checkpoint-lineage"
 
+    def _tr(self):
+        """The active tracer, or None when tracing is off — every
+        instrumentation site guards on this one cheap check."""
+        t = self.tracer
+        return t if (t is not None and t.enabled) else None
+
+    def _trace_end_trial(self, exp_name: str, trial: Trial) -> None:
+        """End the trial's root span once it is terminal (idempotent).
+        Called AFTER all child spans closed so parents outlive children."""
+        tr = self._tr()
+        if tr is not None and trial.is_terminal:
+            tr.end_trial(
+                exp_name, trial.name,
+                outcome=trial.condition.value, reason=trial.current_reason,
+            )
+
     def submit(
         self,
         exp: Experiment,
@@ -176,6 +197,16 @@ class TrialScheduler:
             # assignments — in either direction (advisor round-4 finding:
             # the old guard only blocked lineage trials as reuse TARGETS).
             trial.labels[self.LINEAGE_LABEL] = "1"
+        tr = self._tr()
+        admission = None
+        if tr is not None:
+            # one trace per trial: the controller may already have begun it
+            # at suggestion time; direct submits (resume, tests) begin here
+            root = tr.begin_trial(exp.name, trial.name)
+            admission = tr.start_span(
+                "admission", exp.name, root.trace_id, root.span_id,
+                attrs={"lineage": bool(checkpoint_dir)},
+            )
         trial.set_condition(TrialCondition.PENDING, "TrialPending", "waiting for devices")
         self.state.update_trial(trial)
         if self.metrics_registry is not None:
@@ -197,25 +228,44 @@ class TrialScheduler:
             # finalized from a prior identical-assignment success; never
             # reused for checkpoint-lineage trials (PBT exploit/explore
             # trains FROM a parent checkpoint — same params, different run)
+            if tr is not None:
+                tr.end_span(admission, reused=True)
+                self._trace_end_trial(exp.name, trial)
             return
+        if tr is not None:
+            tr.end_span(admission)
         with self._lock:
-            self._stamp_enqueue(trial.name)
+            self._stamp_enqueue(exp, trial)
             self._waiting.append((exp, trial))
         if dispatch:
             self._dispatch()
 
-    def _stamp_enqueue(self, trial_name: str) -> None:
+    def _stamp_enqueue(self, exp: Experiment, trial: Trial) -> None:
         """Record arrival order + pending-since for the fair-share queue;
         caller holds the scheduler lock."""
         self._seq_counter += 1
-        self._enqueue_seq[trial_name] = self._seq_counter
-        self._enqueued_at[trial_name] = time.time()
+        self._enqueue_seq[trial.name] = self._seq_counter
+        self._enqueued_at[trial.name] = time.time()
+        tr = self._tr()
+        if tr is not None:
+            root = tr.trial_root(exp.name, trial.name)
+            if root is not None:
+                self._queue_spans[trial.name] = tr.start_span(
+                    "queue_wait", exp.name, root.trace_id, root.span_id
+                )
 
     def _clear_enqueue(self, trial_name: str) -> None:
         """Drop a trial's queue bookkeeping (dispatched or killed while
         pending); caller holds the scheduler lock."""
         self._enqueue_seq.pop(trial_name, None)
         self._enqueued_at.pop(trial_name, None)
+        span = self._queue_spans.pop(trial_name, None)
+        if span is not None:
+            tr = self._tr()
+            if tr is not None:
+                # stall flag from PR 2's queue bookkeeping: was this wait
+                # long enough that TrialQueueStalled fired for it?
+                tr.end_span(span, stalled=trial_name in self._stall_emitted)
         self._stall_emitted.discard(trial_name)
 
     def dispatch(self) -> None:
@@ -290,6 +340,7 @@ class TrialScheduler:
                     self._clear_enqueue(trial_name)
                     t.set_condition(TrialCondition.KILLED, "TrialKilled", "killed while pending")
                     self.state.update_trial(t)
+                    self._trace_end_trial(exp.name, t)
                     self.events.put(TrialEvent(exp.name, t.name, t.condition))
                     return
         h = self._handles.get(trial_name)
@@ -302,6 +353,7 @@ class TrialScheduler:
         (ExperimentController.load_experiment) can requeue them — shutdown is
         an artifact of the controller's lifetime, not a search decision."""
         self._shutdown.set()
+        tr = self._tr()
         with self._lock:
             waiting = list(self._waiting)
             self._waiting.clear()
@@ -309,9 +361,14 @@ class TrialScheduler:
             self._enqueued_at.clear()
             self._stall_emitted.clear()
             self._head_key, self._head_credits = None, 0
+            queue_spans = dict(self._queue_spans)
+            self._queue_spans.clear()
         for exp, t in waiting:
             t.set_condition(TrialCondition.KILLED, "SchedulerShutdown", "scheduler shutdown")
             self.state.update_trial(t)
+            if tr is not None:
+                tr.end_span(queue_spans.get(t.name), aborted="shutdown")
+                self._trace_end_trial(exp.name, t)
         for h in list(self._handles.values()):
             h.kill()
 
@@ -608,6 +665,19 @@ class TrialScheduler:
         from .packing import pack_capacity
 
         k = max(pack_capacity(exp), 1)
+        tr = self._tr()
+        if tr is not None:
+            # instantaneous stage marker in each member's trace: the moment
+            # pack formation merged it into a shared dispatch unit
+            now = time.time()
+            for t in members:
+                mroot = tr.trial_root(exp.name, t.name)
+                if mroot is not None:
+                    tr.record_span(
+                        "pack_formation", exp.name, mroot.trace_id,
+                        mroot.span_id, start=now, end=now,
+                        members=len(members), capacity=k,
+                    )
         if self.metrics_registry is not None:
             self.metrics_registry.inc("katib_pack_formed_total", experiment=exp.name)
             self.metrics_registry.inc(
@@ -625,12 +695,26 @@ class TrialScheduler:
             )
 
     def _run_trial(self, exp: Experiment, trial: Trial, devices, handle: TrialExecution) -> None:
+        from ..tracing import pop_log_context, push_log_context
+
         restarted = False
         requeued = False
         started = time.time()
         timer = None
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
+        tr = self._tr()
+        root = tr.trial_root(exp.name, trial.name) if tr is not None else None
+        run_span = exec_span = None
+        if root is not None:
+            run_span = tr.start_span(
+                "run", exp.name, root.trace_id, root.span_id,
+                attrs={"devices": len(devices)},
+            )
+        log_token = push_log_context(
+            experiment=exp.name, trial=trial.name,
+            trace_id=root.trace_id if root is not None else "",
+        )
         try:
             trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "Trial is running")
             self.state.update_trial(trial)
@@ -644,6 +728,11 @@ class TrialScheduler:
                 timer.daemon = True
                 timer.start()
 
+            setup_span = None
+            if run_span is not None:
+                setup_span = tr.start_span(
+                    "executor_setup", exp.name, run_span.trace_id, run_span.span_id
+                )
             ctx = self._build_context(exp, trial, devices, handle)
             spec = exp.spec
             if (
@@ -656,9 +745,20 @@ class TrialScheduler:
                 executor = self._subprocess
             else:
                 executor = self._in_process
+            if run_span is not None:
+                tr.end_span(setup_span, executor=type(executor).__name__)
+                exec_span = tr.start_span(
+                    "execute", exp.name, run_span.trace_id, run_span.span_id,
+                    attrs={"executor": type(executor).__name__},
+                )
+                # runtime-side spans (compile boundary, steps, checkpoint,
+                # flush barriers) hang off the execute span
+                ctx.bind_trace(tr, exp.name, run_span.trace_id, exec_span.span_id)
             result, abandoned = self._execute_bounded(
                 executor, exp, trial, ctx, handle, timed_out
             )
+            if exec_span is not None:
+                tr.end_span(exec_span, outcome=result.outcome.value)
 
             if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
                 # deadline exceeded counts against maxFailedTrialCount
@@ -671,23 +771,43 @@ class TrialScheduler:
             # continues the same observation log (checkpoint resume) or a
             # clean one (no checkpoint).
             if self._preempt_applies(trial, result):
+                preempt_start = time.time()
                 requeued = self._requeue_preempted(exp, trial)
+                if requeued and run_span is not None:
+                    tr.record_span(
+                        "preempted", exp.name, run_span.trace_id, run_span.span_id,
+                        start=preempt_start, end=time.time(),
+                        resumable=trial.name in self._last_checkpoint,
+                    )
             if not requeued:
                 # Classify (observation fold + success/failure conditions)
                 # BEFORE the restart decision: a non-zero-exit trial a
                 # success_condition rescues must not burn max_trial_restarts
                 # attempts, and an rc=0 trial a failure_condition flips to
                 # Failed must be retried like any other failure.
+                fin_span = None
+                if run_span is not None:
+                    fin_span = tr.start_span(
+                        "finalize", exp.name, run_span.trace_id, run_span.span_id
+                    )
                 result, observation = self._classify(exp, trial, result)
                 restarted = self._maybe_restart(exp, trial, result)
                 if not restarted:
                     self._finalize(exp, trial, result, observation)
+                if fin_span is not None:
+                    tr.end_span(fin_span, restarted=restarted)
         except Exception:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
             self.state.update_trial(trial)
         finally:
             if timer is not None:
                 timer.cancel()
+            if run_span is not None:
+                tr.end_span(exec_span)  # no-op unless an exception skipped it
+                tr.end_span(run_span, requeued=requeued, restarted=restarted)
+            if tr is not None and not requeued and not restarted:
+                self._trace_end_trial(exp.name, trial)
+            pop_log_context(log_token)
             with self._lock:
                 self._running.pop(trial.name, None)
                 if not requeued:
@@ -718,6 +838,7 @@ class TrialScheduler:
         one PackedTrialExecutor call, then per-trial condition fan-out —
         each member is classified/finalized independently, exactly like K
         solo trials would be."""
+        from ..tracing import pop_log_context, push_log_context
         from .packing import PACK_LABEL, PackedTrialExecutor
 
         timer = None
@@ -726,6 +847,28 @@ class TrialScheduler:
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         pack_id = f"{trials[0].name}x{len(trials)}"
+        tr = self._tr()
+        # one gang-level trace per pack (root `pack` span + K member child
+        # spans); each member's own trial trace gets a `run` span linking to
+        # it, so both the per-trial and the shared-program views connect
+        gang = (
+            tr.begin_gang(exp.name, pack_id, [t.name for t in trials])
+            if tr is not None
+            else None
+        )
+        member_runs: Dict[str, Any] = {}
+        if gang is not None:
+            for t in trials:
+                mroot = tr.trial_root(exp.name, t.name)
+                if mroot is not None:
+                    member_runs[t.name] = tr.start_span(
+                        "run", exp.name, mroot.trace_id, mroot.span_id,
+                        attrs={"pack": pack_id, "packTraceId": gang.trace_id},
+                    )
+        log_token = push_log_context(
+            experiment=exp.name, trial=pack_id,
+            trace_id=gang.trace_id if gang is not None else "",
+        )
         try:
             for t in trials:
                 t.labels[PACK_LABEL] = pack_id
@@ -746,6 +889,10 @@ class TrialScheduler:
                 timer.start()
 
             ctx = self._build_pack_context(exp, trials, devices, handles)
+            if gang is not None:
+                # shared compiled program: compile/steps/flush spans land in
+                # the gang trace under the pack root
+                ctx.bind_trace(tr, exp.name, gang.trace_id, gang.root.span_id)
             executor = PackedTrialExecutor(self.obs_store)
             results, abandoned = self._execute_pack_bounded(
                 executor, exp, trials, ctx, handles, timed_out
@@ -761,6 +908,13 @@ class TrialScheduler:
                 if self._preempt_applies(trial, result):
                     if self._requeue_preempted(exp, trial):
                         requeued.add(trial.name)
+                        if gang is not None:
+                            tr.end_span(
+                                gang.members.get(trial.name), outcome="preempted"
+                            )
+                            tr.end_span(
+                                member_runs.get(trial.name), requeued=True
+                            )
                         continue
                 result, observation = self._classify(exp, trial, result)
                 restarted = self._maybe_restart(exp, trial, result)
@@ -769,6 +923,11 @@ class TrialScheduler:
                     self._checkpoint_dirs.pop(trial.name, None)
                     self._restarts.pop(trial.name, None)
                     self._last_checkpoint.pop(trial.name, None)
+                if gang is not None:
+                    tr.end_span(
+                        gang.members.get(trial.name), outcome=result.outcome.value
+                    )
+                    tr.end_span(member_runs.get(trial.name), restarted=restarted)
         except Exception:
             tb = traceback.format_exc(limit=5)
             for t in trials:
@@ -778,6 +937,15 @@ class TrialScheduler:
         finally:
             if timer is not None:
                 timer.cancel()
+            if gang is not None:
+                for t in trials:
+                    tr.end_span(gang.members.get(t.name))
+                    tr.end_span(member_runs.get(t.name))
+                tr.end_span(gang.root)
+                for t in trials:
+                    if t.name not in requeued:
+                        self._trace_end_trial(exp.name, t)
+            pop_log_context(log_token)
             with self._lock:
                 self._running.pop(trials[0].name, None)
                 for t in trials:
@@ -806,9 +974,14 @@ class TrialScheduler:
         machinery engages only when EVERY member was asked to stop (timeout
         or shutdown) and the shared program still refuses to exit — there is
         one program, so there is one thread to abandon."""
+        from ..tracing import push_log_context
+
         box: Dict[str, Any] = {}
 
         def _exec():
+            push_log_context(
+                experiment=exp.name, trial=f"{trials[0].name}x{len(trials)}"
+            )
             try:
                 box["results"] = executor.execute(exp, trials, ctx, handles)
             except BaseException:
@@ -912,9 +1085,12 @@ class TrialScheduler:
         abandoned after a grace period — its daemon thread keeps running (a
         Python thread can't be force-killed) and is returned to the caller so
         the devices it may still be using get quarantined, not reissued."""
+        from ..tracing import push_log_context
+
         box: Dict[str, Any] = {}
 
         def _exec():
+            push_log_context(experiment=exp.name, trial=trial.name)
             try:
                 box["result"] = executor.execute(exp, trial, ctx, handle)
             except BaseException:
@@ -1050,7 +1226,7 @@ class TrialScheduler:
                 + (" (resumes from checkpoint)" if has_checkpoint else ""),
             )
         with self._lock:
-            self._stamp_enqueue(trial.name)
+            self._stamp_enqueue(exp, trial)
             self._waiting.append((exp, trial))
         return True
 
@@ -1142,7 +1318,7 @@ class TrialScheduler:
         )
         self.state.update_trial(trial)
         with self._lock:
-            self._stamp_enqueue(trial.name)
+            self._stamp_enqueue(exp, trial)
             self._waiting.append((exp, trial))
         return True
 

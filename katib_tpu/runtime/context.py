@@ -30,12 +30,74 @@ class TrialContext:
     # selection prefers recently-checkpointed trials; resume-vs-restart on
     # preemption hinges on whether a checkpoint exists at all).
     on_checkpoint: Optional[Callable[[int], None]] = None
+    # Tracing (katib_tpu.tracing): bound by the scheduler when tracing is
+    # on. The runtime marks the compile boundary (first report ends the
+    # `compile` span and opens `steps`) and spans checkpoint saves/restores
+    # and obslog flush barriers. All None when tracing is disabled — the
+    # hot path then pays one attribute check per report.
+    tracer: Optional[Any] = None
+    trace_id: Optional[str] = None
+    trace_parent: Optional[str] = None
+
+    def bind_trace(self, tracer, experiment: str, trace_id: str, parent_id: str) -> None:
+        """Attach the trial's trace context (scheduler-side hook)."""
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.trace_parent = parent_id
+        self._trace_experiment = experiment
+        self._compile_span = None
+        self._steps_span = None
+        self._report_count = 0
+
+    def _trace_span(self, name: str, parent: Optional[str] = None, **attrs):
+        if self.tracer is None:
+            return None
+        return self.tracer.start_span(
+            name,
+            getattr(self, "_trace_experiment", self.experiment_name),
+            self.trace_id,
+            parent or self.trace_parent,
+            attrs=attrs or None,
+        )
+
+    def _trace_fn_start(self) -> None:
+        """Executor hook: the trial function is about to run. Everything up
+        to the first report is attributed to `compile` (trace-and-compile of
+        the train step dominates it on JAX workloads)."""
+        if self.tracer is not None:
+            self._compile_span = self._trace_span("compile")
+
+    def _trace_mark_report(self) -> None:
+        """First report = compile boundary: end `compile`, open `steps`."""
+        self._report_count = getattr(self, "_report_count", 0) + 1
+        cs = getattr(self, "_compile_span", None)
+        if cs is not None:
+            self.tracer.end_span(cs, first_report=True)
+            self._compile_span = None
+            self._steps_span = self._trace_span("steps")
+
+    def _trace_fn_end(self) -> None:
+        """Executor hook: the trial function returned/unwound."""
+        if self.tracer is None:
+            return
+        cs = getattr(self, "_compile_span", None)
+        if cs is not None:
+            # the function never reported: the whole run was one opaque
+            # stretch — keep it labeled compile with the zero-report marker
+            self.tracer.end_span(cs, reports=0)
+            self._compile_span = None
+        ss = getattr(self, "_steps_span", None)
+        if ss is not None:
+            self.tracer.end_span(ss, reports=getattr(self, "_report_count", 0))
+            self._steps_span = None
 
     def report(self, **metrics: float) -> None:
         """Push metrics; raises katib_tpu.runtime.metrics.EarlyStopped when all
         early-stopping rules have tripped, TrialPreempted when the fair-share
         policy needs this trial's chips (metrics are persisted first — save
         your checkpoint BEFORE reporting and preemption loses nothing)."""
+        if self.tracer is not None:
+            self._trace_mark_report()
         self.reporter.report(**metrics)
 
     def flush_metrics(self) -> None:
@@ -44,7 +106,12 @@ class TrialContext:
         reported so far is persisted. The runtime calls it on checkpoint
         save and before TrialPreempted/TrialKilled unwind; trial code only
         needs it around its own external side effects."""
-        self.reporter.store.flush()
+        span = self._trace_span("obslog_flush") if self.tracer is not None else None
+        try:
+            self.reporter.store.flush()
+        finally:
+            if span is not None:
+                self.tracer.end_span(span)
 
     @property
     def preempt_requested(self) -> bool:
@@ -119,17 +186,33 @@ class TrialContext:
         from .checkpoints import store_for
 
         store = store_for(self.checkpoint_dir, self.workdir, subdir)
-        notify, orig_save = self.on_checkpoint, store.save
+        notify, orig_save, orig_restore = self.on_checkpoint, store.save, store.restore
 
         def _save(step, state, _notify=notify, _orig=orig_save):
-            _orig(step, state)
+            span = self._trace_span("checkpoint_save", step=int(step)) if self.tracer else None
+            try:
+                _orig(step, state)
+            finally:
+                if span is not None:
+                    self.tracer.end_span(span)
             if _notify is not None:
                 _notify(step)
             # every save is a durability point: a preemption decided against
             # this freshly-checkpointed trial must find its metrics on disk
             self.flush_metrics()
 
+        def _restore(step=None, template=None, _orig=orig_restore):
+            span = self._trace_span("checkpoint_restore") if self.tracer else None
+            restored = None
+            try:
+                restored = _orig(step=step, template=template)
+                return restored
+            finally:
+                if span is not None:
+                    self.tracer.end_span(span, found=restored is not None)
+
         store.save = _save  # instance-level shadow; CheckpointStore API unchanged
+        store.restore = _restore
         return store
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:

@@ -263,6 +263,12 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
     if trial and db:
         store = _env_bound_store(db)
         MetricsReporter(store=store, trial_name=trial).report(**merged)
+        # rejoin the controller trace: $KATIB_TPU_TRACEPARENT (issued by the
+        # subprocess executor) parents this process's report span onto the
+        # trial's `execute` span (katib_tpu.tracing)
+        from ..tracing import record_env_report
+
+        record_env_report(len(merged))
         return
     for k, v in merged.items():
         # normalized so the stdout collector's numeric TEXT filter matches

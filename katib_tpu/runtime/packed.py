@@ -135,6 +135,61 @@ class PackedTrialContext:
             self.member_labels = [{} for _ in range(k)]
         if not self.preempt_events:
             self.preempt_events = [None] * k
+        self._tracer = None  # katib_tpu.tracing — bound by the scheduler
+        self._trace_id = None
+        self._trace_parent = None
+        self._trace_experiment = ""
+        self._compile_span = None
+        self._steps_span = None
+        self._report_count = 0
+
+    # -- tracing hooks (one shared program -> spans in the gang trace) -------
+
+    def bind_trace(self, tracer, experiment: str, trace_id: str, parent_id: str) -> None:
+        self._tracer = tracer
+        self._trace_experiment = experiment
+        self._trace_id = trace_id
+        self._trace_parent = parent_id
+
+    def _trace_fn_start(self) -> None:
+        if self._tracer is not None:
+            self._compile_span = self._tracer.start_span(
+                "compile", self._trace_experiment, self._trace_id,
+                self._trace_parent, attrs={"packSize": self.pack_size},
+            )
+
+    def _trace_mark_report(self) -> None:
+        self._report_count += 1
+        if self._compile_span is not None:
+            self._tracer.end_span(self._compile_span, first_report=True)
+            self._compile_span = None
+            self._steps_span = self._tracer.start_span(
+                "steps", self._trace_experiment, self._trace_id, self._trace_parent
+            )
+
+    def _trace_fn_end(self) -> None:
+        if self._tracer is None:
+            return
+        if self._compile_span is not None:
+            self._tracer.end_span(self._compile_span, reports=0)
+            self._compile_span = None
+        if self._steps_span is not None:
+            self._tracer.end_span(self._steps_span, reports=self._report_count)
+            self._steps_span = None
+
+    def _flush_traced(self, store) -> None:
+        """Durability barrier with its `obslog_flush` span in the gang trace."""
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.start_span(
+                "obslog_flush", self._trace_experiment, self._trace_id,
+                self._trace_parent,
+            )
+        try:
+            store.flush()
+        finally:
+            if span is not None:
+                self._tracer.end_span(span)
 
     @property
     def pack_size(self) -> int:
@@ -187,6 +242,8 @@ class PackedTrialContext:
         member freezes on kill/preempt so its metrics are durable when the
         scheduler requeues it. Raises PackFrozen when no member remains
         active."""
+        if self._tracer is not None:
+            self._trace_mark_report()
         k = self.pack_size
         cols: Dict[str, np.ndarray] = {}
         for name, value in metrics.items():
@@ -243,10 +300,10 @@ class PackedTrialContext:
             # metrics must be durable before the scheduler's requeue path
             # observes the freeze (same barrier MetricsReporter.report runs
             # before raising TrialKilled/TrialPreempted)
-            store.flush()
+            self._flush_traced(store)
         if not any(self._active):
             if store is not None:
-                store.flush()
+                self._flush_traced(store)
             raise PackFrozen(
                 f"all {k} members of pack {self.trial_names} are frozen"
             )

@@ -142,6 +142,9 @@ class ApiServicer:
             MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
             for l in payload.get("metricLogs", [])
         ]
+        self._record_rpc_span(
+            "rpc.report_observation_log", payload, trial=trial, rows=len(logs)
+        )
         if not logs:
             return {}
         # a duplicate of an incoming row necessarily shares its timestamp,
@@ -188,6 +191,23 @@ class ApiServicer:
         assert self.store is not None
         self.store.delete_observation_log(payload["trialName"])
         return {}
+
+    @staticmethod
+    def _record_rpc_span(name: str, payload: Dict, **attrs) -> None:
+        """Rejoin point for traced clients: a request carrying a
+        ``traceparent`` (W3C-style, issued by the controller's tracer) lands
+        a server-side span parented into the caller's trial trace."""
+        from ..tracing import default_tracer, parse_traceparent
+
+        ctx = parse_traceparent(payload.get("traceparent"))
+        if ctx is None:
+            return
+        tracer = default_tracer()
+        if not tracer.enabled:
+            return
+        trace_id, parent_id = ctx
+        span = tracer.start_span(name, "_rpc", trace_id, parent_id, attrs=attrs)
+        tracer.end_span(span)
 
     # ------------------------------------------------------------------
 
@@ -375,16 +395,19 @@ class RemoteObservationStore(ObservationStore):
         )
 
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
-        self.client._call(
-            "ReportObservationLog",
-            {
-                "trialName": trial_name,
-                "metricLogs": [
-                    {"timestamp": l.timestamp, "metricName": l.metric_name, "value": l.value}
-                    for l in logs
-                ],
-            },
-        )
+        from ..tracing import current_traceparent
+
+        payload = {
+            "trialName": trial_name,
+            "metricLogs": [
+                {"timestamp": l.timestamp, "metricName": l.metric_name, "value": l.value}
+                for l in logs
+            ],
+        }
+        tp = current_traceparent()
+        if tp:
+            payload["traceparent"] = tp  # rejoined server-side (api servicer)
+        self.client._call("ReportObservationLog", payload)
 
     def get_observation_log(
         self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None

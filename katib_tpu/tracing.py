@@ -1,0 +1,694 @@
+"""End-to-end trial lifecycle tracing — spans, context propagation, export.
+
+The reference's observability ceiling is logs plus counter/gauge Prometheus
+metrics (SURVEY.md §5, prometheus_metrics.go); after vmapped packing (PR 1),
+preemptive fair-share (PR 2) and the buffered obslog (PR 3) multiplied
+concurrency, "where did this trial's wall-clock go?" is unanswerable from
+those surfaces. Podracer-style TPU stacks (arXiv:2104.06272) live and die by
+per-stage timing; this module supplies it:
+
+- :class:`Span` — ``{trace_id, span_id, parent_id, name, start, end, attrs}``
+  records collected into a bounded, thread-safe per-experiment ring;
+- :class:`Tracer` — one trace per trial (root span ``trial`` from submission
+  to terminal condition) with child spans for every lifecycle stage:
+  suggestion, admission, queue wait, pack formation, dispatch/run, executor
+  setup, first-step compile vs steady-state steps, checkpoint save/restore,
+  obslog flush barriers, preemption and finalization. Packed trials get one
+  gang-level trace whose root ``pack`` span has K ``member:*`` child spans;
+- W3C-traceparent-style context (``00-<trace>-<span>-01``) propagated to
+  subprocess trials via ``KATIB_TPU_TRACEPARENT`` and rejoined on the
+  ``report_metrics`` env binding and the ReportObservationLog RPC;
+- span ends feed the ``katib_span_duration_seconds{stage=...}`` histogram in
+  the MetricsRegistry (controller/events.py);
+- exports: span-tree text rendering (``katib-tpu trace``), Chrome/Perfetto
+  ``trace_event`` JSON (``GET .../trace?format=perfetto``, openable in
+  ui.perfetto.dev alongside the xplane dumps), and per-trial JSON
+  persistence under ``<root>/traces/`` so traces outlive the controller.
+
+Disabled (``runtime.tracing=false`` / ``KATIB_TPU_TRACING=0``) the tracer
+costs one boolean check per call site: ``span()`` hands back a shared no-op
+context manager and every ``begin_*``/``start_span`` returns None.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+ENV_TRACING = "KATIB_TPU_TRACING"
+ENV_TRACEPARENT = "KATIB_TPU_TRACEPARENT"
+
+SPAN_DURATION_METRIC = "katib_span_duration_seconds"
+
+_TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def tracing_enabled_from_env(default: bool = True) -> bool:
+    raw = os.environ.get(ENV_TRACING)
+    if raw is None or raw == "":
+        return default
+    return raw.lower() not in ("0", "false", "off")
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """W3C trace-context shape (version 00, sampled flag)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) or None for a missing/malformed header."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start: float
+    end: Optional[float] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return max((self.end if self.end is not None else time.time()) - self.start, 0.0)
+
+    @property
+    def ended(self) -> bool:
+        return self.end is not None
+
+    def set(self, **attrs) -> None:
+        """Attach attributes mid-span (same surface as the disabled-mode
+        no-op span, so call sites never branch)."""
+        self.attrs.update(attrs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "durationSeconds": round(self.duration, 6) if self.end is not None else None,
+            "attrs": dict(self.attrs),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Span":
+        return cls(
+            trace_id=d.get("traceId", ""),
+            span_id=d.get("spanId", ""),
+            parent_id=d.get("parentId"),
+            name=d.get("name", ""),
+            start=float(d.get("start", 0.0)),
+            end=None if d.get("end") is None else float(d["end"]),
+            attrs=dict(d.get("attrs") or {}),
+        )
+
+
+class _NoopSpan:
+    """Shared stand-in when tracing is disabled: every method is a no-op, so
+    instrumented code never branches beyond the enabled check."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _NoopSpanCM:
+    __slots__ = ()
+
+    def __enter__(self):
+        return _NOOP_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CM = _NoopSpanCM()
+
+
+# current span for the context-manager API (same-thread nesting; the
+# scheduler's cross-thread lifecycle spans use explicit parent ids instead)
+_current_span: ContextVar[Optional[Span]] = ContextVar("katib_tpu_span", default=None)
+
+
+def current_traceparent() -> Optional[str]:
+    """Propagatable context: the current in-thread span if any, else the
+    inherited subprocess context from $KATIB_TPU_TRACEPARENT."""
+    span = _current_span.get()
+    if span is not None:
+        return format_traceparent(span.trace_id, span.span_id)
+    tp = os.environ.get(ENV_TRACEPARENT)
+    return tp if parse_traceparent(tp) else None
+
+
+@dataclass
+class GangTrace:
+    """Handle for one pack's shared trace: root ``pack`` span plus one open
+    ``member:<trial>`` child span per member (ended as members finish)."""
+
+    trace_id: str
+    root: Span
+    members: Dict[str, Span]
+
+
+class Tracer:
+    """Bounded, thread-safe span collector with per-trial trace bookkeeping.
+
+    One ring (deque) of spans per experiment bounds memory; completed trial
+    traces are optionally persisted as one small JSON file each under
+    ``persist_dir`` so ``katib-tpu trace`` works after the controller exits.
+    """
+
+    MAX_TRIAL_INDEX = 8192  # trial -> trace_id mapping bound
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        metrics=None,
+        ring_size: int = 4096,
+        persist_dir: Optional[str] = None,
+    ):
+        self.enabled = enabled
+        self.metrics = metrics
+        self.ring_size = ring_size
+        self.persist_dir = persist_dir
+        self._lock = threading.Lock()
+        self._rings: Dict[str, Deque[Span]] = {}
+        # (experiment, trial) -> trace_id, insertion-ordered for the bound
+        self._trial_traces: "collections.OrderedDict[Tuple[str, str], str]" = (
+            collections.OrderedDict()
+        )
+        self._roots: Dict[str, Span] = {}  # trace_id -> root span
+
+    # -- id + record plumbing ------------------------------------------------
+
+    @staticmethod
+    def new_trace_id() -> str:
+        return uuid.uuid4().hex  # 32 hex chars — W3C trace-id width
+
+    @staticmethod
+    def new_span_id() -> str:
+        return uuid.uuid4().hex[:16]  # 16 hex chars — W3C span-id width
+
+    def _record(self, experiment: str, span: Span) -> None:
+        with self._lock:
+            ring = self._rings.get(experiment)
+            if ring is None:
+                ring = self._rings[experiment] = collections.deque(maxlen=self.ring_size)
+            ring.append(span)
+
+    # -- explicit span API (cross-thread lifecycle instrumentation) ----------
+
+    def start_span(
+        self,
+        name: str,
+        experiment: str,
+        trace_id: str,
+        parent_id: Optional[str] = None,
+        start: Optional[float] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Span]:
+        if not self.enabled:
+            return None
+        span = Span(
+            trace_id=trace_id,
+            span_id=self.new_span_id(),
+            parent_id=parent_id,
+            name=name,
+            start=time.time() if start is None else start,
+            attrs=dict(attrs or {}),
+        )
+        self._record(experiment, span)
+        return span
+
+    def end_span(self, span: Optional[Span], end: Optional[float] = None, **attrs) -> None:
+        if span is None or span.end is not None:
+            return
+        if attrs:
+            span.attrs.update(attrs)
+        span.end = time.time() if end is None else end
+        if self.metrics is not None:
+            try:
+                self.metrics.observe(SPAN_DURATION_METRIC, span.duration, stage=span.name)
+            except Exception:
+                pass  # a histogram bug must never unwind the traced path
+
+    def record_span(
+        self,
+        name: str,
+        experiment: str,
+        trace_id: str,
+        parent_id: Optional[str],
+        start: float,
+        end: float,
+        **attrs,
+    ) -> Optional[Span]:
+        """Record an already-measured interval (e.g. the suggestion batch
+        window stamped onto every trial of the batch)."""
+        span = self.start_span(
+            name, experiment, trace_id, parent_id, start=start, attrs=attrs
+        )
+        if span is not None:
+            self.end_span(span, end=end)
+        return span
+
+    # -- context-manager API (same-thread nesting) ---------------------------
+
+    def span(
+        self,
+        name: str,
+        experiment: str = "",
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
+        **attrs,
+    ):
+        """``with tracer.span("stage", attr=...)``: nests under the current
+        in-thread span (or under the subprocess-inherited traceparent) unless
+        trace_id/parent_id pin the context explicitly. Near-zero overhead
+        when disabled: a shared no-op context manager is returned."""
+        if not self.enabled:
+            return _NOOP_CM
+        return _SpanCM(self, name, experiment, trace_id, parent_id, attrs)
+
+    # -- trial lifecycle -----------------------------------------------------
+
+    def begin_trial(
+        self, experiment: str, trial: str, start: Optional[float] = None, **attrs
+    ) -> Optional[Span]:
+        """Open (or return the still-open) root span of the trial's trace."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            trace_id = self._trial_traces.get((experiment, trial))
+            root = self._roots.get(trace_id) if trace_id else None
+        if root is not None and root.end is None:
+            return root  # resubmit of an in-flight trace (resume path)
+        trace_id = self.new_trace_id()
+        root = Span(
+            trace_id=trace_id,
+            span_id=self.new_span_id(),
+            parent_id=None,
+            name="trial",
+            start=time.time() if start is None else start,
+            attrs={"experiment": experiment, "trial": trial, **attrs},
+        )
+        self._record(experiment, root)
+        with self._lock:
+            self._trial_traces[(experiment, trial)] = trace_id
+            self._trial_traces.move_to_end((experiment, trial))
+            while len(self._trial_traces) > self.MAX_TRIAL_INDEX:
+                _, old_trace = self._trial_traces.popitem(last=False)
+                self._roots.pop(old_trace, None)
+            self._roots[trace_id] = root
+        return root
+
+    def trial_root(self, experiment: str, trial: str) -> Optional[Span]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            trace_id = self._trial_traces.get((experiment, trial))
+            return self._roots.get(trace_id) if trace_id else None
+
+    def end_trial(self, experiment: str, trial: str, **attrs) -> None:
+        """End the trial's root span (idempotent) and persist the trace."""
+        root = self.trial_root(experiment, trial)
+        if root is None or root.end is not None:
+            return
+        self.end_span(root, **attrs)
+        self._persist(experiment, trial, root.trace_id)
+
+    def begin_gang(
+        self, experiment: str, pack_id: str, trials: Sequence[str]
+    ) -> Optional[GangTrace]:
+        """One gang-level trace per pack: root ``pack`` span with K open
+        ``member:<trial>`` children, each linked to the member's own trial
+        trace via the ``trialTraceId`` attr."""
+        if not self.enabled:
+            return None
+        trace_id = self.new_trace_id()
+        root = Span(
+            trace_id=trace_id,
+            span_id=self.new_span_id(),
+            parent_id=None,
+            name="pack",
+            start=time.time(),
+            attrs={"experiment": experiment, "pack": pack_id, "members": len(trials)},
+        )
+        self._record(experiment, root)
+        members: Dict[str, Span] = {}
+        for name in trials:
+            trial_root = self.trial_root(experiment, name)
+            m = Span(
+                trace_id=trace_id,
+                span_id=self.new_span_id(),
+                parent_id=root.span_id,
+                name=f"member:{name}",
+                start=root.start,
+                attrs={
+                    "trial": name,
+                    "trialTraceId": trial_root.trace_id if trial_root else None,
+                },
+            )
+            self._record(experiment, m)
+            members[name] = m
+        return GangTrace(trace_id=trace_id, root=root, members=members)
+
+    # -- queries / export ----------------------------------------------------
+
+    def trace_spans(self, experiment: str, trace_id: str) -> List[Span]:
+        with self._lock:
+            ring = self._rings.get(experiment, ())
+            return [s for s in ring if s.trace_id == trace_id]
+
+    def trial_trace(self, experiment: str, trial: str) -> Optional[Dict[str, Any]]:
+        """``{"traceId", "experiment", "trial", "spans": [...]}`` from the
+        live ring, falling back to the persisted file; None when unknown."""
+        with self._lock:
+            trace_id = self._trial_traces.get((experiment, trial))
+        if trace_id:
+            spans = self.trace_spans(experiment, trace_id)
+            if spans:
+                return {
+                    "traceId": trace_id,
+                    "experiment": experiment,
+                    "trial": trial,
+                    "spans": [s.to_dict() for s in spans],
+                }
+        return self._load_persisted(experiment, trial)
+
+    def forget(self, experiment: str) -> None:
+        with self._lock:
+            self._rings.pop(experiment, None)
+            for key in [k for k in self._trial_traces if k[0] == experiment]:
+                self._roots.pop(self._trial_traces.pop(key), None)
+
+    # -- persistence ---------------------------------------------------------
+
+    def _trace_path(self, experiment: str, trial: str) -> Optional[str]:
+        if not self.persist_dir:
+            return None
+        bad = any(
+            "/" in n or "\\" in n or ".." in n or "\x00" in n or not n
+            for n in (experiment, trial)
+        )
+        if bad:
+            return None
+        return os.path.join(self.persist_dir, experiment, f"{trial}.json")
+
+    def _persist(self, experiment: str, trial: str, trace_id: str) -> None:
+        path = self._trace_path(experiment, trial)
+        if path is None:
+            return
+        payload = {
+            "traceId": trace_id,
+            "experiment": experiment,
+            "trial": trial,
+            "spans": [s.to_dict() for s in self.trace_spans(experiment, trace_id)],
+        }
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            logging.getLogger("katib_tpu.tracing").warning(
+                "failed to persist trace for %s/%s", experiment, trial, exc_info=True
+            )
+
+    def _load_persisted(self, experiment: str, trial: str) -> Optional[Dict[str, Any]]:
+        path = self._trace_path(experiment, trial)
+        if path is None or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+class _SpanCM:
+    """Context manager returned by Tracer.span when enabled."""
+
+    __slots__ = ("_tracer", "_name", "_experiment", "_trace_id", "_parent_id", "_attrs", "_span", "_token")
+
+    def __init__(self, tracer, name, experiment, trace_id, parent_id, attrs):
+        self._tracer = tracer
+        self._name = name
+        self._experiment = experiment
+        self._trace_id = trace_id
+        self._parent_id = parent_id
+        self._attrs = attrs
+        self._span = None
+        self._token = None
+
+    def __enter__(self) -> Span:
+        trace_id, parent_id = self._trace_id, self._parent_id
+        if trace_id is None:
+            parent = _current_span.get()
+            if parent is not None:
+                trace_id, parent_id = parent.trace_id, parent.span_id
+            else:
+                inherited = parse_traceparent(os.environ.get(ENV_TRACEPARENT))
+                if inherited is not None:
+                    trace_id, parent_id = inherited
+                else:
+                    trace_id = Tracer.new_trace_id()
+        self._span = self._tracer.start_span(
+            self._name, self._experiment, trace_id, parent_id, attrs=self._attrs
+        )
+        self._token = _current_span.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        _current_span.reset(self._token)
+        self._tracer.end_span(
+            self._span, **({"error": exc_type.__name__} if exc_type else {})
+        )
+        return False
+
+
+# -- process-global tracer (subprocess trials, RPC services) -----------------
+
+_default_tracer: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def default_tracer() -> Tracer:
+    """Lazily-created process tracer for code with no controller handle:
+    subprocess trials that inherited $KATIB_TPU_TRACEPARENT, and the gRPC
+    service side of the ReportObservationLog rejoin."""
+    global _default_tracer
+    with _default_lock:
+        if _default_tracer is None:
+            _default_tracer = Tracer(enabled=tracing_enabled_from_env())
+        return _default_tracer
+
+
+def record_env_report(n_metrics: int) -> Optional[Span]:
+    """Rejoin point for the report_metrics env binding: a subprocess trial's
+    push lands a ``report_metrics`` span in the child's tracer carrying the
+    controller-issued trace/parent ids, so merged views form one tree."""
+    ctx = parse_traceparent(os.environ.get(ENV_TRACEPARENT))
+    if ctx is None:
+        return None
+    tracer = default_tracer()
+    if not tracer.enabled:
+        return None
+    trace_id, parent_id = ctx
+    experiment = os.environ.get("KATIB_TPU_EXPERIMENT", "") or "_remote"
+    span = tracer.start_span(
+        "report_metrics", experiment, trace_id, parent_id,
+        attrs={"metrics": int(n_metrics)},
+    )
+    tracer.end_span(span)
+    return span
+
+
+# -- structured logging ------------------------------------------------------
+
+_log_ctx: ContextVar[Optional[Dict[str, str]]] = ContextVar(
+    "katib_tpu_log_ctx", default=None
+)
+
+
+def push_log_context(**fields: str):
+    """Stamp experiment=/trial=/trace_id= onto subsequent log lines of this
+    thread (loggers wired via install_log_context). Returns a token for
+    pop_log_context."""
+    merged = dict(_log_ctx.get() or {})
+    merged.update({k: v for k, v in fields.items() if v})
+    return _log_ctx.set(merged)
+
+
+def pop_log_context(token) -> None:
+    _log_ctx.reset(token)
+
+
+@contextlib.contextmanager
+def log_context(**fields: str):
+    token = push_log_context(**fields)
+    try:
+        yield
+    finally:
+        pop_log_context(token)
+
+
+class TraceContextFilter(logging.Filter):
+    """Appends the ambient trial context to log lines —
+    ``... [experiment=e trial=t trace_id=abc]`` — so concurrent trials'
+    interleaved controller/runtime logs are attributable."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _log_ctx.get()
+        if ctx:
+            suffix = " ".join(f"{k}={v}" for k, v in ctx.items())
+            record.msg = f"{record.msg} [{suffix}]"
+        return True
+
+
+_installed_loggers: set = set()
+
+LOGGERS = (
+    "katib_tpu.scheduler",
+    "katib_tpu.executor",
+    "katib_tpu.experiment",
+)
+
+
+def install_log_context(*names: str) -> None:
+    """Idempotently wire the context filter into the named loggers (default:
+    scheduler + executor + experiment)."""
+    for name in names or LOGGERS:
+        if name in _installed_loggers:
+            continue
+        _installed_loggers.add(name)
+        logging.getLogger(name).addFilter(TraceContextFilter())
+
+
+# -- export: span tree + Perfetto --------------------------------------------
+
+def build_tree(spans: Sequence[Span]):
+    """(roots, children) with children keyed by span_id, both in start
+    order; spans whose parent is absent from the set are treated as roots."""
+    by_id = {s.span_id: s for s in spans}
+    children: Dict[str, List[Span]] = {}
+    roots: List[Span] = []
+    for s in sorted(spans, key=lambda s: s.start):
+        if s.parent_id and s.parent_id in by_id:
+            children.setdefault(s.parent_id, []).append(s)
+        else:
+            roots.append(s)
+    return roots, children
+
+
+def render_tree(spans: Sequence[Span]) -> str:
+    """Indented span tree with durations and % of the trial wall-clock —
+    the ``katib-tpu trace`` CLI view."""
+    if not spans:
+        return "(no spans)"
+    roots, children = build_tree(spans)
+    total = max((r.duration for r in roots), default=0.0) or 1e-9
+    width = max(len(s.name) for s in spans) + 2
+    lines: List[str] = []
+
+    def _walk(span: Span, depth: int) -> None:
+        pct = span.duration / total * 100.0
+        label = ("  " * depth + span.name).ljust(width + depth * 2)
+        note = "" if span.ended else "  (open)"
+        keys = {
+            k: v
+            for k, v in span.attrs.items()
+            if k not in ("experiment", "trial") and v not in (None, "")
+        }
+        attrs = f"  {keys}" if keys else ""
+        lines.append(f"{label}{span.duration:>9.3f}s  {pct:>5.1f}%{note}{attrs}")
+        for child in children.get(span.span_id, []):
+            _walk(child, depth + 1)
+
+    for root in roots:
+        _walk(root, 0)
+    return "\n".join(lines)
+
+
+def to_perfetto(spans: Sequence[Span], trace_name: str = "katib-tpu") -> Dict[str, Any]:
+    """Chrome ``trace_event`` JSON (the Trace Event Format consumed by
+    ui.perfetto.dev and chrome://tracing): complete ``X`` events in
+    microseconds, with sibling spans that overlap in time pushed onto
+    separate ``tid`` lanes so nesting stays well-formed."""
+    now = time.time()
+    roots, children = build_tree(spans)
+    events: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": trace_name},
+        }
+    ]
+    lanes: Dict[int, List[Tuple[float, float]]] = {}  # tid -> placed intervals
+
+    def _fits(tid: int, start: float, end: float) -> bool:
+        for s0, e0 in lanes.get(tid, ()):
+            disjoint = end <= s0 or start >= e0
+            contains = s0 <= start and end <= e0
+            contained = start <= s0 and e0 <= end
+            if not (disjoint or contains or contained):
+                return False
+        return True
+
+    def _place(span: Span, parent_tid: int) -> None:
+        start = span.start
+        end = span.end if span.end is not None else now
+        tid = parent_tid
+        while not _fits(tid, start, end):
+            tid += 1
+        lanes.setdefault(tid, []).append((start, end))
+        events.append(
+            {
+                "name": span.name,
+                "cat": "trial",
+                "ph": "X",
+                "ts": span.start * 1e6,
+                "dur": max(end - start, 0.0) * 1e6,
+                "pid": 1,
+                "tid": tid,
+                "args": {
+                    "traceId": span.trace_id,
+                    "spanId": span.span_id,
+                    **{k: v for k, v in span.attrs.items() if v is not None},
+                },
+            }
+        )
+        for child in children.get(span.span_id, []):
+            _place(child, tid)
+
+    for root in roots:
+        _place(root, 1)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -15,6 +15,13 @@ same information surface:
   GET /api/experiments/<name>/trials/<t>/logs   trial stdout (fetch_trial_logs)
   GET /api/experiments/<name>/trials/<t>/profile  xplane profiler artifacts
   GET /api/experiments/<name>/events            event stream (K8s Events parity)
+  GET /api/events                               cross-experiment events
+                                                (?warning=1 filters to
+                                                warnings, ?limit= tails)
+  GET /api/experiments/<e>/trials/<t>/trace     trial lifecycle trace (JSON
+                                                spans; ?format=perfetto emits
+                                                Chrome trace_event JSON for
+                                                ui.perfetto.dev)
   GET /api/experiments/<name>/suggestion        suggestion state
   GET /api/trials/<name>/metrics                raw observation log
   GET /api/algorithms                           registered algorithms
@@ -639,6 +646,21 @@ class _Handler(BaseHTTPRequestHandler):
                 # trials with priority / wait / deficit, running units, and
                 # the device pool — the operator's starvation debugger
                 return self._send(ctrl.scheduler.queue_state())
+            if path == "/api/events":
+                # cross-experiment event view: queue stalls, preemptions and
+                # flusher errors are queryable without knowing the experiment
+                q = parse_qs(urlparse(self.path).query)
+                warning_only = q.get("warning", ["0"])[0] in ("1", "true")
+                limit = q.get("limit", [None])[0]
+                n = int(limit) if limit is not None and limit.isdigit() else None
+                return self._send(
+                    [
+                        e.to_dict()
+                        for e in ctrl.events.list_all(
+                            limit=n, warning_only=warning_only
+                        )
+                    ]
+                )
             if path == "/api/algorithms":
                 from ..earlystop.medianstop import registered_early_stoppers
                 from ..suggest.base import registered_algorithms
@@ -697,6 +719,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._trial_logs(name, parts[5])
                 if sub == "trials" and len(parts) == 7 and parts[6] == "profile":
                     return self._trial_profile(name, parts[5])
+                if sub == "trials" and len(parts) == 7 and parts[6] == "trace":
+                    return self._trial_trace(name, parts[5])
                 if sub == "trials" and len(parts) == 6:
                     # full single-trial object (trial-details page backend):
                     # assignments, condition history, observation, times —
@@ -840,6 +864,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _trial_trace(self, exp_name: str, trial_name: str) -> None:
+        """Serve one trial's lifecycle trace: JSON spans by default, Chrome
+        trace_event JSON (openable in ui.perfetto.dev) with
+        ``?format=perfetto`` (katib_tpu.tracing)."""
+        from ..tracing import Span, to_perfetto
+
+        tracer = getattr(self.controller, "tracer", None)
+        trace = tracer.trial_trace(exp_name, trial_name) if tracer else None
+        if trace is None:
+            return self._send(
+                {"error": f"no trace for trial {trial_name!r} "
+                          "(tracing disabled, or trial unknown)"},
+                code=404,
+            )
+        fmt = parse_qs(urlparse(self.path).query).get("format", ["json"])[0]
+        if fmt == "perfetto":
+            spans = [Span.from_dict(s) for s in trace.get("spans", [])]
+            return self._send(
+                to_perfetto(spans, trace_name=f"katib-tpu {exp_name}/{trial_name}")
+            )
+        return self._send(trace)
 
     def _trial_profile(self, exp_name: str, trial_name: str) -> None:
         """List captured xplane profiler artifacts for a trial (SURVEY §5

@@ -264,6 +264,52 @@ class TestUIServer:
         algos = json.loads(body)
         assert "tpe" in algos["suggestion"] and "medianstop" in algos["earlyStopping"]
 
+    @pytest.mark.smoke
+    def test_global_events_endpoint(self, stack):
+        """/api/events: cross-experiment events without naming an
+        experiment; ?warning=1 filters to warnings (queue stalls,
+        preemptions, flusher errors); ?limit= tails."""
+        base, ctrl, _ = stack
+        ctrl.events.event(
+            "ghost-exp", "Trial", "g-1", "TrialQueueStalled",
+            "pending 300s", warning=True,
+        )
+        _, _, body = get(f"{base}/api/events")
+        events = json.loads(body)
+        assert any(e["reason"] == "ExperimentCreated" for e in events)
+        assert any(e["experiment"] == "ghost-exp" for e in events)
+        _, _, body = get(f"{base}/api/events?warning=1")
+        warnings = json.loads(body)
+        assert warnings and all(e["type"] == "Warning" for e in warnings)
+        assert any(e["reason"] == "TrialQueueStalled" for e in warnings)
+        _, _, body = get(f"{base}/api/events?limit=1")
+        assert len(json.loads(body)) == 1
+
+    @pytest.mark.smoke
+    def test_trial_trace_endpoint_and_perfetto(self, stack):
+        """GET .../trials/<t>/trace serves the lifecycle spans; the
+        ?format=perfetto variant emits Chrome trace_event JSON."""
+        base, ctrl, _ = stack
+        trial = ctrl.state.list_trials("ui-exp")[0]
+        status, ctype, body = get(
+            f"{base}/api/experiments/ui-exp/trials/{trial.name}/trace"
+        )
+        assert status == 200 and "json" in ctype
+        trace = json.loads(body)
+        assert trace["trial"] == trial.name and trace["traceId"]
+        names = {s["name"] for s in trace["spans"]}
+        assert {"trial", "queue_wait", "run", "execute"} <= names
+        assert all(s["end"] is not None for s in trace["spans"])
+        _, _, body = get(
+            f"{base}/api/experiments/ui-exp/trials/{trial.name}/trace?format=perfetto"
+        )
+        doc = json.loads(body)
+        assert doc["traceEvents"]
+        assert any(e.get("ph") == "X" and e["name"] == "trial" for e in doc["traceEvents"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{base}/api/experiments/ui-exp/trials/no-such/trace")
+        assert e.value.code == 404
+
 
 class TestConfig:
     def test_load_roundtrip(self, tmp_path):
@@ -287,6 +333,15 @@ class TestConfig:
         monkeypatch.setenv("KATIB_TPU_OBSLOG_BACKEND", "native")
         cfg = load_config(None)
         assert cfg.runtime.obslog_backend == "native"
+
+    def test_tracing_env_override(self, monkeypatch):
+        from katib_tpu.config import load_config
+
+        assert load_config(None).runtime.tracing is True  # default on
+        monkeypatch.setenv("KATIB_TPU_TRACING", "0")
+        assert load_config(None).runtime.tracing is False
+        monkeypatch.setenv("KATIB_TPU_TRACING", "1")
+        assert load_config(None).runtime.tracing is True
 
 
 class TestUIWriteEndpoints:
