@@ -734,6 +734,110 @@ def _bench_tracing_overhead(smoke: bool = False):
     }
 
 
+def _bench_telemetry_overhead(smoke: bool = False):
+    """Resource telemetry (katib_tpu/telemetry.py): end-to-end trials/sec of
+    an in-process experiment with ``runtime.telemetry`` on vs off. The
+    target is <2% overhead when on (the per-report cost is one heartbeat
+    dict store; the sampler itself ticks on its own thread) and ~0% when off
+    (off IS the KATIB_TPU_TELEMETRY=0 path: every call site reduces to one
+    boolean check). The on side runs the sampler at a 50ms interval — ~100x
+    the production rate — so the measurement actually contains sampling
+    work rather than an idle thread. Interleaved on/off passes, each side's
+    best kept, same noise-shedding shape as tracing_overhead. ``smoke``
+    trims the trial count for the tier-1 wiring test."""
+    from katib_tpu.api.spec import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
+    )
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+
+    n_trials = 12 if smoke else int(os.environ.get("BENCH_TELEMETRY_TRIALS", "64"))
+    reports = 20 if smoke else 100     # report() is the hottest heartbeat site
+    work = 200 if smoke else 20000     # busy-work per step (see tracing bench:
+    # an empty trial loop measures thread-scheduling noise, not telemetry)
+
+    def trial_fn(assignments, ctx):
+        x = float(assignments.get("x", "0.5"))
+        for i in range(reports):
+            acc = 0
+            for j in range(work):
+                acc += j & 7
+            x = x * 0.999 + 1e-9 * acc
+            ctx.report(score=x)
+
+    counter = {"n": 0}
+
+    def run_once(telemetry_on: bool) -> float:
+        counter["n"] += 1
+        cfg = KatibConfig()
+        cfg.runtime.telemetry = telemetry_on
+        cfg.runtime.telemetry_interval_seconds = 0.05  # stress rate, see above
+        cfg.runtime.tracing = False       # isolate telemetry cost
+        cfg.runtime.obslog_buffered = False
+        ctrl = ExperimentController(
+            root_dir=None, devices=list(range(8)), persist=False, config=cfg
+        )
+        name = f"telemetry-bench-{counter['n']}"
+        spec = ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec(
+                    "x", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="1.0")
+                )
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=trial_fn),
+            max_trial_count=n_trials,
+            parallel_trial_count=8,
+        )
+        try:
+            ctrl.create_experiment(spec)
+            t0 = time.perf_counter()
+            exp = ctrl.run(name, timeout=300)
+            dt = time.perf_counter() - t0
+            assert exp.status.trials_succeeded == n_trials, (
+                f"{exp.status.trials_succeeded}/{n_trials} succeeded"
+            )
+            if telemetry_on:
+                assert ctrl.telemetry.enabled
+                if not smoke:
+                    # the sampler really ran: the samples counter advanced
+                    # (smoke passes can finish inside one 50ms tick)
+                    assert "katib_telemetry_samples_total" in ctrl.metrics.render()
+            else:
+                assert not ctrl.telemetry.enabled
+            return dt
+        finally:
+            ctrl.close()
+
+    run_once(False)  # warmup: import + state costs off the timed passes
+    passes = 2 if smoke else 3
+    on_s, off_s = [], []
+    for _ in range(passes):
+        off_s.append(run_once(False))
+        on_s.append(run_once(True))
+    on, off = min(on_s), min(off_s)
+    overhead_pct = (on - off) / off * 100.0
+    return {
+        "trials": n_trials,
+        "reports_per_trial": reports,
+        "sampler_interval_s": 0.05,
+        "passes": passes,
+        "off_s": round(off, 4),
+        "on_s": round(on, 4),
+        "off_trials_per_s": round(n_trials / off, 1),
+        "on_trials_per_s": round(n_trials / on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "target_pct": 2.0,
+        "within_target": overhead_pct < 2.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1684,6 +1788,7 @@ OBSLOG_SCENARIOS = {
     "obslog_report_throughput": _bench_obslog_report_throughput,
     "obslog_fold_latency": _bench_obslog_fold_latency,
     "tracing_overhead": _bench_tracing_overhead,
+    "telemetry_overhead": _bench_telemetry_overhead,
 }
 
 

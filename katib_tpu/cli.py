@@ -18,6 +18,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            % of trial wall-clock (--url asks a live
                            controller; else the persisted trace under
                            <root>/traces/)
+  top                      per-trial resource table (RSS / CPU / HBM / time
+                           since last report; --url asks a live controller's
+                           /api/telemetry, --watch refreshes; else renders
+                           the persisted series under <root>/telemetry/)
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
   ui                       serve the web dashboard + REST API
@@ -280,6 +284,56 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Per-trial resource table (ISSUE 5 tentpole): RSS / CPU / HBM / time
+    since the last metric report, plus the device pool and XLA cache. Live
+    from a running controller's /api/telemetry when --url is given (add
+    --watch to refresh); otherwise reconstructed from the series persisted
+    under <root>/telemetry/ (last sample + peaks per finished trial)."""
+    import os
+    import time as _time
+
+    from .telemetry import fmt_bytes, snapshot_from_persisted, top_rows
+
+    def fetch():
+        if args.url:
+            import urllib.request
+
+            with urllib.request.urlopen(args.url.rstrip("/") + "/api/telemetry") as r:
+                return json.loads(r.read().decode())
+        return snapshot_from_persisted(os.path.join(args.root, "telemetry"))
+
+    while True:
+        snap = fetch()
+        devices = snap.get("devices") or []
+        if devices:
+            used = sum(d.get("bytesInUse") or 0 for d in devices)
+            print(f"devices:   {len(devices)} | HBM in use {fmt_bytes(used)}")
+        cache = snap.get("xlaCache") or {}
+        if cache.get("entries"):
+            print(
+                f"xla-cache: {cache['entries']} entries, "
+                f"{fmt_bytes(cache.get('bytes', 0))}"
+            )
+        rows = top_rows(snap)
+        _table(
+            ["TRIAL", "EXPERIMENT", "RSS", "CPU", "HBM", "LAST-REPORT", "STATE"],
+            rows,
+        )
+        if not rows:
+            print(
+                "(no telemetry; point --root at a controller state dir with "
+                "telemetry/, or --url at a running 'katib-tpu ui' server)"
+            )
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -433,6 +487,24 @@ def main(argv=None) -> int:
         "trace (else reads the persisted trace under <root>/traces/)",
     )
     tc.set_defaults(fn=cmd_trace)
+
+    tp = sub.add_parser(
+        "top",
+        help="per-trial resource table (RSS / CPU / HBM / last-report age)",
+    )
+    tp.add_argument(
+        "--url",
+        default=None,
+        help="base URL of a running 'katib-tpu ui' server for the live "
+        "/api/telemetry view (else reads persisted series under "
+        "<root>/telemetry/)",
+    )
+    tp.add_argument(
+        "--watch", action="store_true",
+        help="refresh the table every --interval seconds until interrupted",
+    )
+    tp.add_argument("--interval", type=float, default=5.0)
+    tp.set_defaults(fn=cmd_top)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")
     me.add_argument("trial")

@@ -52,6 +52,12 @@ class RuntimeConfig:
     obslog_buffer_rows: int = 8192         # backpressure bound (buffered rows)
     tracing: bool = True                   # trial lifecycle spans (tracing.py)
     trace_ring_spans: int = 4096           # per-experiment span ring bound
+    # per-trial resource telemetry + health watchdog (telemetry.py)
+    telemetry: bool = True
+    telemetry_interval_seconds: float = 5.0
+    telemetry_ring_samples: int = 720      # per-trial sample ring bound (~1h at 5s)
+    stall_seconds: float = 120.0           # TrialStalled heartbeat threshold
+    oom_risk_fraction: float = 0.9         # TrialOOMRisk host-memory fraction
     xla_cache_dir: Optional[str] = None
     devices_per_host: Optional[int] = None  # cap devices visible to the allocator
     metrics_poll_interval: float = 0.1
@@ -125,4 +131,7 @@ def load_config(path: Optional[str] = None) -> KatibConfig:
     env_tracing = os.environ.get("KATIB_TPU_TRACING")
     if env_tracing:
         cfg.runtime.tracing = env_tracing.lower() not in ("0", "false", "off")
+    env_telemetry = os.environ.get("KATIB_TPU_TELEMETRY")
+    if env_telemetry:
+        cfg.runtime.telemetry = env_telemetry.lower() not in ("0", "false", "off")
     return cfg

@@ -139,8 +139,8 @@ class MetricsRegistry:
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Histogram] = {}
         self._help: Dict[str, str] = {}
-        self._collector = None  # per-scrape gauge recompute hook
-        self._collector_names: Tuple[str, ...] = ()
+        # per-scrape gauge recompute hooks: [(fn, owned gauge names), ...]
+        self._collectors: List[Tuple[object, Tuple[str, ...]]] = []
         self._collector_error_logged = False
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
@@ -192,31 +192,47 @@ class MetricsRegistry:
         the collector owns. render() swaps every series of the owned names in
         ONE lock acquisition, so a concurrent scrape never observes a
         cleared-but-not-yet-repopulated registry, and owned series vanish
-        when the collector returns none for them (deleted experiments)."""
-        self._collector = fn
-        self._collector_names = tuple(names)
+        when the collector returns none for them (deleted experiments).
+
+        Legacy single-collector surface: REPLACES every registered hook.
+        Subsystems sharing one registry (controller status gauges + the
+        telemetry sampler) use :meth:`add_collector` instead."""
+        self._collectors = [(fn, tuple(names))]
+
+    def add_collector(self, fn, names: Tuple[str, ...] = ()) -> None:
+        """Append a collector hook (same contract as set_collector); each
+        hook owns a disjoint set of gauge names."""
+        self._collectors.append((fn, tuple(names)))
 
     def render(self) -> str:
         """Prometheus text exposition format."""
-        if self._collector is not None:
-            try:
-                collected = self._collector()
-            except Exception:
-                # a scrape must not fail because state was mid-mutation —
-                # but a persistent collector bug must not be silent either
-                if not self._collector_error_logged:
-                    self._collector_error_logged = True
-                    logging.getLogger("katib_tpu.metrics").exception(
-                        "gauge collector failed; current-state gauges frozen "
-                        "(logged once)"
-                    )
-                collected = None
-            if collected is not None:
-                names = set(self._collector_names) | {key[0] for key in collected}
+        if self._collectors:
+            merged: Dict = {}
+            names: set = set()
+            for fn, owned in list(self._collectors):
+                try:
+                    collected = fn()
+                except Exception:
+                    # a scrape must not fail because state was mid-mutation —
+                    # but a persistent collector bug must not be silent
+                    # either; a failing hook's owned gauges stay frozen
+                    # while the other hooks keep collecting
+                    if not self._collector_error_logged:
+                        self._collector_error_logged = True
+                        logging.getLogger("katib_tpu.metrics").exception(
+                            "gauge collector failed; its current-state gauges "
+                            "frozen (logged once)"
+                        )
+                    continue
+                if collected is None:
+                    continue
+                merged.update(collected)
+                names |= set(owned) | {key[0] for key in collected}
+            if names or merged:
                 with self._lock:
                     for key in [k for k in self._gauges if k[0] in names]:
                         del self._gauges[key]
-                    self._gauges.update(collected)
+                    self._gauges.update(merged)
         lines: List[str] = []
         # O(1) dedup of the per-name metadata lines — the old
         # `lines.append(...) if ... not in lines else None` idiom was an
@@ -296,6 +312,17 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_obslog_flush_latency_seconds": "Latency of the last buffered-store flush.",
     "katib_obslog_buffered_rows": "Rows currently buffered in the write-behind store.",
     "katib_span_duration_seconds": "Trial lifecycle stage durations from tracing spans, by stage.",
+    # resource telemetry + health watchdog (katib_tpu/telemetry.py) — the
+    # TrialStalled / TrialOOMRisk warning events pair with these counters
+    # and show in GET /api/events?warning=1
+    "katib_telemetry_samples_total": "Per-trial resource samples recorded by the telemetry sampler.",
+    "katib_trial_stalled_total": "Trials flagged by the watchdog: no report heartbeat past runtime.stall_seconds.",
+    "katib_trial_oom_risk_total": "Trials whose monotonic RSS growth crossed the OOM-risk fraction of host memory.",
+    "katib_trial_host_rss_bytes": "Latest sampled host RSS per running trial (/proc; in-process trials share the controller process).",
+    "katib_trial_cpu_percent": "Latest sampled CPU utilization per running trial (percent of one core).",
+    "katib_device_hbm_used_bytes": "Accelerator memory in use per local device (jax memory_stats).",
+    "katib_xla_cache_entries": "Entries in the persistent XLA compilation cache.",
+    "katib_xla_cache_bytes": "Total size of the persistent XLA compilation cache.",
 }
 
 

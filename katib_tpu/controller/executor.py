@@ -292,6 +292,7 @@ class SubprocessExecutor:
         env[ENV_TRIAL_NAME] = trial.name
         if self.db_path:
             env[ENV_DB_PATH] = self.db_path
+        self._stamp_profile_env(env)
         if ctx.trace_id and ctx.trace_parent:
             # W3C-traceparent-style context: the child's report_metrics spans
             # rejoin this trial's controller trace (katib_tpu.tracing)
@@ -325,8 +326,12 @@ class SubprocessExecutor:
                 cwd=spec.trial_template.working_dir or workdir,
                 start_new_session=True,
             )
+            if ctx.on_subprocess is not None:
+                # telemetry: /proc sampling follows the child, not this process
+                ctx.on_subprocess([proc.pid])
             outcome = self._wait(
-                proc, stdout_path, metrics_file, monitor, spec, handle, prom_logs
+                proc, stdout_path, metrics_file, monitor, spec, handle, prom_logs,
+                heartbeat=ctx.on_report,
             )
         if prom_logs:
             self.obs_store.report_observation_log(trial.name, prom_logs)
@@ -346,12 +351,32 @@ class SubprocessExecutor:
             return ExecutionResult(
                 TrialOutcome.COMPLETED, exit_code=0, stdout_path=stdout_path
             )
+        from ..telemetry import OOM_KILL_MESSAGE, oom_kill_suspected
+
+        # an uninstructed SIGKILL death (the kill path returned above, so
+        # nobody in THIS controller sent it) is the kernel OOM killer's
+        # signature — classify it instead of reporting a bare exit code
+        message = (
+            OOM_KILL_MESSAGE
+            if oom_kill_suspected(proc.returncode)
+            else f"process exited with code {proc.returncode}"
+        )
         return ExecutionResult(
             TrialOutcome.FAILED,
-            f"process exited with code {proc.returncode}",
+            message,
             exit_code=proc.returncode,
             stdout_path=stdout_path,
         )
+
+    @staticmethod
+    def _stamp_profile_env(env: Dict[str, str]) -> None:
+        """Honor $KATIB_TPU_PROFILE end-to-end: the controller's setting is
+        stamped onto trial subprocesses (unless the trial template pinned its
+        own), and ctx.profile()/profile_trace default from it."""
+        from ..runtime.profiling import ENV_PROFILE
+
+        if ENV_PROFILE in os.environ:
+            env.setdefault(ENV_PROFILE, os.environ[ENV_PROFILE])
 
     SCRAPE_INTERVAL = 1.0  # seconds between Prometheus scrapes
     # A metric legitimately reporting the SAME value across steps must still
@@ -410,12 +435,16 @@ class SubprocessExecutor:
         spec: ExperimentSpec,
         handle: TrialExecution,
         prom_logs: Optional[List[MetricLog]] = None,
+        heartbeat: Optional[Callable[[], None]] = None,
     ) -> Optional[ExecutionResult]:
         """Poll for exit; tail output applying stop rules (the reference
         sidecar's watchMetricsFile loop); scrape the trial's Prometheus
         endpoint when the collector kind asks for it. The poll interval
         adapts: 0.1s while the trial emits output/metrics, backing off
-        exponentially to 1s after 30s of quiet (see _AdaptivePoll)."""
+        exponentially to 1s after 30s of quiet (see _AdaptivePoll).
+        ``heartbeat`` is the telemetry watchdog's liveness hook — a
+        subprocess trial can't call ctx.report(), so tailed metric lines
+        and fresh scrape rows count as its heartbeats instead."""
         watch_path = metrics_file or stdout_path
         scrape = (
             spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
@@ -438,6 +467,8 @@ class SubprocessExecutor:
                     stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
                     if len(prom_logs) > before:
                         poll.activity()
+                        if heartbeat is not None:
+                            heartbeat()
                     if stopped is not None:
                         self._terminate(proc)
                         return stopped
@@ -445,6 +476,8 @@ class SubprocessExecutor:
                     parsed = tailer.poll()
                     if parsed:
                         poll.activity()
+                        if heartbeat is not None:
+                            heartbeat()
                     for name, raw, _idx in parsed:
                         try:
                             value = float(raw)
@@ -697,6 +730,7 @@ class MultiHostExecutor(SubprocessExecutor):
         ).rstrip(os.pathsep)
         base_env[ENV_TRIAL_NAME] = trial.name
         base_env["KATIB_TPU_EXPERIMENT"] = trial.experiment_name
+        self._stamp_profile_env(base_env)
         if ctx.trace_id and ctx.trace_parent:
             from ..tracing import ENV_TRACEPARENT, format_traceparent
 
@@ -772,8 +806,13 @@ class MultiHostExecutor(SubprocessExecutor):
                             start_new_session=True,
                         )
                     )
+                if ctx.on_subprocess is not None:
+                    # telemetry samples the WHOLE gang: RSS is summed across
+                    # the worker processes, vanished pids are skipped
+                    ctx.on_subprocess([p.pid for p in procs])
                 outcome = self._wait_gang(
-                    procs, stdout0, metrics_file, monitor, spec, handle, prom_logs
+                    procs, stdout0, metrics_file, monitor, spec, handle, prom_logs,
+                    heartbeat=ctx.on_report,
                 )
             except BaseException:
                 # spawn or wait blew up: never orphan already-started workers
@@ -852,9 +891,11 @@ class MultiHostExecutor(SubprocessExecutor):
         spec: ExperimentSpec,
         handle: TrialExecution,
         prom_logs: List[MetricLog],
+        heartbeat: Optional[Callable[[], None]] = None,
     ) -> Optional[ExecutionResult]:
         """Poll the gang; returns None only when EVERY worker exited 0.
-        Same adaptive backoff as the single-process wait loop."""
+        Same adaptive backoff (and telemetry heartbeat contract) as the
+        single-process wait loop."""
         watch_path = metrics_file or stdout_path
         scrape = (
             spec.metrics_collector_spec.collector_kind == CollectorKind.PROMETHEUS
@@ -873,11 +914,22 @@ class MultiHostExecutor(SubprocessExecutor):
                 # deterministic gang failure: first worker death kills the rest
                 for i, rc in enumerate(rcs):
                     if rc is not None and rc != 0:
+                        from ..telemetry import oom_kill_suspected
+
                         self._terminate_gang(procs)
+                        msg = (
+                            f"worker {i}/{len(procs)} exited with code {rc}; "
+                            "gang killed"
+                        )
+                        if oom_kill_suspected(rc):
+                            msg += (
+                                " (SIGKILL death — likely OOM-killed by the "
+                                "kernel; see the trial's telemetry for the "
+                                "RSS ramp)"
+                            )
                         return ExecutionResult(
                             TrialOutcome.FAILED,
-                            f"worker {i}/{len(procs)} exited with code {rc}; "
-                            "gang killed",
+                            msg,
                             exit_code=rc,  # the FAILING worker's code
                         )
                 if scrape and time.time() - last_scrape >= self.SCRAPE_INTERVAL:
@@ -886,6 +938,8 @@ class MultiHostExecutor(SubprocessExecutor):
                     stopped = self._scrape_prometheus(spec, prom_logs, monitor, last_scraped)
                     if len(prom_logs) > before:
                         poll.activity()
+                        if heartbeat is not None:
+                            heartbeat()
                     if stopped is not None:
                         self._terminate_gang(procs)
                         return stopped
@@ -893,6 +947,8 @@ class MultiHostExecutor(SubprocessExecutor):
                     parsed = tailer.poll()
                     if parsed:
                         poll.activity()
+                        if heartbeat is not None:
+                            heartbeat()
                     for name, raw, _idx in parsed:
                         try:
                             value = float(raw)

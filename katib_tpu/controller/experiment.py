@@ -102,8 +102,23 @@ class ExperimentController:
             ring_size=rt.trace_ring_spans,
             persist_dir=os.path.join(root_dir, "traces") if root_dir else None,
         )
+        from ..telemetry import ResourceSampler
+
+        self.telemetry = ResourceSampler(
+            enabled=rt.telemetry,
+            interval=rt.telemetry_interval_seconds,
+            metrics=self.metrics,
+            events=self.events,
+            persist_dir=os.path.join(root_dir, "telemetry") if root_dir else None,
+            stall_seconds=rt.stall_seconds,
+            oom_risk_fraction=rt.oom_risk_fraction,
+            ring_size=rt.telemetry_ring_samples,
+        )
+        self.telemetry.start()
         self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
-        self.metrics.set_collector(
+        # add_collector, not set_collector: the telemetry sampler registered
+        # its own gauge hook on the same registry
+        self.metrics.add_collector(
             self._collect_current_gauges,
             names=("katib_experiments_current", "katib_trials_current"),
         )
@@ -126,6 +141,7 @@ class ExperimentController:
             aging_seconds=rt.fairshare_aging_seconds,
             preemption_grace_seconds=rt.preemption_grace_seconds,
             tracer=self.tracer,
+            telemetry=self.telemetry,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -461,4 +477,5 @@ class ExperimentController:
         self._closed.set()  # unhooks run() loops (incl. UI run-threads)
         self.scheduler.kill_all()
         self.scheduler.join(timeout=10)
+        self.telemetry.stop()
         self.obs_store.close()

@@ -102,6 +102,7 @@ class TrialScheduler:
         aging_seconds: float = 60.0,
         preemption_grace_seconds: float = 30.0,
         tracer=None,
+        telemetry=None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -110,6 +111,7 @@ class TrialScheduler:
         self.recorder = events
         self.metrics_registry = metrics
         self.tracer = tracer  # katib_tpu.tracing.Tracer (None = no tracing)
+        self.telemetry = telemetry  # telemetry.ResourceSampler (None = off)
         self._queue_spans: Dict[str, Any] = {}  # trial -> open queue_wait span
         if devices is None:
             devices = list(range(8))  # abstract slots when JAX not involved
@@ -165,6 +167,12 @@ class TrialScheduler:
         """The active tracer, or None when tracing is off — every
         instrumentation site guards on this one cheap check."""
         t = self.tracer
+        return t if (t is not None and t.enabled) else None
+
+    def _tm(self):
+        """The active resource sampler, or None when telemetry is off —
+        same one-boolean-check contract as _tr()."""
+        t = self.telemetry
         return t if (t is not None and t.enabled) else None
 
     def _trace_end_trial(self, exp_name: str, trial: Trial) -> None:
@@ -704,6 +712,7 @@ class TrialScheduler:
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         tr = self._tr()
+        tm = self._tm()
         root = tr.trial_root(exp.name, trial.name) if tr is not None else None
         run_span = exec_span = None
         if root is not None:
@@ -711,6 +720,11 @@ class TrialScheduler:
                 "run", exp.name, root.trace_id, root.span_id,
                 attrs={"devices": len(devices)},
             )
+        if tm is not None:
+            # resource sampling for this run stint (telemetry.py): starts as
+            # in-process attribution; the executor re-points it at the child
+            # pids via ctx.on_subprocess when the trial forks
+            tm.register_trial(exp.name, trial.name)
         log_token = push_log_context(
             experiment=exp.name, trial=trial.name,
             trace_id=root.trace_id if root is not None else "",
@@ -802,6 +816,10 @@ class TrialScheduler:
         finally:
             if timer is not None:
                 timer.cancel()
+            if tm is not None:
+                # the stint's resource summary lands on the trial root span
+                # BEFORE it is ended/persisted below
+                self._telemetry_finalize(tm, trial.name, root)
             if run_span is not None:
                 tr.end_span(exec_span)  # no-op unless an exception skipped it
                 tr.end_span(run_span, requeued=requeued, restarted=restarted)
@@ -827,6 +845,19 @@ class TrialScheduler:
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
 
+    def _telemetry_finalize(self, tm, trial_name: str, root) -> None:
+        """Close one trial's telemetry stint: unregister (persists its
+        sample ring) and stamp the peak-RSS / peak-HBM / mean-CPU summary
+        onto the trial's root span so the trace answers cost, not just
+        time. ``root`` is None when tracing is off."""
+        summary = tm.unregister_trial(trial_name)
+        if summary and root is not None:
+            root.set(
+                peak_rss_bytes=summary["peakRssBytes"],
+                peak_hbm_bytes=summary["peakHbmBytes"],
+                mean_cpu_percent=summary["meanCpuPercent"],
+            )
+
     def _run_pack(
         self,
         exp: Experiment,
@@ -848,6 +879,10 @@ class TrialScheduler:
         timed_out = threading.Event()
         pack_id = f"{trials[0].name}x{len(trials)}"
         tr = self._tr()
+        tm = self._tm()
+        if tm is not None:
+            for t in trials:
+                tm.register_trial(exp.name, t.name)  # in-process: shared attribution
         # one gang-level trace per pack (root `pack` span + K member child
         # spans); each member's own trial trace gets a `run` span linking to
         # it, so both the per-trial and the shared-program views connect
@@ -889,6 +924,13 @@ class TrialScheduler:
                 timer.start()
 
             ctx = self._build_pack_context(exp, trials, devices, handles)
+            if tm is not None:
+                # one demuxed report() heartbeats every member — the watchdog
+                # sees the pack's shared step loop, not K separate clocks
+                names = [t.name for t in trials]
+                ctx.on_report = lambda _tm=tm, _names=names: [
+                    _tm.heartbeat(n) for n in _names
+                ]
             if gang is not None:
                 # shared compiled program: compile/steps/flush spans land in
                 # the gang trace under the pack root
@@ -937,6 +979,12 @@ class TrialScheduler:
         finally:
             if timer is not None:
                 timer.cancel()
+            if tm is not None:
+                for t in trials:
+                    self._telemetry_finalize(
+                        tm, t.name,
+                        tr.trial_root(exp.name, t.name) if tr is not None else None,
+                    )
             if gang is not None:
                 for t in trials:
                     tr.end_span(gang.members.get(t.name))
@@ -1346,6 +1394,7 @@ class TrialScheduler:
 
             workdir = os.path.join(self.workdir_root, exp.name, trial.name)
             os.makedirs(workdir, exist_ok=True)
+        tm = self._tm()
         return TrialContext(
             trial_name=trial.name,
             experiment_name=exp.name,
@@ -1357,6 +1406,17 @@ class TrialScheduler:
             labels=dict(trial.labels),
             topology=spec.trial_template.resources.topology,
             on_checkpoint=lambda step, _t=trial.name: self._note_checkpoint(_t),
+            # telemetry hooks (None when off — ctx.report pays one check):
+            # every report is a watchdog heartbeat; subprocess executors
+            # re-point /proc sampling at the child pids they spawn
+            on_report=(
+                (lambda _t=trial.name, _tm=tm: _tm.heartbeat(_t))
+                if tm is not None else None
+            ),
+            on_subprocess=(
+                (lambda pids, _t=trial.name, _tm=tm: _tm.set_pids(_t, pids))
+                if tm is not None else None
+            ),
         )
 
     CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions

@@ -30,6 +30,12 @@ class TrialContext:
     # selection prefers recently-checkpointed trials; resume-vs-restart on
     # preemption hinges on whether a checkpoint exists at all).
     on_checkpoint: Optional[Callable[[int], None]] = None
+    # Telemetry hooks (katib_tpu/telemetry.py), None when telemetry is off:
+    # on_report is the watchdog heartbeat fired on every ctx.report (and on
+    # subprocess output/scrape activity — the executor calls it directly);
+    # on_subprocess re-points /proc sampling at the spawned child pids.
+    on_report: Optional[Callable[[], None]] = None
+    on_subprocess: Optional[Callable[[List[int]], None]] = None
     # Tracing (katib_tpu.tracing): bound by the scheduler when tracing is
     # on. The runtime marks the compile boundary (first report ends the
     # `compile` span and opens `steps`) and spans checkpoint saves/restores
@@ -98,6 +104,8 @@ class TrialContext:
         your checkpoint BEFORE reporting and preemption loses nothing)."""
         if self.tracer is not None:
             self._trace_mark_report()
+        if self.on_report is not None:
+            self.on_report()  # watchdog heartbeat BEFORE a possible unwind
         self.reporter.report(**metrics)
 
     def flush_metrics(self) -> None:
@@ -122,11 +130,14 @@ class TrialContext:
         ev = getattr(self.reporter, "preempt_event", None)
         return ev is not None and ev.is_set()
 
-    def profile(self, enabled: bool = True):
+    def profile(self, enabled: Optional[bool] = None):
         """Context manager: capture a JAX profiler (xplane) trace of the
         enclosed steps into ``<workdir>/profile`` — surfaced by the UI at
         ``/api/experiments/<e>/trials/<t>/profile``. No-op without a workdir
-        so trial code can call it unconditionally (SURVEY.md §5)."""
+        so trial code can call it unconditionally (SURVEY.md §5). ``enabled``
+        defaults from ``$KATIB_TPU_PROFILE`` (the executor stamps it on trial
+        subprocesses), so an operator can switch profiling fleet-wide without
+        touching trial code; an explicit True/False wins."""
         from .profiling import profile_trace
 
         return profile_trace(self.workdir, enabled=enabled)

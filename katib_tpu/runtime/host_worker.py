@@ -88,7 +88,9 @@ class WorkerContext:
             )
         return Mesh(arr, axis_names)
 
-    def profile(self, enabled: bool = True):
+    def profile(self, enabled: Optional[bool] = None):
+        # enabled=None defaults from $KATIB_TPU_PROFILE (stamped on gang
+        # workers by the executor) — same contract as TrialContext.profile
         from .profiling import profile_trace
 
         return profile_trace(self.workdir, enabled=enabled)

@@ -118,6 +118,9 @@ class PackedTrialContext:
     # fair-share preemption: a pack preempts as ONE unit (it holds one gang
     # allocation), so the scheduler sets every member's event together
     preempt_events: List[Optional[threading.Event]] = field(default_factory=list)
+    # telemetry heartbeat (telemetry.py): the scheduler binds a callback that
+    # heartbeats every member — one shared step loop, one watchdog clock
+    on_report: Optional[Any] = None
 
     def __post_init__(self) -> None:
         k = len(self.trial_names)
@@ -244,6 +247,8 @@ class PackedTrialContext:
         active."""
         if self._tracer is not None:
             self._trace_mark_report()
+        if self.on_report is not None:
+            self.on_report()  # watchdog heartbeat for every member
         k = self.pack_size
         cols: Dict[str, np.ndarray] = {}
         for name, value in metrics.items():

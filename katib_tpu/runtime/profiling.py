@@ -16,14 +16,32 @@ import os
 from typing import Iterator, List, Optional
 
 PROFILE_DIRNAME = "profile"
-ENV_PROFILE = "KATIB_TPU_PROFILE"  # "1" on trial subprocesses when requested
+ENV_PROFILE = "KATIB_TPU_PROFILE"  # stamped on trial subprocesses by the executor
+
+
+def profile_enabled_from_env(default: bool = True) -> bool:
+    """$KATIB_TPU_PROFILE verdict: "0"/"false"/"off" disables profiling
+    fleet-wide, anything else (or unset) keeps ``default``. This is how the
+    env hook is honored end-to-end: the executor stamps the controller's
+    value onto trial subprocesses, and ``ctx.profile()`` (enabled=None)
+    resolves through here."""
+    raw = os.environ.get(ENV_PROFILE)
+    if raw is None or raw == "":
+        return default
+    return raw.lower() not in ("0", "false", "off")
 
 
 @contextlib.contextmanager
-def profile_trace(workdir: Optional[str], enabled: bool = True) -> Iterator[Optional[str]]:
+def profile_trace(
+    workdir: Optional[str], enabled: Optional[bool] = None
+) -> Iterator[Optional[str]]:
     """Trace JAX execution into ``<workdir>/profile``; no-op without a
     workdir or when disabled (so trial code can call it unconditionally).
-    Yields the trace directory (or None when inactive)."""
+    ``enabled=None`` defaults from $KATIB_TPU_PROFILE (on unless the env
+    disables it — the pre-env behavior). Yields the trace directory (or
+    None when inactive)."""
+    if enabled is None:
+        enabled = profile_enabled_from_env()
     if not workdir or not enabled:
         yield None
         return
@@ -51,18 +69,22 @@ def profile_trace(workdir: Optional[str], enabled: bool = True) -> Iterator[Opti
 
 
 def list_profile_artifacts(workdir: Optional[str]) -> List[dict]:
-    """Relative paths + sizes of captured trace files under the workdir."""
+    """Relative paths + sizes of captured trace files under the workdir.
+
+    Sorted directory traversal (os.walk order is filesystem-dependent) so
+    the UI listing is deterministic, and tolerant of files vanishing
+    between the walk and the stat (a concurrent trial cleanup)."""
     out: List[dict] = []
     if not workdir:
         return out
     root = os.path.join(workdir, PROFILE_DIRNAME)
-    for dirpath, _dirnames, filenames in os.walk(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
         for fn in sorted(filenames):
             p = os.path.join(dirpath, fn)
-            out.append(
-                {
-                    "path": os.path.relpath(p, root),
-                    "bytes": os.path.getsize(p),
-                }
-            )
+            try:
+                size = os.path.getsize(p)
+            except FileNotFoundError:
+                continue  # vanished between walk and stat
+            out.append({"path": os.path.relpath(p, root), "bytes": size})
     return out

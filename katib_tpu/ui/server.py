@@ -30,6 +30,13 @@ same information surface:
   GET /api/queue                                fair-share queue state (pending
                                                 trials with priority/wait/
                                                 deficit, running units, devices)
+  GET /api/telemetry                            cluster resource snapshot
+                                                (per-trial RSS/CPU/heartbeat,
+                                                per-device HBM, XLA cache —
+                                                what `katib-tpu top` renders)
+  GET /api/experiments/<e>/trials/<t>/telemetry one trial's resource time
+                                                series (live ring, or the
+                                                JSON persisted at trial end)
   GET /metrics                                  Prometheus text exposition
   GET /                                         single-page HTML dashboard
   GET /experiment/<name>                        experiment detail page (live
@@ -646,6 +653,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # trials with priority / wait / deficit, running units, and
                 # the device pool — the operator's starvation debugger
                 return self._send(ctrl.scheduler.queue_state())
+            if path == "/api/telemetry":
+                # cluster resource snapshot (telemetry.py): per-trial RSS/
+                # CPU/heartbeat age, per-device HBM, XLA cache — what
+                # `katib-tpu top` renders
+                tm = getattr(ctrl, "telemetry", None)
+                if tm is None:
+                    return self._send(
+                        {"error": "telemetry unavailable on this controller"},
+                        code=404,
+                    )
+                return self._send(tm.snapshot())
             if path == "/api/events":
                 # cross-experiment event view: queue stalls, preemptions and
                 # flusher errors are queryable without knowing the experiment
@@ -721,6 +739,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._trial_profile(name, parts[5])
                 if sub == "trials" and len(parts) == 7 and parts[6] == "trace":
                     return self._trial_trace(name, parts[5])
+                if sub == "trials" and len(parts) == 7 and parts[6] == "telemetry":
+                    return self._trial_telemetry(name, parts[5])
                 if sub == "trials" and len(parts) == 6:
                     # full single-trial object (trial-details page backend):
                     # assignments, condition history, observation, times —
@@ -886,6 +906,21 @@ class _Handler(BaseHTTPRequestHandler):
                 to_perfetto(spans, trace_name=f"katib-tpu {exp_name}/{trial_name}")
             )
         return self._send(trace)
+
+    def _trial_telemetry(self, exp_name: str, trial_name: str) -> None:
+        """Serve one trial's resource time series (telemetry.py): the live
+        sample ring while it runs, the persisted JSON afterwards."""
+        tm = getattr(self.controller, "telemetry", None)
+        series = tm.trial_series(exp_name, trial_name) if tm is not None else None
+        if series is None:
+            return self._send(
+                {"error": f"no telemetry for trial {trial_name!r} "
+                          "(telemetry disabled, trial unknown, or never "
+                          "sampled — the interval may exceed the trial's "
+                          "runtime)"},
+                code=404,
+            )
+        return self._send(series)
 
     def _trial_profile(self, exp_name: str, trial_name: str) -> None:
         """List captured xplane profiler artifacts for a trial (SURVEY §5

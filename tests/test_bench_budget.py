@@ -556,6 +556,21 @@ def test_tracing_overhead_smoke_wiring(bench):
     # run with busy-work trials is the meaningful <3% measurement
 
 
+def test_telemetry_overhead_smoke_wiring(bench):
+    """--smoke mode of the telemetry_overhead scenario: two full in-process
+    experiments (sampler on at a 50ms interval, and off) run end-to-end at
+    a trimmed trial count. No strict 2% assertion here — CI contention would
+    make the ratio flaky; that target is the timed run's acceptance number,
+    reported as within_target."""
+    out = bench._bench_telemetry_overhead(smoke=True)
+    assert out["smoke"] is True
+    assert out["trials"] == 12 and out["reports_per_trial"] > 0
+    assert out["on_s"] > 0 and out["off_s"] > 0
+    assert out["on_trials_per_s"] > 0 and out["off_trials_per_s"] > 0
+    assert out["target_pct"] == 2.0
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -193,6 +193,61 @@ def test_cli_resume(tmp_path, capsys):
     assert "no persisted state" in capsys.readouterr().err
 
 
+def test_cli_top_renders_persisted_telemetry(tmp_path, capsys):
+    """`katib-tpu top` without --url renders the resource series persisted
+    under <root>/telemetry/ — readable after the controller exited (ISSUE 5
+    acceptance: persisted telemetry outlives the controller)."""
+    import time
+
+    from katib_tpu.api import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
+    )
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+
+    root = str(tmp_path / "root")
+
+    def trial_fn(assignments, ctx):
+        for i in range(5):
+            time.sleep(0.04)
+            ctx.report(score=float(i))
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry_interval_seconds = 0.03  # trials outlive >=1 tick
+    ctrl = ExperimentController(root_dir=root, devices=list(range(2)), config=cfg)
+    spec = ExperimentSpec(
+        name="cli-top",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(function=trial_fn),
+        max_trial_count=2,
+        parallel_trial_count=2,
+    )
+    ctrl.create_experiment(spec)
+    ctrl.run("cli-top", timeout=60)
+    trial_names = [t.name for t in ctrl.state.list_trials("cli-top")]
+    ctrl.close()  # controller gone; top reads the persisted files
+
+    rc = main(["--root", root, "top"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "RSS" in out and "LAST-REPORT" in out
+    for name in trial_names:
+        assert name in out
+    assert "MiB" in out or "GiB" in out  # a real RSS figure rendered
+
+    # empty root: friendly hint, not a traceback
+    rc = main(["--root", str(tmp_path / "empty"), "top"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "no telemetry" in out
+
+
 def test_cli_rejects_invalid_spec(tmp_path, capsys):
     bad = {"name": "bad", "algorithm": {"algorithmName": "nope"}}
     p = tmp_path / "bad.json"

@@ -1,0 +1,422 @@
+"""Per-trial resource telemetry + health watchdog (katib_tpu/telemetry.py):
+sampler mechanics, stall/OOM-risk watchdog firing, rc=-9 OOM-kill
+classification, persistence, and the /metrics gauge surface (ISSUE 5)."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from katib_tpu.controller.events import EventRecorder, MetricsRegistry
+from katib_tpu.telemetry import (
+    OOM_KILL_MESSAGE,
+    ResourceSampler,
+    fmt_bytes,
+    oom_kill_suspected,
+    read_cpu_seconds,
+    read_host_memory_total,
+    read_rss_bytes,
+    scan_xla_cache,
+    snapshot_from_persisted,
+    telemetry_enabled_from_env,
+    top_rows,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def make_sampler(**kw):
+    kw.setdefault("events", EventRecorder())
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("interval", 0.01)
+    return ResourceSampler(**kw)
+
+
+class TestProcReaders:
+    def test_self_process_readable(self):
+        """The /proc readers work on this very process (Linux CI)."""
+        pid = os.getpid()
+        rss = read_rss_bytes(pid)
+        assert rss is not None and rss > 1 << 20  # a python process is >1MiB
+        cpu = read_cpu_seconds(pid)
+        assert cpu is not None and cpu >= 0.0
+        total = read_host_memory_total()
+        assert total is not None and total > rss
+
+    def test_vanished_pid_returns_none(self):
+        assert read_rss_bytes(2**30) is None
+        assert read_cpu_seconds(2**30) is None
+
+    def test_xla_cache_scan(self, tmp_path):
+        (tmp_path / "a").write_bytes(b"x" * 10)
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        (sub / "b").write_bytes(b"y" * 5)
+        out = scan_xla_cache(str(tmp_path))
+        assert out == {"entries": 2, "bytes": 15}
+        assert scan_xla_cache(str(tmp_path / "missing")) == {"entries": 0, "bytes": 0}
+        assert scan_xla_cache(None) == {"entries": 0, "bytes": 0}
+
+    def test_oom_kill_suspected(self):
+        assert oom_kill_suspected(-9)
+        assert oom_kill_suspected(137)  # shell-wrapped 128+9
+        assert not oom_kill_suspected(0)
+        assert not oom_kill_suspected(1)
+        assert not oom_kill_suspected(-15)
+        assert not oom_kill_suspected(None)
+
+    def test_env_toggle(self, monkeypatch):
+        monkeypatch.delenv("KATIB_TPU_TELEMETRY", raising=False)
+        assert telemetry_enabled_from_env()
+        monkeypatch.setenv("KATIB_TPU_TELEMETRY", "0")
+        assert not telemetry_enabled_from_env()
+        monkeypatch.setenv("KATIB_TPU_TELEMETRY", "1")
+        assert telemetry_enabled_from_env()
+
+
+class TestSampler:
+    def test_in_process_sampling_and_gauges(self):
+        metrics = MetricsRegistry()
+        s = make_sampler(metrics=metrics)
+        s.register_trial("exp", "t1")
+        assert s.sample_once() == 1
+        snap = s.snapshot()
+        assert len(snap["trials"]) == 1
+        row = snap["trials"][0]
+        assert row["rssBytes"] > 0 and row["inProcess"]
+        render = metrics.render()
+        assert 'katib_trial_host_rss_bytes{experiment="exp",trial="t1"}' in render
+        assert "katib_telemetry_samples_total" in render
+        assert "katib_xla_cache_entries" in render
+        # finished trial: its gauge series vanish on the next scrape
+        summary = s.unregister_trial("t1")
+        assert summary["peakRssBytes"] > 0 and summary["samples"] == 1
+        assert "katib_trial_host_rss_bytes" not in metrics.render()
+
+    def test_cpu_percent_needs_two_samples(self):
+        s = make_sampler()
+        s.register_trial("exp", "t1")
+        now = time.time()
+        s.sample_once(now=now)
+        first = s.snapshot()["trials"][0]
+        assert first["cpuPercent"] is None  # no previous observation yet
+        # burn some CPU so the delta is visible
+        x = 0
+        for i in range(200000):
+            x += i & 3
+        s.sample_once(now=now + 0.05)
+        second = s.snapshot()["trials"][0]
+        assert second["cpuPercent"] is not None and second["cpuPercent"] >= 0.0
+
+    def test_disabled_is_noop(self):
+        s = ResourceSampler(enabled=False, metrics=MetricsRegistry())
+        s.register_trial("exp", "t1")
+        s.heartbeat("t1")
+        assert s.sample_once() == 0
+        assert s.unregister_trial("t1") is None
+        s.start()
+        assert s._thread is None  # no daemon thread when disabled
+
+    def test_subprocess_pid_attribution(self):
+        """set_pids re-points sampling at child pids; vanished pids skip."""
+        s = make_sampler()
+        s.register_trial("exp", "t1")
+        s.set_pids("t1", [os.getpid(), 2**30])  # one live, one gone
+        s.sample_once()
+        row = s.snapshot()["trials"][0]
+        assert not row["inProcess"]
+        assert row["rssBytes"] == read_rss_bytes(os.getpid())  # dead pid skipped
+
+    def test_persistence_roundtrip_and_offline_top(self, tmp_path):
+        s = make_sampler(persist_dir=str(tmp_path))
+        s.register_trial("exp", "t1")
+        s.heartbeat("t1")
+        s.sample_once()
+        s.unregister_trial("t1")
+        path = tmp_path / "exp" / "t1.json"
+        assert path.exists()
+        series = s.trial_series("exp", "t1")  # falls back to the file
+        assert series["live"] is False and len(series["samples"]) == 1
+        assert series["summary"]["peakRssBytes"] > 0
+        snap = snapshot_from_persisted(str(tmp_path))
+        rows = top_rows(snap)
+        assert len(rows) == 1 and rows[0][0] == "t1" and rows[0][-1] == "done"
+
+    def test_path_traversal_rejected(self, tmp_path):
+        s = make_sampler(persist_dir=str(tmp_path))
+        assert s._series_path("../evil", "t") is None
+        assert s._series_path("exp", "a/b") is None
+        assert s.trial_series("../evil", "t") is None
+
+
+class TestWatchdog:
+    def test_stall_fires_within_one_interval_and_rearms(self):
+        events = EventRecorder()
+        metrics = MetricsRegistry()
+        s = make_sampler(events=events, metrics=metrics, stall_seconds=0.05)
+        s.register_trial("exp", "t1")
+        s.heartbeat("t1")
+        now = time.time()
+        s.sample_once(now=now)  # fresh heartbeat: no warning
+        assert not any(e.reason == "TrialStalled" for e in events.list("exp"))
+        s.sample_once(now=now + 0.2)  # one interval past the threshold
+        stalls = [e for e in events.list("exp") if e.reason == "TrialStalled"]
+        assert len(stalls) == 1 and stalls[0].event_type == "Warning"
+        assert "katib_trial_stalled_total" in metrics.render()
+        assert s.snapshot()["trials"][0]["stalled"]
+        # once per stint: a second stalled tick does not re-emit
+        s.sample_once(now=now + 0.4)
+        assert sum(e.reason == "TrialStalled" for e in events.list("exp")) == 1
+        # a heartbeat re-arms the watchdog; a fresh stall emits again
+        s.heartbeat("t1")
+        s.sample_once(now=time.time() + 0.2)
+        assert sum(e.reason == "TrialStalled" for e in events.list("exp")) == 2
+
+    def test_stalled_event_visible_in_warning_view(self):
+        """TrialStalled rides the cross-experiment warning surface
+        (GET /api/events?warning=1) like every other warning event."""
+        events = EventRecorder()
+        s = make_sampler(events=events, stall_seconds=0.01)
+        s.register_trial("exp", "t1")
+        s.sample_once(now=time.time() + 1.0)
+        warnings = events.list_all(warning_only=True)
+        assert any(e.reason == "TrialStalled" and e.experiment == "exp" for e in warnings)
+
+    def test_never_reported_trial_counts_from_registration(self):
+        events = EventRecorder()
+        s = make_sampler(events=events, stall_seconds=0.05)
+        s.register_trial("exp", "t1")  # never heartbeats
+        s.sample_once(now=time.time() + 0.2)
+        assert any(e.reason == "TrialStalled" for e in events.list("exp"))
+
+    def test_oom_risk_on_monotonic_growth_past_fraction(self):
+        events = EventRecorder()
+        metrics = MetricsRegistry()
+        s = make_sampler(
+            events=events, metrics=metrics,
+            host_memory_bytes=1000, oom_risk_fraction=0.5,
+        )
+        ramp = iter([100, 300, 520, 600, 700, 800])
+        s._read_rss = lambda pid, _r=ramp: next(_r, 900)
+        s._read_cpu = lambda pid: 0.0
+        s.register_trial("exp", "t1", pids=[1234])
+        for i in range(6):
+            s.heartbeat("t1")  # keep the stall watchdog quiet
+            s.sample_once(now=time.time() + i * 0.01)
+        oom = [e for e in events.list("exp") if e.reason == "TrialOOMRisk"]
+        assert len(oom) == 1 and oom[0].event_type == "Warning"
+        assert "before" not in oom[0].message or True  # message is advisory
+        assert "katib_trial_oom_risk_total" in metrics.render()
+        assert s.snapshot()["trials"][0]["oomRisk"]
+
+    def test_no_oom_risk_when_flat_or_below_fraction(self):
+        events = EventRecorder()
+        s = make_sampler(events=events, host_memory_bytes=1000, oom_risk_fraction=0.5)
+        # pid 1: above the fraction but flat (not monotonic growth);
+        # pid 2: growing but far below the fraction — neither warns
+        small = iter([10, 20, 30, 40, 50, 60])
+        readings = {1: lambda: 800, 2: lambda _r=small: next(_r, 70)}
+        s._read_rss = lambda pid: readings[pid]()
+        s._read_cpu = lambda pid: 0.0
+        s.register_trial("exp", "flat", pids=[1])
+        s.register_trial("exp", "small", pids=[2])
+        for i in range(6):
+            s.heartbeat("flat")
+            s.heartbeat("small")
+            s.sample_once(now=time.time() + i * 0.01)
+        assert not any(e.reason == "TrialOOMRisk" for e in events.list("exp"))
+
+
+class TestControllerIntegration:
+    def _spec(self, name, fn=None, command=None, max_trials=1):
+        from katib_tpu.api import (
+            AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+            ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
+        )
+
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=fn, command=command),
+            max_trial_count=max_trials,
+            parallel_trial_count=1,
+        )
+
+    def test_root_span_carries_resource_summary(self, tmp_path):
+        """Peak-RSS / mean-CPU summary attrs land on the PR 4 trial root
+        span at finalize, and the per-trial series persists under
+        <root>/telemetry/ readable after the run."""
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.experiment import ExperimentController
+
+        def trial_fn(assignments, ctx):
+            for i in range(5):
+                time.sleep(0.04)
+                ctx.report(score=float(i))
+
+        cfg = KatibConfig()
+        cfg.runtime.telemetry_interval_seconds = 0.03
+        ctrl = ExperimentController(
+            root_dir=str(tmp_path), devices=list(range(2)), config=cfg
+        )
+        try:
+            ctrl.create_experiment(self._spec("tm-span", fn=trial_fn))
+            exp = ctrl.run("tm-span", timeout=60)
+            assert exp.status.is_succeeded
+            trial = ctrl.state.list_trials("tm-span")[0]
+            trace = ctrl.tracer.trial_trace("tm-span", trial.name)
+            root = next(s for s in trace["spans"] if s["name"] == "trial")
+            assert root["attrs"]["peak_rss_bytes"] > 0
+            assert root["attrs"]["mean_cpu_percent"] is not None
+            series = ctrl.telemetry.trial_series("tm-span", trial.name)
+            assert series and series["samples"]
+            assert os.path.exists(
+                os.path.join(str(tmp_path), "telemetry", "tm-span", f"{trial.name}.json")
+            )
+        finally:
+            ctrl.close()
+
+    def test_subprocess_sigkill_classified_as_oom(self, tmp_path):
+        """A child that dies on an uninstructed SIGKILL (the kernel OOM
+        killer's signature) fails with the OOM-kill classification in its
+        terminal status message, not a bare 'exited with code -9'."""
+        from katib_tpu.api.status import TrialCondition
+        from katib_tpu.controller.experiment import ExperimentController
+
+        cmd = [sys.executable, "-c", "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"]
+        ctrl = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            ctrl.create_experiment(self._spec("tm-oom", command=cmd))
+            ctrl.run("tm-oom", timeout=60)
+            t = ctrl.state.list_trials("tm-oom")[0]
+            assert t.condition == TrialCondition.FAILED
+            assert "OOM" in t.message and "SIGKILL" in t.message
+        finally:
+            ctrl.close()
+
+    def test_subprocess_nonzero_exit_not_misclassified(self, tmp_path):
+        from katib_tpu.api.status import TrialCondition
+        from katib_tpu.controller.experiment import ExperimentController
+
+        cmd = [sys.executable, "-c", "raise SystemExit(3)"]
+        ctrl = ExperimentController(root_dir=str(tmp_path), devices=list(range(2)))
+        try:
+            ctrl.create_experiment(self._spec("tm-rc3", command=cmd))
+            ctrl.run("tm-rc3", timeout=60)
+            t = ctrl.state.list_trials("tm-rc3")[0]
+            assert t.condition == TrialCondition.FAILED
+            assert "exited with code 3" in t.message and "OOM" not in t.message
+        finally:
+            ctrl.close()
+
+    def test_telemetry_disabled_via_env(self, tmp_path, monkeypatch):
+        """KATIB_TPU_TELEMETRY=0: no sampler thread, no telemetry files,
+        trial runs unaffected (the disabled path is one boolean per site)."""
+        monkeypatch.setenv("KATIB_TPU_TELEMETRY", "0")
+        from katib_tpu.config import load_config
+        from katib_tpu.controller.experiment import ExperimentController
+
+        cfg = load_config()
+        assert cfg.runtime.telemetry is False
+        ctrl = ExperimentController(
+            root_dir=str(tmp_path), devices=list(range(2)), config=cfg
+        )
+        try:
+            ctrl.create_experiment(
+                self._spec("tm-off", fn=lambda a, c: c.report(score=1.0))
+            )
+            exp = ctrl.run("tm-off", timeout=60)
+            assert exp.status.is_succeeded
+            assert not ctrl.telemetry.enabled
+            assert ctrl.telemetry._thread is None
+            assert not os.path.exists(os.path.join(str(tmp_path), "telemetry"))
+        finally:
+            ctrl.close()
+
+
+class TestProfileEnvHonored:
+    def test_profile_trace_disabled_by_env(self, tmp_path, monkeypatch):
+        """KATIB_TPU_PROFILE=0 turns ctx.profile() into a no-op fleet-wide;
+        unset keeps the historical default (on, given a workdir)."""
+        from katib_tpu.runtime.profiling import profile_trace
+
+        monkeypatch.setenv("KATIB_TPU_PROFILE", "0")
+        with profile_trace(str(tmp_path)) as d:
+            assert d is None
+        monkeypatch.delenv("KATIB_TPU_PROFILE")
+        with profile_trace(str(tmp_path)) as d:
+            assert d is not None  # default stays on (compat)
+        # an explicit argument beats the env
+        monkeypatch.setenv("KATIB_TPU_PROFILE", "0")
+        with profile_trace(str(tmp_path), enabled=True) as d:
+            assert d is not None
+
+    def test_executor_stamps_profile_env_on_children(self, monkeypatch):
+        from katib_tpu.controller.executor import SubprocessExecutor
+        from katib_tpu.runtime.profiling import ENV_PROFILE
+
+        monkeypatch.setenv(ENV_PROFILE, "1")
+        env = {}
+        SubprocessExecutor._stamp_profile_env(env)
+        assert env[ENV_PROFILE] == "1"
+        # a template-pinned value wins over the controller's
+        env = {ENV_PROFILE: "0"}
+        SubprocessExecutor._stamp_profile_env(env)
+        assert env[ENV_PROFILE] == "0"
+        monkeypatch.delenv(ENV_PROFILE)
+        env = {}
+        SubprocessExecutor._stamp_profile_env(env)
+        assert ENV_PROFILE not in env
+
+    def test_list_profile_artifacts_tolerates_vanishing_files(self, tmp_path, monkeypatch):
+        """A file disappearing between the walk and the stat is skipped, and
+        traversal order is deterministic (sorted)."""
+        import katib_tpu.runtime.profiling as prof
+
+        pdir = tmp_path / "profile"
+        pdir.mkdir()
+        for name in ("b.xplane.pb", "a.xplane.pb", "gone.tmp"):
+            (pdir / name).write_bytes(b"data")
+
+        real_getsize = os.path.getsize
+
+        def flaky_getsize(p):
+            if p.endswith("gone.tmp"):
+                raise FileNotFoundError(p)
+            return real_getsize(p)
+
+        monkeypatch.setattr(prof.os.path, "getsize", flaky_getsize)
+        arts = prof.list_profile_artifacts(str(tmp_path))
+        assert [a["path"] for a in arts] == ["a.xplane.pb", "b.xplane.pb"]
+
+
+class TestRenderHelpers:
+    def test_fmt_bytes(self):
+        assert fmt_bytes(None) == "-"
+        assert fmt_bytes(512) == "512B"
+        assert fmt_bytes(2048) == "2.0KiB"
+        assert fmt_bytes(3 * 2**30) == "3.0GiB"
+
+    def test_top_rows_flags(self):
+        snap = {
+            "trials": [
+                {"trial": "t1", "experiment": "e", "rssBytes": 1 << 20,
+                 "cpuPercent": 42.0, "hbmBytes": None,
+                 "heartbeatAgeSeconds": 3.2, "stalled": True, "oomRisk": True},
+            ]
+        }
+        rows = top_rows(snap)
+        assert rows[0][2] == "1.0MiB" and rows[0][3] == "42%"
+        assert rows[0][5] == "3s" and rows[0][6] == "STALLED,OOM-RISK"
+
+
+def test_oom_kill_message_names_the_surfaces():
+    assert "telemetry" in OOM_KILL_MESSAGE and "rc=-9" in OOM_KILL_MESSAGE

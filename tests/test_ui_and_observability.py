@@ -311,6 +311,102 @@ class TestUIServer:
         assert e.value.code == 404
 
 
+class TestTelemetryEndpoints:
+    """ISSUE 5: the resource-telemetry export surfaces. A dedicated stack
+    with a fast sampler interval so the short trials get sampled."""
+
+    @pytest.fixture(scope="class")
+    def tstack(self, tmp_path_factory):
+        import time
+
+        from katib_tpu.config import KatibConfig
+
+        tmp = tmp_path_factory.mktemp("telemetry-ui")
+        cfg = KatibConfig()
+        cfg.runtime.telemetry_interval_seconds = 0.03
+        ctrl = ExperimentController(
+            root_dir=str(tmp), devices=list(range(2)), config=cfg
+        )
+
+        def trial_fn(assignments, ctx):
+            for i in range(5):
+                time.sleep(0.04)
+                ctx.report(score=float(i))
+
+        spec = ExperimentSpec(
+            name="tm-ui",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=trial_fn),
+            max_trial_count=2,
+            parallel_trial_count=2,
+        )
+        ctrl.create_experiment(spec)
+        ctrl.run("tm-ui", timeout=60)
+        httpd = serve_ui(ctrl, port=0)
+        port = httpd.server_address[1]
+        yield f"http://127.0.0.1:{port}", ctrl
+        httpd.shutdown()
+        ctrl.close()
+
+    @pytest.mark.smoke
+    def test_cluster_snapshot_endpoint(self, tstack):
+        """GET /api/telemetry: the `katib-tpu top` backend — host memory,
+        device list, XLA cache, and the (now empty) running-trial table."""
+        base, _ = tstack
+        status, ctype, body = get(f"{base}/api/telemetry")
+        assert status == 200 and "json" in ctype
+        snap = json.loads(body)
+        assert snap["enabled"] is True
+        assert snap["hostMemoryTotalBytes"] and snap["hostMemoryTotalBytes"] > 0
+        assert "xlaCache" in snap and "devices" in snap
+        assert snap["trials"] == []  # every trial finished and unregistered
+
+    @pytest.mark.smoke
+    def test_trial_time_series_endpoint(self, tstack):
+        """GET .../trials/<t>/telemetry serves the per-trial sample series
+        (persisted after the trial ended) with the resource summary."""
+        base, ctrl = tstack
+        trial = ctrl.state.list_trials("tm-ui")[0]
+        status, ctype, body = get(
+            f"{base}/api/experiments/tm-ui/trials/{trial.name}/telemetry"
+        )
+        assert status == 200 and "json" in ctype
+        series = json.loads(body)
+        assert series["trial"] == trial.name and series["live"] is False
+        assert series["samples"], "trial ran >=4 ticks but recorded no samples"
+        sample = series["samples"][-1]
+        assert sample["rssBytes"] > 0 and sample["inProcess"] is True
+        assert sample["heartbeatAgeSeconds"] is not None
+        assert series["summary"]["peakRssBytes"] > 0
+
+    def test_trial_time_series_404(self, tstack):
+        base, _ = tstack
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{base}/api/experiments/tm-ui/trials/no-such/telemetry")
+        assert e.value.code == 404
+
+    @pytest.mark.smoke
+    def test_metrics_exposition_carries_telemetry_families(self, tstack):
+        """/metrics renders the telemetry counter + XLA-cache gauges with
+        catalog HELP text (finished trials' per-trial gauges vanished)."""
+        base, _ = tstack
+        _, _, body = get(f"{base}/metrics")
+        assert "katib_telemetry_samples_total" in body
+        assert "# HELP katib_xla_cache_entries" in body
+        assert "# TYPE katib_xla_cache_entries gauge" in body
+        # per-trial series are gone (trials finished) but were sampled:
+        # the counter advanced past zero
+        for line in body.splitlines():
+            if line.startswith("katib_telemetry_samples_total"):
+                assert float(line.split()[-1]) > 0
+
+
 class TestConfig:
     def test_load_roundtrip(self, tmp_path):
         from katib_tpu.config import KatibConfig, load_config
