@@ -838,6 +838,32 @@ def _bench_telemetry_overhead(smoke: bool = False):
     }
 
 
+def _bench_check_latency(smoke: bool = False):
+    """Wall-clock of one full `katib-tpu check` pass over katib_tpu/
+    (ISSUE 6 satellite): the analyzer gates every PR from a tier-1 test, so
+    the pass itself must stay a few seconds at most or it gets turned off.
+    Pure-AST — no JAX import, no backend — so smoke IS the full measurement
+    (there is nothing to trim)."""
+    import time as _time
+
+    from katib_tpu.analysis.engine import check_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    t0 = _time.perf_counter()
+    findings, stats = check_paths(["katib_tpu"], repo_root=repo)
+    elapsed = _time.perf_counter() - t0
+    return {
+        "files": stats["files"],
+        "findings": len(findings),
+        "suppressed": stats["suppressed"],
+        "elapsed_s": round(elapsed, 3),
+        "files_per_s": round(stats["files"] / elapsed, 1) if elapsed else None,
+        "target_s": 5.0,
+        "within_target": elapsed < 5.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1789,6 +1815,7 @@ OBSLOG_SCENARIOS = {
     "obslog_fold_latency": _bench_obslog_fold_latency,
     "tracing_overhead": _bench_tracing_overhead,
     "telemetry_overhead": _bench_telemetry_overhead,
+    "check_latency": _bench_check_latency,
 }
 
 

@@ -1,0 +1,39 @@
+"""Repo-native static analysis + dynamic concurrency checking.
+
+After PRs 1-5 the controller is a genuinely concurrent system — scheduler
+dispatch, the obslog flusher, the ResourceSampler tick, and per-trial worker
+threads all share state — and the e2e is compile-dominated (BENCH_r02/r04:
+23-51s XLA compile vs ~2ms steps). Both facts turned into conventions:
+"don't create jit wrappers per call", "hold self._lock when touching the
+shared dicts", "flush before raising TrialPreempted". Conventions rot; this
+package turns them into machine-checked rules (docs/static-analysis.md):
+
+- :mod:`engine` — file walker + rule runner behind ``katib-tpu check``;
+- :mod:`rules_recompile` — recompile / host-sync hazards (KTC1xx);
+- :mod:`rules_locks` — lock discipline for threaded classes (KTL2xx);
+- :mod:`rules_invariants` — repo invariants: flush-before-preempt-raise,
+  metric/event catalogs, env-overridable config knobs (KTI3xx);
+- :mod:`suppress` — ``suppressions.toml`` + inline ``# katib-check:
+  ignore[RULE]`` handling;
+- :mod:`lockgraph` — the dynamic half: an opt-in
+  (``KATIB_TPU_LOCKCHECK=1``) instrumented-lock wrapper recording the
+  cross-thread lock-acquisition-order graph and reporting cycles
+  (potential deadlocks).
+
+A tier-1 test (tests/test_static_analysis.py) runs the analyzer over
+``katib_tpu/`` and fails on any non-suppressed finding, so every future PR
+is checked automatically.
+"""
+
+# Lazy re-exports: `python -m katib_tpu.analysis.engine` must not find the
+# engine pre-imported by its own package __init__ (runpy would warn), and
+# importing lockgraph must stay cheap for the env-gated controller hook.
+_EXPORTS = ("Finding", "check_paths", "check_source", "main")
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        from . import engine
+
+        return getattr(engine, name)
+    raise AttributeError(name)

@@ -1,0 +1,178 @@
+"""Shared AST plumbing for the katib-tpu check rules.
+
+Every rule works on plain ``ast`` trees — the analyzer never imports the
+code it checks (so it runs in milliseconds and can't be wedged by a JAX
+backend probe). Helpers here answer the questions every rule family asks:
+"what does this call resolve to", "am I inside a loop / a with-lock block",
+"is this expression rooted in jnp/jax".
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, sortable into the stable (path, line, rule)
+    order the CLI emits — CI log diffs between runs must be meaningful."""
+
+    path: str   # repo-relative, forward slashes
+    line: int
+    rule: str   # e.g. "KTL201"
+    message: str
+
+    def sort_key(self) -> Tuple[str, int, str, str]:
+        return (self.path, self.line, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+@dataclass
+class RuleContext:
+    """What a rule may consult beyond the file's own AST."""
+
+    path: str                       # repo-relative posix path of the file
+    hot_path: bool = False          # models/ ops/ suggest/ runtime/packed.py
+    # catalogs parsed from controller/events.py; None disables the rule
+    # (fixture tests inject their own)
+    metric_catalog: Optional[Set[str]] = None
+    event_catalog: Optional[Set[str]] = None
+    # module-level string constants of the file being checked (NAME = "str")
+    constants: dict = field(default_factory=dict)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.jit`` -> "jax.jit", ``a.b.c`` -> "a.b.c", bare names too;
+    None for anything not a plain attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit", "jax.experimental.pjit.pjit"}
+
+
+def is_jit_call(node: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``pjit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(node.func)
+    if name in JIT_NAMES:
+        return True
+    if name in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in JIT_NAMES
+    return False
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        return is_jit_call(dec)
+    return dotted_name(dec) in JIT_NAMES
+
+
+def jnp_rooted(node: ast.AST) -> bool:
+    """Does this expression mention jnp/jax (a device value, so converting
+    it to host is a sync)? Plain names are NOT treated as device values —
+    ``float(s.get("lr"))`` parses a string, not a DeviceArray."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax", "lax"):
+            return True
+    return False
+
+
+def literal_str(node: ast.AST, constants: Optional[dict] = None) -> Optional[str]:
+    """A string literal, or a Name resolving to a module-level string
+    constant (telemetry.py's STALLED_TOTAL_METRIC pattern); None for
+    anything dynamic (f-strings, attribute lookups)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name) and constants:
+        v = constants.get(node.id)
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def module_constants(tree: ast.Module) -> dict:
+    """Top-level ``NAME = "literal"`` assignments of a module."""
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                out[t.id] = node.value.value
+    return out
+
+
+def walk_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    """Every FunctionDef/AsyncFunctionDef in the tree, outermost first."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def enclosing_loops(func: ast.AST) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+    """Yield (loop_node, body_statements) for every for/while loop directly
+    inside this function (nested loops included), WITHOUT descending into
+    nested function definitions — their loops belong to the inner scope."""
+
+    def _walk(stmts: Sequence[ast.stmt]) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                yield stmt, list(stmt.body) + list(stmt.orelse)
+                yield from _walk(stmt.body)
+                yield from _walk(stmt.orelse)
+                continue
+            for attr in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, attr, None)
+                if not sub:
+                    continue
+                if attr == "handlers":
+                    for h in sub:
+                        yield from _walk(h.body)
+                else:
+                    yield from _walk(sub)
+
+    body = getattr(func, "body", [])
+    yield from _walk(body)
+
+
+def statements_in(stmts: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """Flatten a statement list, recursing through control flow but NOT into
+    nested function/class definitions."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                yield from statements_in(sub)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from statements_in(h.body)
+
+
+LOCKISH = ("lock", "cv", "cond", "mutex")
+
+
+def is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return any(part in low for part in LOCKISH)

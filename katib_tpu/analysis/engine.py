@@ -1,0 +1,277 @@
+"""The ``katib-tpu check`` engine: walk files, run rules, report.
+
+Pure-AST: no katib_tpu module is imported, no JAX backend is touched, so a
+full-tree pass stays well under a second (bench.py check_latency measures
+it). Output is deterministically sorted by (path, line, rule, message) in
+both formats so CI log diffs between runs are meaningful.
+
+Usage (also via ``katib-tpu check``):
+
+    python -m katib_tpu.analysis.engine [paths...] [--format text|json]
+        [--baseline] [--no-suppressions]
+
+Exit codes: 0 clean, 1 findings, 2 bad usage / unreadable suppressions.
+
+``--baseline`` records the current non-suppressed findings into
+``analysis/baseline.json``; subsequent runs subtract entries matching
+(path, rule, line). It exists for adopting the checker on a dirty tree —
+prefer fixing or a reasoned suppressions.toml entry for anything meant to
+stay.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import rules_invariants, rules_locks, rules_recompile
+from .common import Finding, RuleContext, module_constants
+from .suppress import (
+    Suppression,
+    SuppressionError,
+    apply_suppressions,
+    parse_suppressions_toml,
+)
+
+Finding = Finding  # re-export for `from .engine import Finding`
+
+# modules whose loops are the trial fast path (KTC104/KTC105 scope)
+HOT_PATH_DIRS = ("katib_tpu/models/", "katib_tpu/ops/", "katib_tpu/suggest/")
+HOT_PATH_FILES = ("katib_tpu/runtime/packed.py",)
+
+EVENTS_PY = os.path.join("katib_tpu", "controller", "events.py")
+SUPPRESSIONS_TOML = os.path.join("katib_tpu", "analysis", "suppressions.toml")
+BASELINE_JSON = os.path.join("katib_tpu", "analysis", "baseline.json")
+
+RULE_MODULES = (rules_recompile, rules_locks, rules_invariants)
+
+
+def repo_relative(path: str, repo_root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(repo_root))
+    return rel.replace(os.sep, "/")
+
+
+def is_hot_path(rel_path: str) -> bool:
+    return rel_path in HOT_PATH_FILES or any(
+        rel_path.startswith(d) for d in HOT_PATH_DIRS
+    )
+
+
+def _dict_literal_keys(tree: ast.Module, name: str) -> Optional[Set[str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == name
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    return {
+                        k.value
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    }
+    return None
+
+
+def load_catalogs(repo_root: str) -> Tuple[Optional[Set[str]], Optional[Set[str]]]:
+    """(metric catalog, event catalog) from controller/events.py; (None,
+    None) when the file is missing (fixture runs) — which disables KTI302
+    rather than flooding."""
+    path = os.path.join(repo_root, EVENTS_PY)
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None, None
+    metric = _dict_literal_keys(tree, "_HELP_CATALOG")
+    event = _dict_literal_keys(tree, "EVENT_CATALOG")
+    if metric is not None:
+        # histogram families implicitly expose _bucket/_sum/_count series
+        metric = set(metric)
+    return metric, event
+
+
+def check_source(
+    src: str,
+    path: str = "<string>",
+    hot_path: Optional[bool] = None,
+    metric_catalog: Optional[Set[str]] = None,
+    event_catalog: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run every rule over one source blob — the unit-test entry point.
+    A syntax error yields a single KT000 finding instead of raising."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "KT000", f"syntax error: {e.msg}")]
+    ctx = RuleContext(
+        path=path,
+        hot_path=is_hot_path(path) if hot_path is None else hot_path,
+        metric_catalog=metric_catalog,
+        event_catalog=event_catalog,
+        constants=module_constants(tree),
+    )
+    findings: List[Finding] = []
+    for mod in RULE_MODULES:
+        findings += mod.check(tree, ctx)
+    return sorted(set(findings), key=Finding.sort_key)
+
+
+def discover_files(paths: Sequence[str], repo_root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames.sort()
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def check_paths(
+    paths: Sequence[str],
+    repo_root: Optional[str] = None,
+    use_suppressions: bool = True,
+    use_baseline: bool = True,
+) -> "tuple[List[Finding], dict]":
+    """Analyze files/dirs; returns (kept findings, stats). Findings are
+    already suppression- and baseline-filtered and stably sorted."""
+    repo_root = repo_root or default_repo_root()
+    files = discover_files(paths, repo_root)
+    metric_catalog, event_catalog = load_catalogs(repo_root)
+    findings: List[Finding] = []
+    sources: Dict[str, List[str]] = {}
+    n_errors = 0
+    for fp in files:
+        rel = repo_relative(fp, repo_root)
+        try:
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            n_errors += 1
+            continue
+        sources[rel] = src.splitlines()
+        found = check_source(
+            src, rel,
+            metric_catalog=metric_catalog, event_catalog=event_catalog,
+        )
+        findings += found
+    suppressions: List[Suppression] = []
+    if use_suppressions:
+        sup_path = os.path.join(repo_root, SUPPRESSIONS_TOML)
+        if os.path.exists(sup_path):
+            with open(sup_path) as f:
+                suppressions = parse_suppressions_toml(
+                    f.read(), source=repo_relative(sup_path, repo_root)
+                )
+    kept, n_suppressed = apply_suppressions(findings, suppressions, sources)
+    n_baselined = 0
+    if use_baseline:
+        base = _load_baseline(repo_root)
+        if base:
+            before = len(kept)
+            kept = [f for f in kept if (f.path, f.rule, f.line) not in base]
+            n_baselined = before - len(kept)
+    kept = sorted(kept, key=Finding.sort_key)
+    stats = {
+        "files": len(files),
+        "findings": len(kept),
+        "suppressed": n_suppressed,
+        "baselined": n_baselined,
+        "read_errors": n_errors,
+    }
+    return kept, stats
+
+
+def default_repo_root() -> str:
+    """The tree containing this installed/checked-out katib_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_baseline(repo_root: str) -> Set[Tuple[str, str, int]]:
+    path = os.path.join(repo_root, BASELINE_JSON)
+    if not os.path.exists(path):
+        return set()
+    try:
+        with open(path) as f:
+            entries = json.load(f)
+        return {(e["path"], e["rule"], int(e["line"])) for e in entries}
+    except (OSError, ValueError, KeyError, TypeError):
+        return set()
+
+
+def write_baseline(findings: List[Finding], repo_root: str) -> str:
+    path = os.path.join(repo_root, BASELINE_JSON)
+    with open(path, "w") as f:
+        json.dump([f2.to_dict() for f2 in findings], f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def format_text(findings: List[Finding], stats: dict) -> str:
+    lines = [f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings]
+    lines.append(
+        f"katib-tpu check: {stats['findings']} finding(s) in "
+        f"{stats['files']} file(s) "
+        f"({stats['suppressed']} suppressed, {stats['baselined']} baselined)"
+    )
+    return "\n".join(lines)
+
+
+def format_json(findings: List[Finding], stats: dict) -> str:
+    # stable key order + stable finding order: byte-identical across runs
+    return json.dumps(
+        {"findings": [f.to_dict() for f in findings], "stats": stats},
+        indent=2, sort_keys=True,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="katib-tpu check",
+        description="recompile-hazard, lock-discipline and repo-invariant "
+        "static analysis (docs/static-analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to analyze (default: katib_tpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", action="store_true",
+                   help="record current findings into analysis/baseline.json "
+                        "and exit 0; later runs subtract them")
+    p.add_argument("--no-suppressions", action="store_true",
+                   help="ignore suppressions.toml and inline ignores")
+    p.add_argument("--repo-root", default=None)
+    args = p.parse_args(argv)
+
+    repo_root = args.repo_root or default_repo_root()
+    paths = args.paths or ["katib_tpu"]
+    try:
+        findings, stats = check_paths(
+            paths, repo_root,
+            use_suppressions=not args.no_suppressions,
+            use_baseline=not args.baseline,
+        )
+    except SuppressionError as e:
+        print(f"katib-tpu check: {e}", file=sys.stderr)
+        return 2
+    if args.baseline:
+        path = write_baseline(findings, repo_root)
+        print(f"baseline with {len(findings)} finding(s) written to {path}")
+        return 0
+    print(format_text(findings, stats) if args.format == "text" else format_json(findings, stats))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,371 @@
+"""Dynamic lock-order race detector — the runtime half of katib-tpu check.
+
+Static rules (rules_locks.py) prove mutations happen under *a* lock; they
+cannot prove that two subsystems take *two* locks in a consistent order.
+With the scheduler lock, the obslog Condition + io-lock, the tracer ring
+lock, the sampler lock and the metrics-registry lock all live in one
+process, an A->B / B->A inversion between any pair is a latent deadlock
+that no amount of stress luck reliably surfaces.
+
+This module records the cross-thread **lock-acquisition-order graph**: an
+edge ``A -> B`` whenever a thread acquires B while holding A (locks are
+identified by their construction site, so all instances from one
+``self._lock = threading.Lock()`` line aggregate into one node). A cycle
+in that graph is a potential deadlock; a 2-cycle is the classic AB/BA
+inversion. Each edge remembers its first witness (thread name and the
+acquiring code line) so a report is actionable.
+
+Two ways in:
+
+- ``with lockgraph.instrument():`` — tests wrap a stress scenario; locks
+  (and Conditions) constructed inside the block are instrumented, and
+  ``assert_no_cycles()`` fails the test on any inversion. Used by
+  tests/test_scheduler_stress.py and the telemetry/obslog stress paths.
+- ``KATIB_TPU_LOCKCHECK=1`` — ``maybe_install_from_env()`` (called by
+  ExperimentController on construction) instruments the process
+  permanently and logs a warning with the cycle report at interpreter
+  exit. Overhead is one dict update per acquire; fine for staging, not
+  for a production hot path.
+
+Same-site edges (two instances born on the same line) are deliberately
+not recorded: a by-site graph cannot tell consistent from inconsistent
+instance ordering, and flagging every nested same-class acquisition would
+drown real inversions in noise.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import logging
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+log = logging.getLogger("katib_tpu.lockgraph")
+
+ENV_LOCKCHECK = "KATIB_TPU_LOCKCHECK"
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+
+def lockcheck_enabled_from_env(default: bool = False) -> bool:
+    raw = os.environ.get(ENV_LOCKCHECK)
+    if raw is None or raw == "":
+        return default
+    return raw.lower() not in ("0", "false", "off")
+
+
+class LockGraph:
+    """Thread-safe acquisition-order graph over lock construction sites."""
+
+    def __init__(self) -> None:
+        self._mu = _REAL_LOCK()  # never an instrumented lock
+        self.active = True
+        # edge (site_a, site_b) -> first witness {thread, at}
+        self._edges: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._nodes: Set[str] = set()
+        self._tls = threading.local()
+        self.acquisitions = 0
+
+    # -- recording (called from instrumented locks) --------------------------
+
+    def _held(self) -> List[Tuple[str, int]]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, site: str, instance: int) -> None:
+        if not self.active:
+            return
+        held = self._held()
+        if any(inst == instance for _, inst in held):
+            held.append((site, instance))  # reentrant: no new edges
+            return
+        frame = sys._getframe(1)
+        while frame is not None and frame.f_code.co_filename == __file__:
+            frame = frame.f_back
+        at = (
+            f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
+            if frame is not None
+            else "?"
+        )
+        with self._mu:
+            self.acquisitions += 1
+            self._nodes.add(site)
+            for held_site, _ in held:
+                if held_site != site:  # same-site: by-site graph can't judge
+                    edge = (held_site, site)
+                    if edge not in self._edges:
+                        self._edges[edge] = {
+                            "thread": threading.current_thread().name,
+                            "at": at,
+                        }
+        held.append((site, instance))
+
+    def note_release(self, site: str, instance: int) -> bool:
+        """Drop the newest held entry for this lock instance; False when the
+        instance was not held by this thread (e.g. Condition.wait on a
+        condition entered via its underlying mutex, the queue.Queue shape)."""
+        if not self.active:
+            return False
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == instance:
+                del held[i]
+                return True
+        return False
+
+    # -- analysis ------------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], Dict[str, str]]:
+        with self._mu:
+            return dict(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary inversion, as node lists [a, b, ..., a]. DFS
+        with an on-stack set; graphs here are tiny (tens of nodes)."""
+        edges = self.edges()
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        for dests in adj.values():
+            dests.sort()
+        found: List[List[str]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def _dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_stack:
+                    i = stack.index(nxt)
+                    cyc = stack[i:] + [nxt]
+                    # canonical rotation so each cycle reports once
+                    body = cyc[:-1]
+                    k = body.index(min(body))
+                    canon = tuple(body[k:] + body[:k])
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        found.append(list(canon) + [canon[0]])
+                    continue
+                stack.append(nxt)
+                on_stack.add(nxt)
+                _dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+        for start in sorted(adj):
+            _dfs(start, [start], {start})
+        return found
+
+    def report(self) -> dict:
+        edges = self.edges()
+        return {
+            "nodes": sorted(self._nodes),
+            "acquisitions": self.acquisitions,
+            "edges": [
+                {"from": a, "to": b, **w} for (a, b), w in sorted(edges.items())
+            ],
+            "cycles": self.cycles(),
+        }
+
+    def assert_no_cycles(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            raise AssertionError(
+                "lock-order cycles detected (potential deadlock):\n"
+                + "\n".join("  " + " -> ".join(c) for c in cycles)
+                + f"\nfull report: {self.report()}"
+            )
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._nodes.clear()
+            self.acquisitions = 0
+
+
+GRAPH = LockGraph()
+GRAPH.active = False  # recording only while instrumented/installed
+
+
+def _creation_site(depth: int = 2) -> str:
+    frame = sys._getframe(depth)
+    return f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
+
+
+class InstrumentedLock:
+    """threading.Lock stand-in that reports to the global LockGraph. Keeps
+    the real lock's semantics (including Condition's duck-typed use of
+    acquire/release) and degrades to pass-through when recording stops."""
+
+    __slots__ = ("_real", "_site", "_graph")
+
+    def __init__(self, real, site: str, graph: LockGraph):
+        self._real = real
+        self._site = site
+        self._graph = graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._graph.note_acquire(self._site, id(self))
+        return ok
+
+    def release(self) -> None:
+        self._graph.note_release(self._site, id(self))
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<InstrumentedLock {self._site} {self._real!r}>"
+
+
+class InstrumentedRLock(InstrumentedLock):
+    """RLock variant — also delegates the private protocol Condition uses
+    when handed an RLock explicitly."""
+
+    __slots__ = ()
+
+    def _is_owned(self):
+        return self._real._is_owned()
+
+    def _release_save(self):
+        self._graph.note_release(self._site, id(self))
+        return self._real._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._real._acquire_restore(state)
+        self._graph.note_acquire(self._site, id(self))
+
+
+class InstrumentedCondition(_REAL_CONDITION):
+    """Condition whose lock operations feed the graph. wait() releases and
+    reacquires the underlying lock — mirrored so held-stacks stay true."""
+
+    def __init__(self, lock=None):
+        # default to a REAL RLock: letting Condition call the patched
+        # threading.RLock would double-record every wait/notify under a
+        # synthetic threading.py node
+        super().__init__(lock if lock is not None else _REAL_RLOCK())
+        self._kt_site = _creation_site()
+
+    def __enter__(self):
+        r = super().__enter__()
+        GRAPH.note_acquire(self._kt_site, id(self))
+        return r
+
+    def __exit__(self, *exc):
+        GRAPH.note_release(self._kt_site, id(self))
+        return super().__exit__(*exc)
+
+    def acquire(self, *a, **kw):
+        ok = super().acquire(*a, **kw)
+        if ok:
+            GRAPH.note_acquire(self._kt_site, id(self))
+        return ok
+
+    def release(self) -> None:
+        GRAPH.note_release(self._kt_site, id(self))
+        super().release()
+
+    def wait(self, timeout: Optional[float] = None):
+        # only re-note after the wait if THIS wrapper was the held entry —
+        # code that entered via the underlying mutex (queue.Queue) has its
+        # bookkeeping on the mutex's own instrumented release/acquire
+        was_held = GRAPH.note_release(self._kt_site, id(self))
+        try:
+            return super().wait(timeout)
+        finally:
+            if was_held:
+                GRAPH.note_acquire(self._kt_site, id(self))
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # built on wait(); bookkeeping happens there
+        return super().wait_for(predicate, timeout)
+
+
+def _lock_factory():
+    return InstrumentedLock(_REAL_LOCK(), _creation_site(), GRAPH)
+
+
+def _rlock_factory():
+    return InstrumentedRLock(_REAL_RLOCK(), _creation_site(), GRAPH)
+
+
+_installed = False
+
+
+def _patch() -> None:
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = InstrumentedCondition
+    GRAPH.active = True
+
+
+def _unpatch() -> None:
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    GRAPH.active = False
+
+
+@contextlib.contextmanager
+def instrument(reset: bool = True):
+    """Instrument lock construction inside the block and yield the graph.
+    Locks that outlive the block keep working (pass-through once
+    ``GRAPH.active`` drops). Not reentrant with install()."""
+    if reset:
+        GRAPH.reset()
+    _patch()
+    try:
+        yield GRAPH
+    finally:
+        _unpatch()
+
+
+def install() -> LockGraph:
+    """Instrument permanently (process-wide) and report at exit."""
+    global _installed
+    if _installed:
+        return GRAPH
+    _installed = True
+    _patch()
+
+    def _report() -> None:
+        GRAPH.active = False
+        cycles = GRAPH.cycles()
+        if cycles:
+            log.warning(
+                "lock-order cycles detected during this run: %s",
+                ["->".join(c) for c in cycles],
+            )
+        else:
+            log.info(
+                "lockcheck: %d acquisitions over %d lock sites, no cycles",
+                GRAPH.acquisitions, len(GRAPH.report()["nodes"]),
+            )
+
+    atexit.register(_report)
+    return GRAPH
+
+
+def maybe_install_from_env() -> Optional[LockGraph]:
+    """KATIB_TPU_LOCKCHECK=1 opt-in; called by ExperimentController before
+    it constructs the locked subsystems so their locks are instrumented."""
+    if lockcheck_enabled_from_env():
+        return install()
+    return None

@@ -1,0 +1,282 @@
+"""Lock-discipline rules for threaded classes (KTL2xx).
+
+The controller runs at least four daemon threads besides the per-trial
+workers (scheduler dispatch, obslog flusher, ResourceSampler tick,
+heartbeat bookkeeping). Their shared state lives in dict/list/deque/set
+attributes of classes that create their own ``self._lock`` — and until
+this pass, holding the lock around mutations was enforced only by
+convention (and docstring markers like "caller holds the scheduler
+lock"). These rules make the conventions machine-checked:
+
+- **KTL201 unlocked-shared-mutation** — inside a class that constructs a
+  ``threading.Lock/RLock/Condition`` in ``__init__``, a mutation of a
+  shared container attribute (one initialized to a dict/list/set/deque in
+  ``__init__``) outside any ``with self._lock``-style block. Mutations are
+  subscript stores/deletes, augmented assigns, and the mutating method
+  calls (append/pop/update/...). Exempt by existing repo convention:
+  ``__init__`` itself (no concurrency yet), methods named ``*_locked``,
+  and methods whose docstring says "caller holds" (the documented
+  lock-transfer idiom) — the rule VERIFIES the convention is declared, not
+  that every caller honors it; the dynamic lockgraph covers the rest.
+- **KTL202 bare-acquire** — ``<lockish>.acquire()`` as a statement outside
+  a ``try`` whose ``finally`` releases: an exception between acquire and
+  release deadlocks every other thread. Use ``with`` (or try/finally).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .common import Finding, RuleContext, dotted_name, is_lockish_name
+
+MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "pop", "popitem", "popleft", "remove", "discard", "clear",
+    "setdefault", "move_to_end",
+}
+
+CONTAINER_CTORS = {
+    "dict", "list", "set", "collections.deque", "deque",
+    "collections.OrderedDict", "OrderedDict", "collections.defaultdict",
+    "defaultdict", "queue.Queue",
+}
+
+LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+
+def check(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out += _check_class(node, ctx)
+    out += _bare_acquire(tree, ctx)
+    return sorted(set(out), key=Finding.sort_key)
+
+
+# -- KTL201 ------------------------------------------------------------------
+
+def _self_attr_assigns(init: ast.FunctionDef):
+    """Yield (attr_name, value_node) for ``self.X = <expr>`` in __init__."""
+    for stmt in ast.walk(init):
+        targets: Sequence[ast.AST] = ()
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                yield t.attr, value
+
+
+def _is_container_ctor(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name in CONTAINER_CTORS:
+            return True
+        # collections.deque(maxlen=...) behind a conditional etc.
+    return False
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    return isinstance(value, ast.Call) and dotted_name(value.func) in LOCK_CTORS
+
+
+def _caller_holds_exempt(func: ast.FunctionDef) -> bool:
+    if func.name == "__init__" or func.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(func) or ""
+    low = " ".join(doc.lower().split())
+    return "caller holds" in low or "holds the scheduler lock" in low
+
+
+class _LockScopeVisitor(ast.NodeVisitor):
+    """Walk one method tracking the with-self-lock depth; record mutations
+    of guarded attrs seen at depth 0."""
+
+    def __init__(self, guarded: Set[str], lock_attrs: Set[str], path: str):
+        self.guarded = guarded
+        self.lock_attrs = lock_attrs
+        self.path = path
+        self.depth = 0
+        self.findings: List[Finding] = []
+
+    def _is_lock_cm(self, item: ast.withitem) -> bool:
+        expr = item.context_expr
+        # with self._lock:  /  with self._cv:  /  with lock:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and (
+                expr.attr in self.lock_attrs or is_lockish_name(expr.attr)
+            ):
+                return True
+        if isinstance(expr, ast.Name) and is_lockish_name(expr.id):
+            return True
+        # with self._cv: wait_for / condition helpers
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._is_lock_cm(i) for i in node.items)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    # do not descend into nested defs — they execute later, on other stacks
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _guarded_target(self, node: ast.AST) -> Optional[str]:
+        """self.X[...] or self.X where X is a guarded container."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.guarded
+        ):
+            return node.attr
+        return None
+
+    def _flag(self, attr: str, lineno: int, what: str) -> None:
+        if self.depth == 0:
+            self.findings.append(
+                Finding(
+                    self.path, lineno, "KTL201",
+                    f"{what} of shared attribute self.{attr} outside a "
+                    "'with self._lock' block — lock it, mark the method "
+                    "'caller holds the lock', or add a reviewed suppression",
+                )
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Subscript):
+                    attr = self._guarded_target(sub)
+                    if attr:
+                        self._flag(attr, node.lineno, "subscript store")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        attr = self._guarded_target(node.target)
+        if attr:
+            self._flag(attr, node.lineno, "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            attr = self._guarded_target(t)
+            if attr:
+                self._flag(attr, node.lineno, "delete")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS:
+            attr = self._guarded_target(f.value)
+            if attr:
+                self._flag(attr, node.lineno, f".{f.attr}()")
+        self.generic_visit(node)
+
+
+def _check_class(cls: ast.ClassDef, ctx: RuleContext) -> List[Finding]:
+    init = next(
+        (
+            n for n in cls.body
+            if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+        ),
+        None,
+    )
+    if init is None:
+        return []
+    lock_attrs: Set[str] = set()
+    guarded: Set[str] = set()
+    for attr, value in _self_attr_assigns(init):
+        if value is None:
+            continue
+        if _is_lock_ctor(value):
+            lock_attrs.add(attr)
+        elif _is_container_ctor(value):
+            guarded.add(attr)
+    if not lock_attrs or not guarded:
+        return []
+    out: List[Finding] = []
+    for method in cls.body:
+        if not isinstance(method, ast.FunctionDef) or _caller_holds_exempt(method):
+            continue
+        v = _LockScopeVisitor(guarded, lock_attrs, ctx.path)
+        for stmt in method.body:
+            v.visit(stmt)
+        out += v.findings
+    return out
+
+
+# -- KTL202 ------------------------------------------------------------------
+
+def _bare_acquire(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+
+    def _receiver_lockish(call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "acquire"):
+            return False
+        base = f.value
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        return is_lockish_name(name)
+
+    def _try_releases(try_node: ast.Try) -> bool:
+        for stmt in try_node.finalbody:
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "release"
+                ):
+                    return True
+        return False
+
+    protected_lines: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try) and _try_releases(node):
+            # acquire immediately BEFORE the try (the canonical idiom) or as
+            # the first statement inside it both count as protected
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    protected_lines.add(sub.lineno)
+    # an acquire on the line just above a protecting try is the canonical
+    # "acquire(); try: ... finally: release()" shape — collect try linenos
+    try_starts = {
+        n.lineno for n in ast.walk(tree)
+        if isinstance(n, ast.Try) and _try_releases(n)
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _receiver_lockish(node):
+            if node.lineno in protected_lines:
+                continue
+            if any(0 < t - node.lineno <= 2 for t in try_starts):
+                continue
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, "KTL202",
+                    "bare .acquire() without a try/finally release — an "
+                    "exception in between deadlocks every other thread; use "
+                    "'with lock:' or acquire();try:...finally:release()",
+                )
+            )
+    return out

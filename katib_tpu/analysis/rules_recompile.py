@@ -1,0 +1,266 @@
+"""Recompile- and host-sync-hazard rules (KTC1xx).
+
+BENCH_r02/r04 measured the DARTS e2e as compile-dominated: 23-51s of XLA
+compile against ~2ms steps. At that ratio one accidental retrace costs more
+than ten thousand steps, and one ``float()`` on a device value inside a
+step loop serializes the host against the device every iteration. These
+rules keep new hazards out of the hot paths:
+
+- **KTC101 jit-in-loop** — a ``jax.jit`` / ``pjit`` / ``partial(jax.jit,
+  ...)`` wrapper created inside a ``for``/``while`` loop: every iteration
+  builds a fresh callable, so jit's trace cache (keyed on function
+  identity) misses every time.
+- **KTC102 traced-branch** — Python ``if``/``while`` on a traced parameter
+  inside a jitted function: either a TracerBoolConversionError at runtime
+  or, for a concrete value, a silent retrace per distinct value. Branch on
+  ``jnp.where``/``lax.cond``, or mark the argument static.
+- **KTC103 nonhashable-static** — ``static_argnums``/``static_argnames``
+  given a list/set/dict/comprehension. jit hashes static arguments into
+  the cache key; an unhashable spec (or one rebuilt per call) defeats the
+  cache or raises at trace time. Use int/str/tuple literals.
+- **KTC104 host-sync-in-loop** (hot paths only) — ``float(<jnp expr>)``,
+  ``np.asarray/np.array(<jnp expr>)``, ``.item()``, ``.block_until_ready()``
+  inside a loop whose body has no report boundary. Syncing at the report
+  boundary (the loop also calls ``*.report`` / ``report_population`` /
+  ``print``) is the designed place to materialize metrics; syncing
+  mid-step stalls the dispatch pipeline.
+- **KTC105 jit-then-call** (hot paths only) — ``jax.jit(...)(args)``:
+  the freshly created wrapper is called once and dropped, so the NEXT call
+  re-traces and re-compiles from scratch. Hoist the jitted callable (or
+  cache it, see utils/modelinit.jitted_init) and call the cached object.
+
+Hot paths are ``models/``, ``ops/``, ``suggest/``, ``runtime/packed.py``
+(katib_tpu/analysis/engine.py HOT_PATH_*): the modules whose loops run on
+the trial fast path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .common import (
+    Finding,
+    RuleContext,
+    dotted_name,
+    enclosing_loops,
+    is_jit_call,
+    is_jit_decorator,
+    jnp_rooted,
+    walk_functions,
+)
+
+HOST_SYNC_METHODS = ("item", "block_until_ready")
+REPORT_BOUNDARY_FUNCS = ("report_population", "print", "report_metrics")
+
+
+def check(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    out += _jit_in_loop(tree, ctx)
+    out += _traced_branch(tree, ctx)
+    out += _nonhashable_static(tree, ctx)
+    if ctx.hot_path:
+        out += _host_sync_in_loop(tree, ctx)
+        out += _jit_then_call(tree, ctx)
+    return out
+
+
+# -- KTC101 ------------------------------------------------------------------
+
+def _jit_in_loop(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for func in list(walk_functions(tree)) + [tree]:
+        for _loop, body in enclosing_loops(func):
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) and is_jit_call(node):
+                        out.append(
+                            Finding(
+                                ctx.path, node.lineno, "KTC101",
+                                "jit/pjit wrapper created inside a loop — "
+                                "every iteration re-traces and re-compiles; "
+                                "hoist the jitted callable out of the loop",
+                            )
+                        )
+    return _dedup(out)
+
+
+# -- KTC102 ------------------------------------------------------------------
+
+def _jitted_defs(tree: ast.Module):
+    """(funcdef, static_param_names) for functions that run under jit:
+    decorated with @jax.jit/@pjit/@partial(jax.jit, ...), or a local def
+    passed by name to a jax.jit(...) / jax.jit(jax.vmap(...)) call."""
+    defs = {f.name: f for f in walk_functions(tree) if isinstance(f, ast.FunctionDef)}
+    jitted = {}
+    for f in defs.values():
+        for dec in f.decorator_list:
+            if is_jit_decorator(dec):
+                jitted[f.name] = (f, _static_params(dec, f))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and is_jit_call(node)) or not node.args:
+            continue
+        target = node.args[0]
+        # unwrap jax.vmap(name) / functools.partial(jax.jit, ...) has no target
+        if isinstance(target, ast.Call) and dotted_name(target.func) in (
+            "jax.vmap", "vmap"
+        ) and target.args:
+            target = target.args[0]
+        if isinstance(target, ast.Name) and target.id in defs and target.id not in jitted:
+            jitted[target.id] = (defs[target.id], _static_params(node, defs[target.id]))
+    return jitted.values()
+
+
+def _static_params(call_or_dec: ast.AST, func: ast.FunctionDef) -> Set[str]:
+    """Parameter names excluded from tracing by static_argnums/argnames."""
+    static: Set[str] = set()
+    if not isinstance(call_or_dec, ast.Call):
+        return static
+    params = [a.arg for a in func.args.posonlyargs + func.args.args]
+    for kw in call_or_dec.keywords:
+        val = kw.value
+        if kw.arg == "static_argnames":
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    static.add(sub.value)
+        elif kw.arg == "static_argnums":
+            nums = []
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                    nums.append(sub.value)
+            for n in nums:
+                if 0 <= n < len(params):
+                    static.add(params[n])
+    return static
+
+
+def _traced_branch(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for func, static in _jitted_defs(tree):
+        traced = {
+            a.arg
+            for a in func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+        } - static - {"self"}
+        for stmt in ast.walk(func):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            names = {
+                n.id for n in ast.walk(stmt.test) if isinstance(n, ast.Name)
+            }
+            hit = sorted(names & traced)
+            if hit:
+                out.append(
+                    Finding(
+                        ctx.path, stmt.lineno, "KTC102",
+                        f"Python {'if' if isinstance(stmt, ast.If) else 'while'} "
+                        f"on traced value(s) {', '.join(hit)} inside jitted "
+                        f"function {func.name!r} — use jnp.where/lax.cond, or "
+                        "mark the argument static",
+                    )
+                )
+    return _dedup(out)
+
+
+# -- KTC103 ------------------------------------------------------------------
+
+_UNHASHABLE = (ast.List, ast.Set, ast.Dict, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _nonhashable_static(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("static_argnums", "static_argnames") and isinstance(
+                kw.value, _UNHASHABLE
+            ):
+                out.append(
+                    Finding(
+                        ctx.path, kw.value.lineno, "KTC103",
+                        f"{kw.arg} given a non-hashable "
+                        f"{type(kw.value).__name__.lower()} — jit hashes the "
+                        "static spec into its cache key; use an int/str or "
+                        "tuple literal",
+                    )
+                )
+    return _dedup(out)
+
+
+# -- KTC104 ------------------------------------------------------------------
+
+def _has_report_boundary(body: List[ast.AST]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "report":
+                return True
+            if dotted_name(node.func) in REPORT_BOUNDARY_FUNCS:
+                return True
+    return False
+
+
+def _host_sync_in_loop(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for func in walk_functions(tree):
+        if func.name.startswith("report"):
+            continue  # the report/demux plumbing IS the sync boundary
+        for _loop, body in enclosing_loops(func):
+            if _has_report_boundary(body):
+                continue
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    msg = None
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in HOST_SYNC_METHODS
+                        and not node.args
+                    ):
+                        msg = f".{node.func.attr}() host-syncs the device"
+                    else:
+                        name = dotted_name(node.func)
+                        if (
+                            name in ("float", "np.asarray", "np.array", "numpy.asarray", "numpy.array")
+                            and node.args
+                            and jnp_rooted(node.args[0])
+                        ):
+                            msg = f"{name}(...) on a jax value host-syncs the device"
+                    if msg:
+                        out.append(
+                            Finding(
+                                ctx.path, node.lineno, "KTC104",
+                                f"{msg} inside a step loop with no report "
+                                "boundary — hoist the sync to the report "
+                                "point or keep the value on-device",
+                            )
+                        )
+    return _dedup(out)
+
+
+# -- KTC105 ------------------------------------------------------------------
+
+def _jit_then_call(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Call)
+            and is_jit_call(node.func)
+        ):
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, "KTC105",
+                    "jit wrapper created and immediately called — the next "
+                    "call re-traces from scratch; bind the jitted callable "
+                    "once (module level or lru_cache) and call that",
+                )
+            )
+    return _dedup(out)
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    return sorted(set(findings), key=Finding.sort_key)

@@ -1,0 +1,135 @@
+"""Suppression handling for katib-tpu check.
+
+Two mechanisms, both requiring a visible reason:
+
+1. **Inline**: a ``# katib-check: ignore[KTL201]`` comment on the flagged
+   line (multiple rules comma-separated, ``ignore[*]`` for all). The rest
+   of the comment is the justification and lives next to the code.
+2. **File**: ``katib_tpu/analysis/suppressions.toml`` — reviewed
+   exceptions with rule, path, optional line, and a mandatory reason.
+   Parsed by the tiny reader below because the py3.10 image has no
+   tomllib/tomli; the reader supports exactly the subset the file uses —
+   ``[[suppression]]`` table arrays with ``key = "string"`` / integer /
+   boolean values and ``#`` comments. Anything fancier is a parse error,
+   loudly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .common import Finding
+
+_INLINE_RE = re.compile(r"#\s*katib-check:\s*ignore\[([A-Za-z0-9*,\s]+)\]")
+
+
+@dataclass(frozen=True)
+class Suppression:
+    rule: str                 # "KTL201" or "*"
+    path: str                 # repo-relative path, exact match
+    line: Optional[int] = None
+    reason: str = ""
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule not in ("*", f.rule):
+            return False
+        if self.path != f.path:
+            return False
+        return self.line is None or self.line == f.line
+
+
+class SuppressionError(ValueError):
+    """suppressions.toml failed to parse — the file is part of the checked
+    contract, so a malformed entry fails the run rather than silently
+    un-suppressing (or over-suppressing) findings."""
+
+
+def parse_suppressions_toml(text: str, source: str = "suppressions.toml") -> List[Suppression]:
+    out: List[Suppression] = []
+    current: Optional[Dict[str, object]] = None
+
+    def _flush() -> None:
+        nonlocal current
+        if current is None:
+            return
+        rule = current.get("rule")
+        path = current.get("path")
+        reason = current.get("reason")
+        if not isinstance(rule, str) or not isinstance(path, str):
+            raise SuppressionError(
+                f"{source}: a [[suppression]] needs string 'rule' and 'path'"
+            )
+        if not isinstance(reason, str) or not reason.strip():
+            raise SuppressionError(
+                f"{source}: suppression for {rule} at {path} has no 'reason' "
+                "— reviewed exceptions must say why"
+            )
+        line = current.get("line")
+        if line is not None and not isinstance(line, int):
+            raise SuppressionError(f"{source}: 'line' must be an integer")
+        out.append(Suppression(rule=rule, path=path, line=line, reason=reason))
+        current = None
+
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppression]]":
+            _flush()
+            current = {}
+            continue
+        m = re.match(r"^([A-Za-z_][A-Za-z0-9_-]*)\s*=\s*(.+?)\s*$", line)
+        if m is None or current is None:
+            raise SuppressionError(f"{source}:{n}: cannot parse {raw!r}")
+        key, val = m.group(1), m.group(2)
+        # strip trailing comments outside quotes
+        if val.startswith('"'):
+            m2 = re.match(r'^"((?:[^"\\]|\\.)*)"', val)
+            if m2 is None:
+                raise SuppressionError(f"{source}:{n}: unterminated string")
+            current[key] = m2.group(1).replace('\\"', '"').replace("\\\\", "\\")
+        elif val.split("#")[0].strip() in ("true", "false"):
+            current[key] = val.split("#")[0].strip() == "true"
+        else:
+            num = val.split("#")[0].strip()
+            try:
+                current[key] = int(num)
+            except ValueError:
+                raise SuppressionError(
+                    f"{source}:{n}: unsupported value {val!r} (string/int/bool only)"
+                ) from None
+    _flush()
+    return out
+
+
+def inline_suppressed(finding: Finding, source_lines: List[str]) -> bool:
+    """Is the flagged line annotated ``# katib-check: ignore[RULE]``?"""
+    idx = finding.line - 1
+    if not (0 <= idx < len(source_lines)):
+        return False
+    m = _INLINE_RE.search(source_lines[idx])
+    if m is None:
+        return False
+    rules = {r.strip() for r in m.group(1).split(",")}
+    return "*" in rules or finding.rule in rules
+
+
+def apply_suppressions(
+    findings: List[Finding],
+    suppressions: List[Suppression],
+    sources: Dict[str, List[str]],
+) -> "tuple[List[Finding], int]":
+    """(kept findings, number suppressed). ``sources`` maps repo-relative
+    path -> source lines for inline-comment lookup."""
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in findings:
+        if any(s.matches(f) for s in suppressions) or inline_suppressed(
+            f, sources.get(f.path, [])
+        ):
+            n_suppressed += 1
+            continue
+        kept.append(f)
+    return kept, n_suppressed

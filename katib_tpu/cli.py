@@ -24,6 +24,9 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            the persisted series under <root>/telemetry/)
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
+  check [paths]            recompile-hazard / lock-discipline / repo-invariant
+                           static analysis (docs/static-analysis.md); exits 1
+                           on non-suppressed findings
   ui                       serve the web dashboard + REST API
   serve                    run the suggestion/early-stopping/db-manager service
 
@@ -356,6 +359,22 @@ def cmd_algorithms(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """Static analysis over the tree (ISSUE 6 tentpole): recompile/host-sync
+    hazards, lock discipline, repo invariants. A thin shim — the engine owns
+    its own argparse so `python -m katib_tpu.analysis.engine` behaves
+    identically in CI."""
+    from .analysis.engine import main as check_main
+
+    forwarded = list(args.paths)
+    forwarded += ["--format", args.format]
+    if args.baseline:
+        forwarded.append("--baseline")
+    if args.no_suppressions:
+        forwarded.append("--no-suppressions")
+    return check_main(forwarded)
+
+
 def cmd_ui(args) -> int:
     from .ui.server import serve_ui
 
@@ -512,6 +531,20 @@ def main(argv=None) -> int:
     me.set_defaults(fn=cmd_metrics)
 
     sub.add_parser("algorithms", help="list registered algorithms").set_defaults(fn=cmd_algorithms)
+
+    ck = sub.add_parser(
+        "check",
+        help="static analysis: recompile hazards, lock discipline, repo "
+        "invariants (exit 1 on findings)",
+    )
+    ck.add_argument("paths", nargs="*", help="files/dirs (default: katib_tpu/)")
+    ck.add_argument("--format", choices=("text", "json"), default="text")
+    ck.add_argument(
+        "--baseline", action="store_true",
+        help="record current findings to analysis/baseline.json and exit 0",
+    )
+    ck.add_argument("--no-suppressions", action="store_true")
+    ck.set_defaults(fn=cmd_check)
 
     ui = sub.add_parser("ui", help="serve the web dashboard + REST API")
     ui.add_argument("--host", default="127.0.0.1")

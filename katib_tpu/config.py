@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -65,6 +66,56 @@ class RuntimeConfig:
     queue_stall_seconds: float = 120.0     # TrialQueueStalled warning threshold
     fairshare_aging_seconds: float = 60.0  # +1 effective priority per interval waited
     preemption_grace_seconds: float = 30.0  # preempt signal -> kill escalation
+
+
+# Every RuntimeConfig knob is overridable from the environment without
+# shipping a config file (reference: env trumps config, consts/const.go:
+# 93-103). The table is DECLARATIVE and complete by construction — the
+# KTI303 analyzer rule (katib_tpu/analysis) fails the build when a new
+# field lands without an entry. Names follow KATIB_TPU_<FIELD>; the two
+# historical exceptions keep their documented spellings.
+ENV_OVERRIDES: Dict[str, str] = {
+    "default_parallel_trial_count": "KATIB_TPU_DEFAULT_PARALLEL_TRIAL_COUNT",
+    "max_trial_restarts": "KATIB_TPU_MAX_TRIAL_RESTARTS",
+    "trial_timeout_seconds": "KATIB_TPU_TRIAL_TIMEOUT_SECONDS",
+    "obslog_backend": "KATIB_TPU_OBSLOG_BACKEND",
+    "obslog_buffered": "KATIB_TPU_OBSLOG_BUFFERED",
+    "obslog_buffer_rows": "KATIB_TPU_OBSLOG_BUFFER_ROWS",
+    "tracing": "KATIB_TPU_TRACING",
+    "trace_ring_spans": "KATIB_TPU_TRACE_RING_SPANS",
+    "telemetry": "KATIB_TPU_TELEMETRY",
+    "telemetry_interval_seconds": "KATIB_TPU_TELEMETRY_INTERVAL_SECONDS",
+    "telemetry_ring_samples": "KATIB_TPU_TELEMETRY_RING_SAMPLES",
+    "stall_seconds": "KATIB_TPU_STALL_SECONDS",
+    "oom_risk_fraction": "KATIB_TPU_OOM_RISK_FRACTION",
+    "xla_cache_dir": "KATIB_TPU_XLA_CACHE",  # historical spelling
+    "devices_per_host": "KATIB_TPU_DEVICES_PER_HOST",
+    "metrics_poll_interval": "KATIB_TPU_METRICS_POLL_INTERVAL",
+    "queue_stall_seconds": "KATIB_TPU_QUEUE_STALL_SECONDS",
+    "fairshare_aging_seconds": "KATIB_TPU_FAIRSHARE_AGING_SECONDS",
+    "preemption_grace_seconds": "KATIB_TPU_PREEMPTION_GRACE_SECONDS",
+}
+
+_FALSY = ("0", "false", "off")
+
+
+def _coerce_env(field_type: str, raw: str):
+    """Parse one env value per the dataclass field's annotation (a string —
+    this module uses postponed annotations). Returns (ok, value); a
+    malformed number is rejected so a typo'd env var keeps the default
+    loudly rather than crashing the controller at import."""
+    if "Optional" in field_type and raw.lower() in ("none", "null"):
+        return True, None
+    if "bool" in field_type:
+        return True, raw.lower() not in _FALSY
+    try:
+        if "int" in field_type:
+            return True, int(raw)
+        if "float" in field_type:
+            return True, float(raw)
+    except ValueError:
+        return False, None
+    return True, raw
 
 
 @dataclass
@@ -119,19 +170,19 @@ def load_config(path: Optional[str] = None) -> KatibConfig:
         with open(path) as f:
             cfg = KatibConfig.from_dict(json.load(f))
     # env overrides (reference: env vars trump config, consts/const.go:93-103)
-    env_backend = os.environ.get("KATIB_TPU_OBSLOG_BACKEND")
-    if env_backend:
-        cfg.runtime.obslog_backend = env_backend
-    env_buffered = os.environ.get("KATIB_TPU_OBSLOG_BUFFERED")
-    if env_buffered:
-        cfg.runtime.obslog_buffered = env_buffered.lower() not in ("0", "false", "off")
-    env_cache = os.environ.get("KATIB_TPU_XLA_CACHE")
-    if env_cache:
-        cfg.runtime.xla_cache_dir = env_cache
-    env_tracing = os.environ.get("KATIB_TPU_TRACING")
-    if env_tracing:
-        cfg.runtime.tracing = env_tracing.lower() not in ("0", "false", "off")
-    env_telemetry = os.environ.get("KATIB_TPU_TELEMETRY")
-    if env_telemetry:
-        cfg.runtime.telemetry = env_telemetry.lower() not in ("0", "false", "off")
+    # — driven entirely by the ENV_OVERRIDES table so every knob, present
+    # and future, has the same spelling and coercion rules
+    types = {f.name: str(f.type) for f in dataclasses.fields(RuntimeConfig)}
+    for field_name, env_name in ENV_OVERRIDES.items():
+        raw = os.environ.get(env_name)
+        if raw is None or raw == "" or field_name not in types:
+            continue
+        ok, value = _coerce_env(types[field_name], raw)
+        if ok:
+            setattr(cfg.runtime, field_name, value)
+        else:
+            logging.getLogger("katib_tpu.config").warning(
+                "ignoring malformed %s=%r (expected %s)",
+                env_name, raw, types[field_name],
+            )
     return cfg

@@ -197,19 +197,25 @@ class MetricsRegistry:
         Legacy single-collector surface: REPLACES every registered hook.
         Subsystems sharing one registry (controller status gauges + the
         telemetry sampler) use :meth:`add_collector` instead."""
-        self._collectors = [(fn, tuple(names))]
+        with self._lock:
+            self._collectors = [(fn, tuple(names))]
 
     def add_collector(self, fn, names: Tuple[str, ...] = ()) -> None:
         """Append a collector hook (same contract as set_collector); each
-        hook owns a disjoint set of gauge names."""
-        self._collectors.append((fn, tuple(names)))
+        hook owns a disjoint set of gauge names. Registration happens from
+        subsystem constructors on whatever thread builds them — locked, so a
+        concurrent scrape's hook iteration never sees a half-appended list."""
+        with self._lock:
+            self._collectors.append((fn, tuple(names)))
 
     def render(self) -> str:
         """Prometheus text exposition format."""
-        if self._collectors:
+        with self._lock:
+            collectors = list(self._collectors)
+        if collectors:
             merged: Dict = {}
             names: set = set()
-            for fn, owned in list(self._collectors):
+            for fn, owned in collectors:
                 try:
                     collected = fn()
                 except Exception:
@@ -328,3 +334,45 @@ _HELP_CATALOG: Dict[str, str] = {
 
 def _default_help(name: str, kind: str) -> str:
     return _HELP_CATALOG.get(name, f"katib-tpu {kind} {name}.")
+
+
+# Event-reason catalog: one operator-facing line per reason recorded through
+# EventRecorder.event (docs/static-analysis.md KTI302 — the analyzer fails
+# the build when a literal reason is emitted without an entry, so every
+# event surfaced in /api/events stays look-up-able). Reasons that reach the
+# recorder through dynamic sites (trial.current_reason in
+# scheduler._record_terminal, the experiment terminal reason in
+# experiment._on_completed) are cataloged here too for completeness.
+EVENT_CATALOG: Dict[str, str] = {
+    # experiment lifecycle
+    "ExperimentCreated": "Experiment admitted and persisted.",
+    "ExperimentGoalReached": "Objective goal met; experiment succeeded.",
+    "ExperimentMaxTrialsReached": "maxTrialCount trials finished; experiment succeeded.",
+    "ExperimentMaxFailedTrialsReached": "maxFailedTrialCount exceeded; experiment failed.",
+    "ExperimentSuggestionEndReached": "Suggestion algorithm reported search end.",
+    "ExperimentSuggestionFailed": "Suggestion service errored; experiment failed.",
+    "Succeeded": "Experiment terminal condition (no specific reason recorded).",
+    "Failed": "Experiment terminal condition (no specific reason recorded).",
+    # trial lifecycle
+    "TrialCreated": "Trial admitted to the scheduler queue.",
+    "TrialPending": "Trial waiting for its gang device allocation.",
+    "TrialRunning": "Trial dispatched onto devices.",
+    "TrialSucceeded": "Trial finished with the objective metric available.",
+    "TrialFailed": "Trial failed (non-zero exit, exception, or failure condition).",
+    "TrialKilled": "Trial killed by early stopping shrink, timeout escalation, or kill().",
+    "TrialEarlyStopped": "Early-stopping rules tripped; trial stopped.",
+    "MetricsUnavailable": "Trial finished without a usable objective metric.",
+    "DuplicateResultReused": "Identical-assignment result copied; workload not re-run.",
+    "TrialRestarting": "Failed trial requeued under max_trial_restarts.",
+    "TrialResubmitted": "In-flight trial requeued after a controller restart.",
+    "TrialLost": "Trial state lost across a controller restart; marked failed.",
+    "SchedulerShutdown": "Trial killed because the controller shut down (resumable).",
+    # scheduling / packing (PR 1-2)
+    "PackFormed": "Compatible trials merged into one vmapped program.",
+    "TrialDevicesClamped": "Gang request exceeded machine size; allocation clamped.",
+    "TrialPreempted": "Fair-share policy preempted the trial for higher-priority work.",
+    "TrialQueueStalled": "Trial pending past runtime.queue_stall_seconds.",
+    # telemetry watchdog (PR 5)
+    "TrialStalled": "No report() heartbeat past runtime.stall_seconds.",
+    "TrialOOMRisk": "Monotonic RSS growth past runtime.oom_risk_fraction of host memory.",
+}

@@ -64,8 +64,14 @@ class ExperimentController:
         persist: bool = True,
         config: Optional["KatibConfig"] = None,
     ):
+        from ..analysis.lockgraph import maybe_install_from_env
         from ..config import load_config
 
+        # KATIB_TPU_LOCKCHECK=1: instrument lock construction BEFORE the
+        # locked subsystems (scheduler, obslog, tracer, sampler) are built,
+        # so the dynamic lock-order detector sees every acquisition
+        # (analysis/lockgraph.py; cycle report logged at exit)
+        maybe_install_from_env()
         self.config = config if config is not None else load_config()
         rt = self.config.runtime
         if rt.xla_cache_dir:

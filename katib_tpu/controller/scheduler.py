@@ -222,7 +222,8 @@ class TrialScheduler:
         if self.recorder is not None:
             self.recorder.event(exp.name, "Trial", trial.name, "TrialCreated", "Trial is created")
         if checkpoint_dir:
-            self._checkpoint_dirs[trial.name] = checkpoint_dir
+            with self._lock:
+                self._checkpoint_dirs[trial.name] = checkpoint_dir
         elif (
             # the persisted label, not the transient checkpoint_dir arg: a
             # resumed lineage trial can be resubmitted with
@@ -407,8 +408,8 @@ class TrialScheduler:
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self._lock:
-                # snapshot: _run_trial's finally pops _handles without the
-                # lock, and get_trial yields the GIL mid-generator
+                # snapshot: _run_trial's finally pops _handles under its own
+                # lock stints, and get_trial yields the GIL mid-generator
                 handle_names = list(self._handles)
                 waiting = [t.experiment_name for _, t in self._waiting]
             busy = any(
@@ -837,11 +838,12 @@ class TrialScheduler:
                 self._quarantine(trial.name, devices, abandoned, exp, started)
             else:
                 self._release_allocation(exp, devices, started)
-            self._handles.pop(trial.name, None)
-            if not restarted and not requeued:
-                self._checkpoint_dirs.pop(trial.name, None)
-                self._restarts.pop(trial.name, None)
-                self._last_checkpoint.pop(trial.name, None)
+            with self._lock:
+                self._handles.pop(trial.name, None)
+                if not restarted and not requeued:
+                    self._checkpoint_dirs.pop(trial.name, None)
+                    self._restarts.pop(trial.name, None)
+                    self._last_checkpoint.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
 
@@ -962,9 +964,10 @@ class TrialScheduler:
                 restarted = self._maybe_restart(exp, trial, result)
                 if not restarted:
                     self._finalize(exp, trial, result, observation)
-                    self._checkpoint_dirs.pop(trial.name, None)
-                    self._restarts.pop(trial.name, None)
-                    self._last_checkpoint.pop(trial.name, None)
+                    with self._lock:
+                        self._checkpoint_dirs.pop(trial.name, None)
+                        self._restarts.pop(trial.name, None)
+                        self._last_checkpoint.pop(trial.name, None)
                 if gang is not None:
                     tr.end_span(
                         gang.members.get(trial.name), outcome=result.outcome.value
@@ -1003,8 +1006,10 @@ class TrialScheduler:
                 self._quarantine(pack_id, devices, abandoned, exp, started)
             else:
                 self._release_allocation(exp, devices, started)
+            with self._lock:
+                for t in trials:
+                    self._handles.pop(t.name, None)
             for t in trials:
-                self._handles.pop(t.name, None)
                 self.events.put(TrialEvent(exp.name, t.name, t.condition))
             self._dispatch()
 
@@ -1221,7 +1226,8 @@ class TrialScheduler:
         """ctx.checkpoint_store() save hook: victim selection prefers
         recently-checkpointed trials, and a preempted trial resumes (keeps
         its observation log) only if it checkpointed at all."""
-        self._last_checkpoint[trial_name] = time.time()
+        with self._lock:
+            self._last_checkpoint[trial_name] = time.time()
 
     def _preempt_applies(self, trial: Trial, result: ExecutionResult) -> bool:
         """Did this trial end because the fair-share policy preempted it?
@@ -1249,11 +1255,11 @@ class TrialScheduler:
         invariant as restart requeues)."""
         with self._lock:
             self._preempting.discard(trial.name)
+            has_checkpoint = trial.name in self._last_checkpoint
         # the cooperative exit already ran the reporter's flush barrier; this
         # covers the grace-window kill escalation, where the victim's last
         # report predates the preempt signal and may still sit in the buffer
         self.obs_store.flush()
-        has_checkpoint = trial.name in self._last_checkpoint
         if not has_checkpoint:
             self.obs_store.delete_observation_log(trial.name)
         trial.set_condition(
@@ -1351,10 +1357,11 @@ class TrialScheduler:
         (the reference leaves retries to the trial job's backoffLimit)."""
         if result.outcome != TrialOutcome.FAILED or not self.max_trial_restarts:
             return False
-        attempts = self._restarts.get(trial.name, 0)
-        if attempts >= self.max_trial_restarts:
-            return False
-        self._restarts[trial.name] = attempts + 1
+        with self._lock:
+            attempts = self._restarts.get(trial.name, 0)
+            if attempts >= self.max_trial_restarts:
+                return False
+            self._restarts[trial.name] = attempts + 1
         # drop the failed attempt's metrics so the next attempt's fold (and
         # its success/failure-condition classification) can't mix two
         # executions — same invariant as the requeue path in experiment.py

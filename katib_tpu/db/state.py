@@ -175,9 +175,11 @@ class ExperimentStateStore:
             if fn.endswith(".json"):
                 try:
                     with open(os.path.join(d, fn)) as f:
-                        self._templates[fn[:-5]] = json.load(f)
+                        template = json.load(f)
                 except (OSError, json.JSONDecodeError):
                     continue
+                with self._lock:
+                    self._templates[fn[:-5]] = template
 
     # -- persistence ---------------------------------------------------------
 

@@ -7,6 +7,7 @@ ctx.checkpoint_dir)."""
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 from typing import Dict
@@ -14,6 +15,7 @@ from typing import Dict
 import numpy as np
 
 _STEPS_PER_ROUND = 20
+_LR_PERIOD = 100
 
 
 def _optimal_lr(step: int, period: int = 100) -> float:
@@ -102,20 +104,8 @@ def run_pbt_trial_packed(assignments, ctx=None) -> None:
             else:
                 raise RuntimeError(msg)
 
-    period = 100
-
-    def member_round(lr_i, step0, score0):
-        def body(i, score):
-            step = step0 + i
-            phase = (step % period) / period
-            tri = jnp.where(phase < 0.5, 2.0 * phase, 2.0 * (1.0 - phase))
-            target = 0.02 * tri
-            return score + jnp.maximum(0.0, 1.0 - jnp.abs(lr_i - target) / 0.02) * 0.01
-
-        return jax.lax.fori_loop(0, _STEPS_PER_ROUND, body, score0)
-
     new_scores = np.asarray(
-        jax.jit(jax.vmap(member_round))(
+        _generation_program()(
             jnp.asarray(lr), jnp.asarray(steps, jnp.float32), jnp.asarray(scores)
         )
     )
@@ -128,6 +118,30 @@ def run_pbt_trial_packed(assignments, ctx=None) -> None:
             json.dump({"step": int(new_steps[i]), "score": float(new_scores[i])}, f)
 
     report_population(ctx, **{"Validation-accuracy": new_scores})
+
+
+@functools.lru_cache(maxsize=1)
+def _generation_program():
+    """The vmapped+jitted generation scorer, built once per process.
+    PBT calls run_pbt_trial_packed every generation; a jit wrapper created
+    inside it (the pre-ISSUE-6 shape, KTC105) re-traced and re-compiled the
+    identical program each time — the exact recompile hazard the analyzer
+    exists to catch. With a stable function identity, jit's cache serves
+    every generation (one compile per distinct pack size K)."""
+    import jax
+    import jax.numpy as jnp
+
+    def member_round(lr_i, step0, score0):
+        def body(i, score):
+            step = step0 + i
+            phase = (step % _LR_PERIOD) / _LR_PERIOD
+            tri = jnp.where(phase < 0.5, 2.0 * phase, 2.0 * (1.0 - phase))
+            target = 0.02 * tri
+            return score + jnp.maximum(0.0, 1.0 - jnp.abs(lr_i - target) / 0.02) * 0.01
+
+        return jax.lax.fori_loop(0, _STEPS_PER_ROUND, body, score0)
+
+    return jax.jit(jax.vmap(member_round))
 
 
 run_pbt_trial_packed.supports_packing = True

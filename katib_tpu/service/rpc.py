@@ -123,8 +123,13 @@ class ApiServicer:
 
     def set_trial_status(self, payload: Dict) -> Dict:
         """medianstop SetTrialStatus (service.py:193-247): mark EarlyStopped.
-        In-process orchestrators read trial_status_overrides."""
-        self.trial_status_overrides[payload["trialName"]] = TrialCondition.EARLY_STOPPED.value
+        In-process orchestrators read trial_status_overrides. gRPC handlers
+        run on a thread pool, so the shared override map is written under
+        the service lock like the suggester/stopper registries."""
+        with self._lock:
+            self.trial_status_overrides[payload["trialName"]] = (
+                TrialCondition.EARLY_STOPPED.value
+            )
         return {}
 
     # -- DBManager service (api.proto:13-31) ---------------------------------

@@ -573,6 +573,7 @@ class TraceContextFilter(logging.Filter):
 
 
 _installed_loggers: set = set()
+_installed_loggers_lock = threading.Lock()
 
 LOGGERS = (
     "katib_tpu.scheduler",
@@ -583,12 +584,15 @@ LOGGERS = (
 
 def install_log_context(*names: str) -> None:
     """Idempotently wire the context filter into the named loggers (default:
-    scheduler + executor + experiment)."""
-    for name in names or LOGGERS:
-        if name in _installed_loggers:
-            continue
-        _installed_loggers.add(name)
-        logging.getLogger(name).addFilter(TraceContextFilter())
+    scheduler + executor + experiment). Locked: two controllers constructed
+    concurrently (tests do this) must not double-install a filter through
+    the check-then-add race."""
+    with _installed_loggers_lock:
+        for name in names or LOGGERS:
+            if name in _installed_loggers:
+                continue
+            _installed_loggers.add(name)
+            logging.getLogger(name).addFilter(TraceContextFilter())
 
 
 # -- export: span tree + Perfetto --------------------------------------------

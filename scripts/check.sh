@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# katib-tpu pre-merge check (ISSUE 6): the static analyzer over the full
+# tree, then the lockgraph-instrumented scheduler + telemetry + obslog
+# stress smoke. Mirrors what tier-1 enforces (tests/test_static_analysis.py)
+# but runs in ~30s for local use:
+#
+#   scripts/check.sh            # text output
+#   scripts/check.sh --json     # analyzer findings as stable-sorted JSON
+#
+# Exit non-zero on any non-suppressed finding or lock-order cycle.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FORMAT=text
+if [[ "${1:-}" == "--json" ]]; then
+    FORMAT=json
+fi
+
+echo "== katib-tpu check (static analysis) =="
+python -m katib_tpu.analysis.engine katib_tpu --format "$FORMAT"
+
+echo
+echo "== lockgraph stress smoke (dynamic lock-order) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \
+    "tests/test_telemetry.py::TestSampler::test_lock_order_under_concurrent_register_sample_scrape" \
+    tests/test_obslog_pipeline.py::test_read_your_writes_under_concurrent_writers \
+    tests/test_static_analysis.py
+
+echo
+echo "check.sh: all clean"

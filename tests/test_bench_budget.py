@@ -571,6 +571,20 @@ def test_telemetry_overhead_smoke_wiring(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_check_latency_smoke_stays_fast(bench):
+    """--smoke analyzer run (ISSUE 6 satellite): the static-analysis pass
+    gates every PR from tier-1, so the full-tree pass must stay under a few
+    seconds — and must be clean on the shipped tree (the same gate
+    tests/test_static_analysis.py::test_tree_is_clean enforces with a
+    readable diff)."""
+    out = bench._bench_check_latency(smoke=True)
+    assert out["smoke"] is True
+    assert out["files"] > 80
+    assert out["findings"] == 0
+    assert out["elapsed_s"] < 5.0, out
+    assert out["within_target"] is True
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

