@@ -864,6 +864,51 @@ def _bench_check_latency(smoke: bool = False):
     }
 
 
+def _bench_analyze_latency(smoke: bool = False):
+    """Wall-clock of `katib-tpu analyze` over the two flagship workloads
+    (ISSUE 7 satellite): mnist + transformer under their example search
+    spaces. The analyzer sits on the admission path (HBM pre-flight) and
+    the dispatch path consults its cache, so the full classification —
+    baseline trace plus every corner trace — must stay under a few
+    seconds. Measured post-import (jax import cost is the process's, not
+    the analyzer's); ``smoke`` is the full measurement (abstract tracing
+    has nothing to trim)."""
+    import time as _time
+
+    from katib_tpu.analysis.program import analyze_spec, clear_cache
+    from katib_tpu.api.spec import load_experiment_document
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    results = {}
+    total = 0.0
+    for label, spec_file in (
+        ("mnist", "examples/random.json"),
+        ("transformer", "examples/distributed-lm.json"),
+    ):
+        with open(os.path.join(repo, spec_file)) as f:
+            spec = load_experiment_document(f.read())
+        clear_cache()
+        t0 = _time.perf_counter()
+        analysis = analyze_spec(spec)
+        elapsed = _time.perf_counter() - t0
+        total += elapsed
+        assert analysis.analyzable, analysis.error
+        results[label] = {
+            "elapsed_s": round(elapsed, 3),
+            "fingerprint": analysis.fingerprint,
+            "classes": dict(analysis.classes),
+            "flops": analysis.cost.flops,
+            "peak_bytes": analysis.cost.peak_bytes,
+        }
+    return {
+        "targets": results,
+        "elapsed_s": round(total, 3),
+        "target_s": 5.0,
+        "within_target": total < 5.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1816,6 +1861,7 @@ OBSLOG_SCENARIOS = {
     "tracing_overhead": _bench_tracing_overhead,
     "telemetry_overhead": _bench_telemetry_overhead,
     "check_latency": _bench_check_latency,
+    "analyze_latency": _bench_analyze_latency,
 }
 
 

@@ -7,7 +7,7 @@ both formats so CI log diffs between runs are meaningful.
 
 Usage (also via ``katib-tpu check``):
 
-    python -m katib_tpu.analysis.engine [paths...] [--format text|json]
+    python -m katib_tpu.analysis.engine [paths...] [--format text|json|sarif]
         [--baseline] [--no-suppressions]
 
 Exit codes: 0 clean, 1 findings, 2 bad usage / unreadable suppressions.
@@ -29,6 +29,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import rules_invariants, rules_locks, rules_recompile
 from .common import Finding, RuleContext, module_constants
+from .program import KTX_SUMMARIES
 from .suppress import (
     Suppression,
     SuppressionError,
@@ -235,6 +236,82 @@ def format_json(findings: List[Finding], stats: dict) -> str:
     )
 
 
+# one-line rule summaries: SARIF rule metadata + the docs catalog headers
+RULE_SUMMARIES: Dict[str, str] = {
+    "KT000": "file does not parse",
+    "KTC101": "jit/pjit wrapper created inside a loop",
+    "KTC102": "Python branch on a traced parameter of a jitted function",
+    "KTC103": "non-hashable static_argnums/static_argnames",
+    "KTC104": "host sync inside a step loop without a report boundary",
+    "KTC105": "jit wrapper created and immediately called",
+    "KTC106": "jitted function bakes mutable state at trace time",
+    "KTL201": "unlocked mutation of lock-guarded shared state",
+    "KTL202": "bare lock.acquire() without try/finally release",
+    "KTI301": "TrialPreempted/TrialKilled raised without a preceding flush",
+    "KTI302": "metric family or event reason missing from the catalog",
+    "KTI303": "RuntimeConfig knob missing from ENV_OVERRIDES",
+    **KTX_SUMMARIES,
+}
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def format_sarif(findings: List[Finding], stats: dict) -> str:
+    """SARIF 2.1.0 document for code-scanning uploads (one run, one
+    result per finding). Same determinism contract as text/json: findings
+    arrive stably sorted, rule metadata is sorted by id, and keys are
+    serialized sorted — two runs over the same tree are byte-identical."""
+    rules = [
+        {
+            "id": rule,
+            "shortDescription": {"text": RULE_SUMMARIES.get(rule, rule)},
+            "helpUri": "https://github.com/katib-tpu/katib-tpu/blob/main/docs/static-analysis.md",
+        }
+        for rule in sorted({f.rule for f in findings})
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error" if f.rule == "KT000" else "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "katib-tpu-check",
+                        "informationUri": "https://github.com/katib-tpu/katib-tpu/blob/main/docs/static-analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -245,7 +322,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to analyze (default: katib_tpu/)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p.add_argument("--baseline", action="store_true",
                    help="record current findings into analysis/baseline.json "
                         "and exit 0; later runs subtract them")
@@ -269,7 +346,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         path = write_baseline(findings, repo_root)
         print(f"baseline with {len(findings)} finding(s) written to {path}")
         return 0
-    print(format_text(findings, stats) if args.format == "text" else format_json(findings, stats))
+    formatter = {
+        "text": format_text, "json": format_json, "sarif": format_sarif,
+    }[args.format]
+    print(formatter(findings, stats))
     return 1 if findings else 0
 
 

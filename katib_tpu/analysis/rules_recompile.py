@@ -28,6 +28,14 @@ rules keep new hazards out of the hot paths:
   the freshly created wrapper is called once and dropped, so the NEXT call
   re-traces and re-compiles from scratch. Hoist the jitted callable (or
   cache it, see utils/modelinit.jitted_init) and call the cached object.
+- **KTC106 baked-trace-state** — a jitted function reading a *mutable*
+  module global (list/dict/set literal or constructor, or a name rebound
+  via ``global``) or a ``self`` attribute that is assigned outside
+  ``__init__``. jit traces the read ONCE and bakes the value into the
+  executable: later mutations are silently ignored by the compiled
+  program, and any code path that forces a retrace recompiles against a
+  different constant. Pass the value as an argument (traced or static) or
+  make it an immutable module constant.
 
 Hot paths are ``models/``, ``ops/``, ``suggest/``, ``runtime/packed.py``
 (katib_tpu/analysis/engine.py HOT_PATH_*): the modules whose loops run on
@@ -59,6 +67,7 @@ def check(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
     out += _jit_in_loop(tree, ctx)
     out += _traced_branch(tree, ctx)
     out += _nonhashable_static(tree, ctx)
+    out += _baked_trace_state(tree, ctx)
     if ctx.hot_path:
         out += _host_sync_in_loop(tree, ctx)
         out += _jit_then_call(tree, ctx)
@@ -259,6 +268,152 @@ def _jit_then_call(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
                     "once (module level or lru_cache) and call that",
                 )
             )
+    return _dedup(out)
+
+
+# -- KTC106 ------------------------------------------------------------------
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "bytearray", "deque", "defaultdict", "OrderedDict",
+    "Counter", "collections.deque", "collections.defaultdict",
+    "collections.OrderedDict", "collections.Counter",
+}
+
+
+def _mutable_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names that hold mutable state: bound to a mutable
+    literal/constructor at module level, or rebound via ``global`` inside
+    any function (scalar module state mutated at runtime)."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        mutable = isinstance(value, _MUTABLE_LITERALS) or (
+            isinstance(value, ast.Call)
+            and dotted_name(value.func) in _MUTABLE_CTORS
+        )
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    for func in walk_functions(tree):
+        for stmt in ast.walk(func):
+            if isinstance(stmt, ast.Global):
+                out.update(stmt.names)
+    return out
+
+
+def _bound_names(func: ast.AST) -> Set[str]:
+    """Names the function binds locally (params, assignments, loop/with
+    targets, comprehension vars) — reads of these are not global reads."""
+    args = func.args
+    names = {
+        a.arg
+        for a in args.posonlyargs + args.args + args.kwonlyargs
+    }
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not func:
+            names.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _mutated_attrs_by_class(tree: ast.Module) -> dict:
+    """ClassDef node -> self attributes assigned in any method OTHER than
+    __init__ (attributes only ever set at construction act as frozen
+    config and are exempt)."""
+    out: dict = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__":
+                continue
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        attrs.add(t.attr)
+        out[cls] = attrs
+    return out
+
+
+def _baked_trace_state(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    mut_globals = _mutable_globals(tree)
+    mutated_attrs = _mutated_attrs_by_class(tree)
+    owner_of = {
+        meth: cls
+        for cls in mutated_attrs
+        for meth in cls.body
+        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for func, _static in _jitted_defs(tree):
+        bound = _bound_names(func)
+        owner = owner_of.get(func)
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mut_globals
+                and node.id not in bound
+            ):
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, "KTC106",
+                        f"jitted function {func.name!r} reads mutable module "
+                        f"global {node.id!r} at trace time — the value is "
+                        "baked into the executable (silently stale after "
+                        "mutation, and a recompile hazard on retrace); pass "
+                        "it as an argument or make it an immutable constant",
+                    )
+                )
+            elif (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and owner is not None
+                and node.attr in mutated_attrs.get(owner, ())
+            ):
+                out.append(
+                    Finding(
+                        ctx.path, node.lineno, "KTC106",
+                        f"jitted method {func.name!r} reads self.{node.attr}, "
+                        "which is assigned outside __init__ — the attribute's "
+                        "trace-time value is baked into the executable and "
+                        "later mutations are silently ignored; pass it as an "
+                        "argument or freeze it at construction",
+                    )
+                )
     return _dedup(out)
 
 

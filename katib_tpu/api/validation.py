@@ -348,6 +348,45 @@ def _validate_trial_template(spec: ExperimentSpec, errs: List[str]) -> None:
                 errs.append(f"unknown trialSpec meta placeholder ${{trialSpec.{meta}}}")
 
 
+# Semantic admission pre-flight (analysis/program.py, ISSUE 7): fraction of
+# device memory above which the predicted peak earns a warning event even
+# though the experiment is admitted.
+HBM_WARN_FRACTION = 0.8
+
+
+def predicted_memory_errors(
+    peak_bytes: int, capacity_bytes: int, target: str
+) -> List[str]:
+    """Admission check over the jaxpr-level cost model's peak-HBM estimate
+    — a *lower bound* on what XLA will allocate, so exceeding capacity is a
+    certain OOM, not a maybe (the PR 5 watchdog catches the runtime rest).
+    Returns field-error strings in the validator's accumulate style."""
+    if capacity_bytes and peak_bytes > capacity_bytes:
+        return [
+            f"trialTemplate: predicted peak HBM of {peak_bytes} bytes for "
+            f"{target} exceeds device memory ({capacity_bytes} bytes); the "
+            "trial cannot fit — shrink the model/batch corners of the "
+            "search space or request a larger slice "
+            "(estimate: katib-tpu analyze)"
+        ]
+    return []
+
+
+def predicted_memory_warning(
+    peak_bytes: int, capacity_bytes: int, target: str
+) -> Optional[str]:
+    """Near-capacity warning text (>= HBM_WARN_FRACTION of the device),
+    emitted as a PredictedHbmNearCapacity event by the controller."""
+    if capacity_bytes and peak_bytes > capacity_bytes * HBM_WARN_FRACTION:
+        return (
+            f"predicted peak HBM {peak_bytes} bytes for {target} is within "
+            f"{100 * (1 - HBM_WARN_FRACTION):.0f}% of device memory "
+            f"({capacity_bytes} bytes); the static estimate is a lower "
+            "bound — XLA temporaries may push the trial over"
+        )
+    return None
+
+
 def _is_meta_key(reference: str) -> bool:
     """reference validator.go:564-581 (isMetaKey)."""
     if reference in {f"${{trialSpec.{k}}}" for k in META_KEYS}:

@@ -27,6 +27,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
   check [paths]            recompile-hazard / lock-discipline / repo-invariant
                            static analysis (docs/static-analysis.md); exits 1
                            on non-suppressed findings
+  analyze <spec|module:fn> semantic program analysis: compile fingerprint,
+                           shape-affecting vs runtime-scalar parameter
+                           classification, FLOPs/HBM cost table, KTX4xx
+                           findings (jaxpr-level, never executes the trial)
   ui                       serve the web dashboard + REST API
   serve                    run the suggestion/early-stopping/db-manager service
 
@@ -375,6 +379,81 @@ def cmd_check(args) -> int:
     return check_main(forwarded)
 
 
+def cmd_analyze(args) -> int:
+    """Semantic program analysis (ISSUE 7 tentpole): trace the trial's
+    abstract program under the experiment's search space (eval_shape /
+    make_jaxpr only — no compilation, no execution, no devices) and print
+    the compile fingerprint, the per-parameter classification, and the
+    jaxpr cost model. Accepts an experiment spec file (JSON/YAML, plain or
+    CRD envelope) or a bare module:fn target."""
+    import os
+
+    from .analysis.program import analyze_entry, analyze_spec, filter_findings
+
+    target = args.target
+    if os.path.exists(target):
+        from .api.spec import load_experiment_document
+
+        try:
+            with open(target) as f:
+                spec = load_experiment_document(f.read())
+            analysis = analyze_spec(spec)
+        except (ValueError, KeyError, TypeError) as e:
+            print(f"invalid experiment spec: {e}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            analysis = analyze_entry(target)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
+    findings, n_suppressed = filter_findings(list(analysis.findings))
+    if args.format == "json":
+        doc = analysis.to_dict()
+        doc["findings"] = [f.to_dict() for f in findings]
+        doc["suppressed"] = n_suppressed
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 1 if findings else 0
+
+    print(f"target:      {analysis.target}")
+    if analysis.digest:
+        print(f"digest:      {analysis.digest}")
+    if not analysis.analyzable:
+        print("analyzable:  no"
+              + (f" ({analysis.error})" if analysis.error else ""))
+    else:
+        print(f"fingerprint: {analysis.fingerprint}")
+    if analysis.params:
+        print("\nparameters:")
+        _table(
+            ["NAME", "TYPE", "CLASS", "CORNERS", "DISTINCT-PROGRAMS"],
+            [
+                (p.name, p.type, p.cls, ", ".join(p.corner_values),
+                 str(p.distinct_fingerprints))
+                for p in analysis.params
+            ],
+        )
+    if analysis.cost is not None:
+        c = analysis.cost
+        print("\ncost (baseline program, static estimate):")
+        _table(
+            ["FLOPS", "PARAM-BYTES", "INPUT-BYTES", "OUTPUT-BYTES",
+             "PEAK-HBM(LOWER-BOUND)", "EQNS"],
+            [(f"{c.flops:.4g}", str(c.param_bytes), str(c.input_bytes),
+              str(c.output_bytes), str(c.peak_bytes), str(c.eqns))],
+        )
+        for note in c.notes:
+            print(f"  note: {note}")
+    if findings:
+        print(f"\nfindings ({n_suppressed} suppressed):")
+        for f in findings:
+            print(f"  {f.path}:{f.line}: {f.rule} {f.message}")
+    else:
+        print(f"\nno findings ({n_suppressed} suppressed)")
+    return 1 if findings else 0
+
+
 def cmd_ui(args) -> int:
     from .ui.server import serve_ui
 
@@ -538,13 +617,25 @@ def main(argv=None) -> int:
         "invariants (exit 1 on findings)",
     )
     ck.add_argument("paths", nargs="*", help="files/dirs (default: katib_tpu/)")
-    ck.add_argument("--format", choices=("text", "json"), default="text")
+    ck.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     ck.add_argument(
         "--baseline", action="store_true",
         help="record current findings to analysis/baseline.json and exit 0",
     )
     ck.add_argument("--no-suppressions", action="store_true")
     ck.set_defaults(fn=cmd_check)
+
+    an = sub.add_parser(
+        "analyze",
+        help="semantic program analysis: compile fingerprint, parameter "
+        "classification, cost table (exit 1 on KTX findings)",
+    )
+    an.add_argument(
+        "target",
+        help="experiment spec file (JSON/YAML) or module:fn entry point",
+    )
+    an.add_argument("--format", choices=("text", "json"), default="text")
+    an.set_defaults(fn=cmd_analyze)
 
     ui = sub.add_parser("ui", help="serve the web dashboard + REST API")
     ui.add_argument("--host", default="127.0.0.1")

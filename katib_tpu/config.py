@@ -66,6 +66,11 @@ class RuntimeConfig:
     queue_stall_seconds: float = 120.0     # TrialQueueStalled warning threshold
     fairshare_aging_seconds: float = 60.0  # +1 effective priority per interval waited
     preemption_grace_seconds: float = 30.0  # preempt signal -> kill escalation
+    # semantic program analysis (analysis/program.py): admission HBM
+    # pre-flight, fingerprint pack grouping, compile-aware dispatch ordering
+    semantic_analysis: bool = True
+    device_hbm_bytes: Optional[int] = None  # per-device capacity for the
+    # pre-flight; None = detect from jax memory_stats when available
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -94,6 +99,8 @@ ENV_OVERRIDES: Dict[str, str] = {
     "queue_stall_seconds": "KATIB_TPU_QUEUE_STALL_SECONDS",
     "fairshare_aging_seconds": "KATIB_TPU_FAIRSHARE_AGING_SECONDS",
     "preemption_grace_seconds": "KATIB_TPU_PREEMPTION_GRACE_SECONDS",
+    "semantic_analysis": "KATIB_TPU_SEMANTIC_ANALYSIS",
+    "device_hbm_bytes": "KATIB_TPU_DEVICE_HBM_BYTES",
 }
 
 _FALSY = ("0", "false", "off")

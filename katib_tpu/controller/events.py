@@ -375,4 +375,6 @@ EVENT_CATALOG: Dict[str, str] = {
     # telemetry watchdog (PR 5)
     "TrialStalled": "No report() heartbeat past runtime.stall_seconds.",
     "TrialOOMRisk": "Monotonic RSS growth past runtime.oom_risk_fraction of host memory.",
+    # semantic admission pre-flight (PR 7, analysis/program.py)
+    "PredictedHbmNearCapacity": "Static peak-HBM estimate within the warning fraction of device memory.",
 }

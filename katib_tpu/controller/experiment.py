@@ -74,6 +74,11 @@ class ExperimentController:
         maybe_install_from_env()
         self.config = config if config is not None else load_config()
         rt = self.config.runtime
+        from ..analysis import program as semantic_analysis
+
+        # one switch for every consumer, including the lock-free dispatch
+        # paths (packing keys, fingerprint-grouped ordering)
+        semantic_analysis.set_enabled(rt.semantic_analysis)
         if rt.xla_cache_dir:
             # picked up by utils.compilation.enable_compilation_cache in
             # whichever process first touches JAX
@@ -161,6 +166,11 @@ class ExperimentController:
             known_algorithms=registered_algorithms(),
             known_early_stopping=registered_early_stoppers(),
         )
+        # semantic pre-flight (ISSUE 7): rejects a certainly-OOM sweep at
+        # admission (raises ValidationError) and warms the analysis cache
+        # for the dispatch-path consumers; near-capacity warning deferred
+        # until the experiment exists to attach the event to
+        hbm_warning = self._semantic_preflight(spec)
         exp = Experiment(spec=spec)
         exp.status.set_condition(
             ExperimentCondition.CREATED, ExperimentReason.NONE, "Experiment is created"
@@ -173,7 +183,45 @@ class ExperimentController:
         # suggestion_controller.go:256-271). Done at admission like the
         # reference's validating webhook.
         self.suggestions.validate(exp)
+        if hbm_warning:
+            self.events.event(
+                spec.name, "Experiment", spec.name,
+                "PredictedHbmNearCapacity", hbm_warning, warning=True,
+            )
         return exp
+
+    def _semantic_preflight(self, spec: ExperimentSpec) -> Optional[str]:
+        """Jaxpr-level admission pre-flight (analysis/program.py),
+        complementing the PR 5 runtime OOM watchdog: trace the trial's
+        abstract program under the search space's baseline avals and
+        reject (ValidationError) when the predicted peak HBM — a lower
+        bound — already exceeds device memory. Returns a near-capacity
+        warning string, or None. Best-effort by design: probes are opt-in
+        and analysis failures admit the experiment unchanged."""
+        rt = self.config.runtime
+        if not rt.semantic_analysis:
+            return None
+        from ..analysis import program as semantic
+        from ..api.validation import (
+            ValidationError,
+            predicted_memory_errors,
+            predicted_memory_warning,
+        )
+
+        analysis = semantic.cached_analysis(spec)
+        if analysis is None or not analysis.analyzable or analysis.cost is None:
+            return None
+        capacity = rt.device_hbm_bytes or semantic.device_capacity_bytes()
+        if not capacity:
+            return None
+        errs = predicted_memory_errors(
+            analysis.cost.peak_bytes, capacity, analysis.target
+        )
+        if errs:
+            raise ValidationError(errs)
+        return predicted_memory_warning(
+            analysis.cost.peak_bytes, capacity, analysis.target
+        )
 
     def edit_experiment_budget(
         self,

@@ -14,10 +14,17 @@ Packability (docs/trial-packing.md):
   trial function declares ``supports_packing = True`` (auto-detection, pack
   size then defaults to :data:`AUTO_PACK_SIZE`);
 - every parameter assignment is a runtime scalar (parses as float) — a
-  shape-affecting or categorical parameter would force per-member
-  recompilation, defeating the point;
-- members come from the same experiment/template: mixed templates never
-  pack (plan_packs groups by experiment name + template identity).
+  categorical parameter cannot be stacked into the vmapped population;
+- members come from the same experiment/template AND the same compile
+  fingerprint group: plan_packs keys open packs by (experiment name,
+  stable template digest, semantic fingerprint group). The digest replaces
+  the old ``id(template)`` key (``id()`` reuse after GC could merge
+  distinct templates); the fingerprint group (analysis/program.py) keeps
+  members whose *shape-affecting* parameters differ — mismatched avals,
+  so no shared executable — in separate packs, upgrading the old "all
+  params are floats" heuristic to real program equality. When semantic
+  analysis is off or the template has no probe, the digest alone keys the
+  pack and behavior matches the old heuristic exactly.
 
 Fallback is strict: a trial that fails any check runs through the existing
 ``InProcessExecutor`` unchanged, and a *member* failure (ctx.fail_member,
@@ -90,8 +97,10 @@ def pack_capacity(exp: Experiment) -> int:
 def unpackable_reason(exp: Experiment, trial: Trial) -> Optional[str]:
     """None when this trial may join a pack, else a human-readable reason —
     the strict-fallback predicate. Checked per trial because packability
-    depends on the *assignments* (all runtime scalars), not just the
-    template."""
+    depends on the *assignments* (stackable scalars), not just the
+    template. Program-equality across members is NOT checked here: that is
+    plan_packs' fingerprint-group key, which splits shape-affecting value
+    groups into separate packs instead of rejecting them."""
     template = exp.spec.trial_template
     if template.command is not None:
         return "command templates run as subprocesses"
@@ -116,12 +125,25 @@ def plan_packs(
 
     Returns ``[(exp, [trial, ...]), ...]`` where a singleton list is a solo
     dispatch (normal executor) and a longer list is a pack. Members are
-    grouped by (experiment name, template identity) — mixed templates never
-    pack — and capped at the experiment's pack capacity K."""
+    grouped by (experiment name, stable template digest, fingerprint
+    group) — mixed templates never pack, and members whose shape-affecting
+    parameters differ (distinct compiled programs) never share a pack —
+    capped at the experiment's pack capacity K."""
+    from ..analysis import program as semantic
+
     units: List[Tuple[Experiment, List[Trial]]] = []
-    open_packs: Dict[Tuple[str, int], Tuple[int, int]] = {}  # key -> (unit idx, K)
+    open_packs: Dict[Tuple, Tuple[int, int]] = {}  # key -> (unit idx, K)
+    digests: Dict[str, str] = {}  # experiment -> template digest (one/pass)
     for exp, trial in waiting:
-        key = (exp.name, id(exp.spec.trial_template))
+        digest = digests.get(exp.name)
+        if digest is None:
+            digest = semantic.template_digest(exp.spec.trial_template)
+            digests[exp.name] = digest
+        try:
+            group = semantic.pack_group_key(exp.spec, trial)
+        except Exception:
+            group = None  # analysis is advisory; formation must not break
+        key = (exp.name, digest, group)
         if unpackable_reason(exp, trial) is not None:
             units.append((exp, [trial]))
             continue

@@ -465,7 +465,11 @@ class TrialScheduler:
             fairshare_on = any(fs.uses_fairshare(e.exp) for e in entries) or any(
                 u.fairshare for u in self._running.values()
             )
-            ordered = self._policy.order(entries, now) if fairshare_on else entries
+            ordered = (
+                self._policy.order(entries, now)
+                if fairshare_on
+                else self._fingerprint_grouped(entries)
+            )
             free = self.allocator.free_count
             leftover: List[fs.QueueEntry] = []
             head_seen = False
@@ -509,6 +513,33 @@ class TrialScheduler:
                 self._head_key, self._head_credits = None, 0
             self._waiting = [(e.exp, t) for e in leftover for t in e.trials]
             self._note_queue_state(leftover, now)
+
+    def _fingerprint_grouped(self, entries):
+        """Legacy-path dispatch ordering (ISSUE 7): units whose trials
+        compile to the same program (equal semantic dispatch-group key,
+        analysis/program.py) dispatch consecutively, so the first unit's
+        trace/compile warms the jit and persistent-XLA caches for the rest
+        — the cheap precursor to ROADMAP 1's AOT compile service. Stable:
+        groups appear at their first member's arrival position, members
+        keep arrival order, and units with no key (analysis off, command
+        template, no probe) are singleton groups — with no keys the walk
+        is the identity, preserving FIFO exactly. Caller holds the
+        scheduler lock."""
+        from ..analysis import program as semantic
+
+        first_pos: Dict[Any, int] = {}
+        keyed = []
+        for i, e in enumerate(entries):
+            try:
+                key = semantic.dispatch_group_key(e.exp.spec, e.trials[0])
+            except Exception:
+                key = None  # advisory: ordering must never break dispatch
+            gid = ("solo", i) if key is None else ("fp", key)
+            if gid not in first_pos:
+                first_pos[gid] = i
+            keyed.append((first_pos[gid], i, e))
+        keyed.sort(key=lambda t: (t[0], t[1]))
+        return [e for _, _, e in keyed]
 
     def _start_unit(self, entry, devices) -> None:
         """Spawn the worker thread for one dispatch unit (solo or pack) and

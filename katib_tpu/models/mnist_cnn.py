@@ -206,3 +206,56 @@ def run_mnist_trial_packed(assignments, ctx=None) -> None:
 
 
 run_mnist_trial_packed.supports_packing = True
+
+
+def abstract_mnist_program(assignments: Dict[str, str]):
+    """Abstract program probe (katib_tpu.analysis.program): the canonical
+    jitted train step of the MNIST trial, described with ShapeDtypeStruct
+    avals only — eval_shape for the parameter tree, no arrays, no devices.
+
+    lr/momentum enter as traced f32 scalar inputs (runtime-scalar: one
+    executable covers the whole sweep); the model widths and batch_size
+    select different avals (shape-affecting: one compile per value);
+    num_epochs / num_train_examples are host-side loop knobs."""
+    from ..analysis.program import ProgramProbe
+
+    batch_size = int(assignments.get("batch_size", "64"))
+    model = MnistCNN(
+        conv1=int(assignments.get("conv1_channels", "20")),
+        conv2=int(assignments.get("conv2_channels", "50")),
+        hidden=int(assignments.get("hidden_size", "500")),
+    )
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)  # raw PRNG key, abstract
+    probe_x = jax.ShapeDtypeStruct((2, 28, 28, 1), jnp.float32)
+    params = jax.eval_shape(
+        lambda r, x: model.init(r, x)["params"], rng, probe_x
+    )
+    bx = jax.ShapeDtypeStruct((batch_size, 28, 28, 1), jnp.float32)
+    by = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    momentum = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def train_step(params, velocity, lr, momentum, bx, by):
+        # SGD-with-momentum with lr/momentum as traced per-call scalars —
+        # the same member program run_mnist_trial_packed vmaps (and the
+        # shape-bucketed program a shared-executable sweep would compile)
+        def loss_fn(p):
+            logits = model.apply({"params": p}, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        velocity = jax.tree_util.tree_map(lambda g, v: g + momentum * v, grads, velocity)
+        params = jax.tree_util.tree_map(lambda p, v: p - lr * v, params, velocity)
+        return params, velocity, loss
+
+    return ProgramProbe(
+        fn=train_step,
+        args=(params, params, lr, momentum, bx, by),
+        params=params,
+        hyperparams={"lr": lr, "momentum": momentum},
+        host_params={"num_epochs", "num_train_examples"},
+    )
+
+
+run_mnist_trial.abstract_program = abstract_mnist_program
+run_mnist_trial_packed.abstract_program = abstract_mnist_program

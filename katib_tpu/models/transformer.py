@@ -434,6 +434,61 @@ class TransformerLM(nn.Module):
         return logits
 
 
+def abstract_lm_program(assignments: Dict[str, str]):
+    """Abstract program probe (katib_tpu.analysis.program) for the LM trial
+    (parallel/train.py:run_lm_trial): the canonical jitted train step traced
+    from ShapeDtypeStruct avals — eval_shape init, no mesh, no devices.
+
+    learning_rate enters as a traced f32 scalar (runtime-scalar); every
+    architecture/shape knob (embed_dim, num_layers, num_heads, batch_size,
+    seq_len, vocab_size) changes avals, and the parallelism degrees select
+    a different sharded program, so all of those are fingerprint material
+    (shape-affecting); num_steps/profile are host-side knobs."""
+    from ..analysis.program import ProgramProbe
+
+    config = TransformerConfig(
+        vocab_size=int(assignments.get("vocab_size", "512")),
+        embed_dim=int(assignments.get("embed_dim", "128")),
+        num_layers=int(assignments.get("num_layers", "2")),
+        num_heads=int(assignments.get("num_heads", "4")),
+        max_seq_len=int(assignments.get("seq_len", "128")),
+    )
+    batch = int(assignments.get("batch_size", "8"))
+    seq = int(assignments.get("seq_len", "128"))
+    model = TransformerLM(config)  # mesh-free abstract twin; the mesh
+    # layout enters the fingerprint through `statics` below instead
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    targets = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    params = jax.eval_shape(
+        lambda r, t: model.init(r, t)["params"], rng, tokens
+    )
+
+    def train_step(params, lr, tokens, targets):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return nll.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return ProgramProbe(
+        fn=train_step,
+        args=(params, lr, tokens, targets),
+        params=params,
+        hyperparams={"learning_rate": lr},
+        host_params={"num_steps", "profile"},
+        statics={
+            "tensor_parallel": int(assignments.get("tensor_parallel", "1")),
+            "sequence_parallel": int(assignments.get("sequence_parallel", "1")),
+        },
+    )
+
+
 # ---------------------------------------------------------------------------
 # Sharding rules
 # ---------------------------------------------------------------------------

@@ -228,3 +228,10 @@ def run_lm_trial(assignments: Dict[str, str], ctx=None) -> None:
             ctx.report(loss=float(loss))
     else:
         print(f"loss={float(loss)}")
+
+
+# semantic-analysis probe (katib_tpu.analysis.program): the abstract twin of
+# this trial's train step lives next to the model it shapes
+from ..models.transformer import abstract_lm_program  # noqa: E402
+
+run_lm_trial.abstract_program = abstract_lm_program

@@ -20,6 +20,10 @@ echo "== katib-tpu check (static analysis) =="
 python -m katib_tpu.analysis.engine katib_tpu --format "$FORMAT"
 
 echo
+echo "== katib-tpu analyze smoke (semantic program analysis) =="
+JAX_PLATFORMS=cpu python bench.py analyze_latency --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

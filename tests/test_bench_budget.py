@@ -585,6 +585,25 @@ def test_check_latency_smoke_stays_fast(bench):
     assert out["within_target"] is True
 
 
+def test_analyze_latency_smoke_stays_fast(bench):
+    """--smoke analyzer run (ISSUE 7 satellite): full semantic analysis of
+    mnist + transformer under their example search spaces — baseline trace
+    plus every corner — must stay under the 5s budget, classify the
+    expected parameters, and produce stable fingerprints."""
+    out = bench._bench_analyze_latency(smoke=True)
+    assert out["smoke"] is True
+    assert out["elapsed_s"] < 5.0, out
+    assert out["within_target"] is True
+    mnist = out["targets"]["mnist"]
+    lm = out["targets"]["transformer"]
+    assert mnist["fingerprint"].startswith("ktfp-")
+    assert mnist["classes"] == {"lr": "runtime-scalar", "momentum": "runtime-scalar"}
+    assert lm["classes"] == {
+        "learning_rate": "runtime-scalar", "embed_dim": "shape-affecting",
+    }
+    assert mnist["flops"] > 0 and lm["peak_bytes"] > 0
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -1,0 +1,633 @@
+"""Semantic program analysis (ISSUE 7): process-stable compile
+fingerprints, shape-affecting vs runtime-scalar classification, the jaxpr
+cost model, the admission HBM pre-flight, fingerprint pack keys, and the
+compile-aware dispatch ordering — all under JAX_PLATFORMS=cpu with no trial
+execution."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from katib_tpu.analysis import program
+from katib_tpu.analysis.costmodel import estimate_cost
+from katib_tpu.analysis.program import (
+    CLASS_BAKED,
+    CLASS_HOST,
+    CLASS_SCALAR,
+    CLASS_SHAPE,
+    ProgramProbe,
+    analyze_spec,
+    template_digest,
+)
+from katib_tpu.api.spec import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    ParameterSpec,
+    ParameterType,
+    TrialResources,
+    TrialTemplate,
+    load_experiment_document,
+)
+from katib_tpu.api.status import Experiment, Trial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _semantic_on():
+    """Every test sees analysis enabled and an empty cache; the global
+    switch is restored so controller tests elsewhere are unaffected."""
+    program.set_enabled(True)
+    program.clear_cache()
+    yield
+    program.set_enabled(True)
+    program.clear_cache()
+
+
+def _mnist_spec(name="prog-mnist", params=None, **template_kw):
+    return ExperimentSpec(
+        name=name,
+        parameters=params
+        or [
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.5")),
+            ParameterSpec("momentum", ParameterType.DOUBLE, FeasibleSpace(min="0.5", max="0.99")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(
+            entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial", **template_kw
+        ),
+        max_trial_count=2,
+        parallel_trial_count=1,
+    )
+
+
+# -- fingerprints ------------------------------------------------------------
+
+def test_fingerprint_stable_within_process():
+    spec = _mnist_spec()
+    a1 = analyze_spec(spec)
+    a2 = analyze_spec(spec)
+    assert a1.analyzable and a1.fingerprint.startswith("ktfp-")
+    assert a1.fingerprint == a2.fingerprint
+
+
+def test_fingerprint_stable_across_processes():
+    """The acceptance bar: no id()s, no hash-seed dependence — two fresh
+    interpreters with different PYTHONHASHSEED agree byte-for-byte."""
+    code = (
+        "from katib_tpu.api.spec import load_experiment_document\n"
+        "from katib_tpu.analysis.program import analyze_spec\n"
+        "spec = load_experiment_document(open('examples/random.json').read())\n"
+        "a = analyze_spec(spec)\n"
+        "assert a.analyzable, a.error\n"
+        "print(a.fingerprint)\n"
+    )
+    fps = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONHASHSEED=seed)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        fps.append(proc.stdout.strip())
+    assert fps[0] == fps[1]
+    assert fps[0].startswith("ktfp-")
+
+
+def test_fingerprint_differs_for_different_programs():
+    spec_small = _mnist_spec()
+    a = analyze_spec(spec_small)
+    probe = jax.ShapeDtypeStruct((), jnp.float32)
+    other = ProgramProbe(fn=lambda x: x + 1.0, args=(probe,))
+    fp_other = program.fingerprint_jaxpr(program.trace_probe(other), other)
+    assert a.fingerprint != fp_other
+
+
+def test_statics_enter_the_fingerprint():
+    x = jax.ShapeDtypeStruct((4,), jnp.float32)
+
+    def make(tp):
+        return ProgramProbe(fn=lambda v: v * 2.0, args=(x,), statics={"tp": tp})
+
+    p1, p2 = make(1), make(2)
+    fp1 = program.fingerprint_jaxpr(program.trace_probe(p1), p1)
+    fp2 = program.fingerprint_jaxpr(program.trace_probe(p2), p2)
+    assert fp1 != fp2
+
+
+# -- classification ----------------------------------------------------------
+
+def test_mnist_classification_runtime_scalars():
+    a = analyze_spec(_mnist_spec())
+    assert a.classes == {"lr": CLASS_SCALAR, "momentum": CLASS_SCALAR}
+    assert a.findings == []
+
+
+def test_mnist_classification_shape_affecting_and_host():
+    params = [
+        ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.5")),
+        ParameterSpec(
+            "batch_size", ParameterType.DISCRETE, FeasibleSpace(list=["32", "64", "128"])
+        ),
+        ParameterSpec(
+            "hidden_size", ParameterType.DISCRETE, FeasibleSpace(list=["100", "500"])
+        ),
+        ParameterSpec(
+            "num_epochs", ParameterType.DISCRETE, FeasibleSpace(list=["1", "2"])
+        ),
+    ]
+    a = analyze_spec(_mnist_spec(params=params))
+    assert a.classes["lr"] == CLASS_SCALAR
+    assert a.classes["batch_size"] == CLASS_SHAPE
+    assert a.classes["hidden_size"] == CLASS_SHAPE
+    assert a.classes["num_epochs"] == CLASS_HOST
+
+
+def test_single_point_dimension_classifies_fixed_without_findings():
+    """A one-value dimension (pinned host knob) has no corners to perturb:
+    it can never vary, so it must classify `fixed` — not `baked` — and
+    raise no KTX401 (found by driving the e2e verify flow)."""
+    params = [
+        ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.5")),
+        ParameterSpec(
+            "batch_size", ParameterType.DISCRETE, FeasibleSpace(list=["32"])
+        ),
+    ]
+    a = analyze_spec(_mnist_spec(params=params))
+    assert a.classes == {"lr": CLASS_SCALAR, "batch_size": program.CLASS_FIXED}
+    assert a.findings == []
+
+
+def test_transformer_classification():
+    with open(os.path.join(REPO, "examples", "distributed-lm.json")) as f:
+        spec = load_experiment_document(f.read())
+    a = analyze_spec(spec)
+    assert a.analyzable, a.error
+    assert a.classes == {
+        "learning_rate": CLASS_SCALAR,
+        "embed_dim": CLASS_SHAPE,
+    }
+    assert a.cost is not None and a.cost.flops > 1e9
+    assert a.cost.param_bytes > 0
+
+
+def test_baked_parameter_yields_ktx401():
+    """A search dimension the probe neither shapes nor inputs nor declares
+    host-side is a trace-time constant — the KTX401 hazard."""
+
+    def fn(assignments, ctx=None):
+        pass
+
+    def builder(assignments):
+        x = jax.ShapeDtypeStruct((4,), jnp.float32)
+        return ProgramProbe(fn=lambda v: v * 2.0, args=(x,))
+
+    fn.abstract_program = builder
+    spec = _mnist_spec(params=[
+        ParameterSpec("alpha", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="0.9")),
+    ])
+    spec.trial_template = TrialTemplate(function=fn)
+    a = analyze_spec(spec)
+    assert a.classes["alpha"] == CLASS_BAKED
+    assert [f.rule for f in a.findings] == ["KTX401"]
+
+
+def test_weak_type_hyperparam_yields_ktx402():
+    def fn(assignments, ctx=None):
+        pass
+
+    def builder(assignments):
+        # a weak-typed scalar input: what passing a raw Python float traces as
+        lr = jax.core.ShapedArray((), jnp.float32, weak_type=True)
+        return ProgramProbe(fn=lambda lr: lr * 2.0, args=(lr,), hyperparams={"alpha": lr})
+
+    fn.abstract_program = builder
+    spec = _mnist_spec(params=[
+        ParameterSpec("alpha", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="0.9")),
+    ])
+    spec.trial_template = TrialTemplate(function=fn)
+    a = analyze_spec(spec)
+    assert a.classes["alpha"] == CLASS_SCALAR
+    assert [f.rule for f in a.findings] == ["KTX402"]
+
+
+def test_pack_enabled_shape_affecting_yields_ktx403():
+    params = [
+        ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.5")),
+        ParameterSpec(
+            "batch_size", ParameterType.DISCRETE, FeasibleSpace(list=["32", "64"])
+        ),
+    ]
+    spec = _mnist_spec(params=params)
+    spec.trial_template = TrialTemplate(
+        entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial_packed",
+        resources=TrialResources(pack_size=4),
+    )
+    a = analyze_spec(spec)
+    assert "KTX403" in [f.rule for f in a.findings]
+
+
+def test_probe_less_entry_yields_ktx404_not_crash():
+    def plain(assignments, ctx=None):
+        pass
+
+    spec = _mnist_spec()
+    spec.trial_template = TrialTemplate(function=plain)
+    a = analyze_spec(spec)
+    assert not a.analyzable
+    assert [f.rule for f in a.findings] == ["KTX404"]
+
+
+# -- cost model --------------------------------------------------------------
+
+def test_cost_model_matmul_within_2x_of_hand_count():
+    m, k, n = 64, 128, 32
+    cj = jax.make_jaxpr(lambda a, b: a @ b)(
+        jax.ShapeDtypeStruct((m, k), jnp.float32),
+        jax.ShapeDtypeStruct((k, n), jnp.float32),
+    )
+    cost = estimate_cost(cj)
+    hand = 2.0 * m * k * n
+    assert hand / 2 <= cost.flops <= hand * 2
+    assert cost.input_bytes == (m * k + k * n) * 4
+    assert cost.output_bytes == m * n * 4
+    assert cost.peak_bytes >= cost.input_bytes + cost.output_bytes
+
+
+def test_cost_model_scan_multiplies_body():
+    def scanned(xs):
+        def body(carry, x):
+            return carry + x * x, ()
+
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), xs)
+        return out
+
+    short = estimate_cost(jax.make_jaxpr(scanned)(jax.ShapeDtypeStruct((10,), jnp.float32)))
+    long = estimate_cost(jax.make_jaxpr(scanned)(jax.ShapeDtypeStruct((1000,), jnp.float32)))
+    assert long.flops > short.flops * 50
+
+
+def test_peak_bytes_tracks_live_intermediates():
+    def wide(x):
+        a = x * 2.0       # one [N] temp
+        b = a + 1.0       # another
+        return (a * b).sum()
+
+    n = 1 << 16
+    cost = estimate_cost(jax.make_jaxpr(wide)(jax.ShapeDtypeStruct((n,), jnp.float32)))
+    assert cost.peak_bytes >= n * 4 * 2  # input + at least one live temp
+
+
+# -- admission pre-flight ----------------------------------------------------
+
+def _controller(config):
+    from katib_tpu.controller.experiment import ExperimentController
+
+    return ExperimentController(
+        root_dir=None, persist=False, devices=[0], config=config
+    )
+
+
+def _quiet_config():
+    from katib_tpu.config import KatibConfig
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.tracing = False
+    return cfg
+
+
+def test_preflight_rejects_predicted_oom():
+    from katib_tpu.api.validation import ValidationError
+
+    cfg = _quiet_config()
+    cfg.runtime.device_hbm_bytes = 1024  # nothing real fits in 1 KiB
+    ctrl = _controller(cfg)
+    try:
+        with pytest.raises(ValidationError, match="predicted peak HBM"):
+            ctrl.create_experiment(_mnist_spec(name="prog-oom"))
+        assert ctrl.state.get_experiment("prog-oom") is None
+    finally:
+        ctrl.close()
+
+
+def test_preflight_warns_near_capacity():
+    cfg = _quiet_config()
+    a = analyze_spec(_mnist_spec(name="prog-warn"))
+    cfg.runtime.device_hbm_bytes = int(a.cost.peak_bytes * 1.05)
+    ctrl = _controller(cfg)
+    try:
+        ctrl.create_experiment(_mnist_spec(name="prog-warn"))
+        reasons = [e.reason for e in ctrl.events.list_all(warning_only=True)]
+        assert "PredictedHbmNearCapacity" in reasons
+    finally:
+        ctrl.close()
+
+
+def test_preflight_disabled_admits_everything():
+    cfg = _quiet_config()
+    cfg.runtime.semantic_analysis = False
+    cfg.runtime.device_hbm_bytes = 1024
+    ctrl = _controller(cfg)
+    try:
+        exp = ctrl.create_experiment(_mnist_spec(name="prog-off"))
+        assert exp is not None
+    finally:
+        ctrl.close()
+        program.set_enabled(True)
+
+
+# -- pack formation ----------------------------------------------------------
+
+def _trial(exp_name, name, **assignments):
+    return Trial(
+        name=name,
+        experiment_name=exp_name,
+        parameter_assignments=[
+            ParameterAssignment(k, v) for k, v in assignments.items()
+        ],
+    )
+
+
+def probeless_pack_fn(assignments, ctx=None):
+    pass
+
+
+probeless_pack_fn.supports_packing = True
+
+
+def _probeless_spec(name, lrs):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec("lr", ParameterType.DISCRETE, FeasibleSpace(list=lrs))
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("grid"),
+        trial_template=TrialTemplate(
+            function=probeless_pack_fn, resources=TrialResources(pack_size=4)
+        ),
+        max_trial_count=len(lrs),
+    )
+
+
+def test_pack_preflight_equivalent_to_old_heuristic_without_probe():
+    """Satellite: on the existing packing fixtures (probe-less functions)
+    the fingerprint upgrade must reproduce the old heuristic exactly —
+    same unpackable reasons, same pack structure, analysis on or off."""
+    from katib_tpu.controller.packing import plan_packs, unpackable_reason
+
+    exp = Experiment(spec=_probeless_spec("pack-eq", ["0.1", "0.2", "0.3"]))
+    trials = [_trial("pack-eq", f"t{i}", lr=v) for i, v in enumerate(["0.1", "0.2", "0.3"])]
+    cat = _trial("pack-eq", "tcat", lr="relu")
+
+    def snapshot():
+        units = plan_packs([(exp, t) for t in trials])
+        return (
+            [unpackable_reason(exp, t) for t in trials + [cat]],
+            [[t.name for t in members] for _, members in units],
+        )
+
+    program.set_enabled(True)
+    with_analysis = snapshot()
+    program.set_enabled(False)
+    without_analysis = snapshot()
+    program.set_enabled(True)
+    assert with_analysis == without_analysis
+    assert with_analysis[1] == [["t0", "t1", "t2"]]
+    assert with_analysis[0][:3] == [None, None, None]
+    assert "not a runtime scalar" in with_analysis[0][3]
+
+
+def test_plan_packs_splits_shape_affecting_value_groups():
+    """Members whose shape-affecting parameter differs compile to different
+    programs: the fingerprint group key must put them in separate packs
+    (the old float heuristic would have merged them and crashed in
+    uniform_param)."""
+    from katib_tpu.controller.packing import plan_packs
+
+    spec = ExperimentSpec(
+        name="pack-split",
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.01", max="0.5")),
+            ParameterSpec(
+                "batch_size", ParameterType.DISCRETE, FeasibleSpace(list=["32", "64"])
+            ),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss"),
+        algorithm=AlgorithmSpec("grid"),
+        trial_template=TrialTemplate(
+            entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial_packed",
+            resources=TrialResources(pack_size=4),
+        ),
+        max_trial_count=4,
+    )
+    exp = Experiment(spec=spec)
+    waiting = [
+        (exp, _trial("pack-split", "a32", lr="0.1", batch_size="32")),
+        (exp, _trial("pack-split", "b64", lr="0.2", batch_size="64")),
+        (exp, _trial("pack-split", "c32", lr="0.3", batch_size="32")),
+        (exp, _trial("pack-split", "d64", lr="0.4", batch_size="64")),
+    ]
+    units = plan_packs(waiting)
+    names = [[t.name for t in members] for _, members in units]
+    assert names == [["a32", "c32"], ["b64", "d64"]]
+
+
+def test_template_digest_replaces_id_keying():
+    t1 = TrialTemplate(function=probeless_pack_fn)
+    t2 = TrialTemplate(function=probeless_pack_fn)
+    assert template_digest(t1) == template_digest(t2)  # same def, same program
+    t3 = TrialTemplate(function=probeless_pack_fn, resources=TrialResources(pack_size=8))
+    assert template_digest(t1) != template_digest(t3)
+    t4 = TrialTemplate(entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial")
+    assert template_digest(t1) != template_digest(t4)
+    # digests are strings, never id()s: stable across calls
+    assert template_digest(t4) == template_digest(
+        TrialTemplate(entry_point="katib_tpu.models.mnist_cnn:run_mnist_trial")
+    )
+
+
+# -- dispatch ordering + the 1-compile acceptance sweep ----------------------
+
+TRACE_COUNT = {"n": 0}
+
+
+def _counting_body(lr):
+    TRACE_COUNT["n"] += 1  # python body runs once per TRACE, not per call
+    return lr * 2.0
+
+
+_COUNTING_STEP = jax.jit(_counting_body)
+
+
+def run_counting_trial(assignments, ctx=None):
+    lr = jnp.float32(float(assignments["lr"]))  # strong f32: one cache entry
+    val = _COUNTING_STEP(lr)
+    if ctx is not None:
+        ctx.report(loss=float(val))
+
+
+def _counting_probe(assignments):
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    return ProgramProbe(
+        fn=lambda lr: lr * 2.0, args=(lr,), hyperparams={"lr": lr}
+    )
+
+
+run_counting_trial.abstract_program = _counting_probe
+
+
+def test_16_trial_runtime_scalar_sweep_compiles_once():
+    """The acceptance sweep: 16 trials whose only parameter is classified
+    runtime-scalar dispatch under fingerprint-grouped ordering and share
+    exactly ONE trace/compile of the module-level jitted step."""
+    lrs = [format(0.05 * (i + 1), ".4f") for i in range(16)]
+    spec = ExperimentSpec(
+        name="prog-sweep16",
+        parameters=[
+            ParameterSpec("lr", ParameterType.DISCRETE, FeasibleSpace(list=lrs))
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss"),
+        algorithm=AlgorithmSpec("grid"),
+        trial_template=TrialTemplate(function=run_counting_trial),
+        max_trial_count=16,
+        parallel_trial_count=16,
+    )
+    a = analyze_spec(spec)
+    assert a.analyzable and a.classes == {"lr": CLASS_SCALAR}
+
+    _COUNTING_STEP.clear_cache()
+    TRACE_COUNT["n"] = 0
+    ctrl = _controller(_quiet_config())
+    try:
+        ctrl.create_experiment(spec)
+        exp = ctrl.run("prog-sweep16", timeout=120)
+        assert exp.status.is_succeeded
+        trials = ctrl.state.list_trials("prog-sweep16")
+        assert len(trials) == 16
+        assert TRACE_COUNT["n"] == 1, (
+            f"expected exactly one trace of the shared program, got "
+            f"{TRACE_COUNT['n']}"
+        )
+    finally:
+        ctrl.close()
+
+
+def test_dispatch_ordering_groups_same_fingerprint_units():
+    """Interleaved units from a fingerprint-keyed experiment regroup
+    consecutively at the first member's position; unanalyzable units keep
+    their arrival slots (identity when no keys at all — legacy FIFO)."""
+    from katib_tpu.controller import fairshare as fs
+    from katib_tpu.controller.scheduler import TrialScheduler
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import InMemoryObservationStore
+
+    sched = TrialScheduler(
+        ExperimentStateStore(None), InMemoryObservationStore(), devices=[0, 1]
+    )
+    spec_a = ExperimentSpec(
+        name="ord-a",
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min="0.1", max="0.9"))
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=TrialTemplate(function=run_counting_trial),
+    )
+    exp_a = Experiment(spec=spec_a)
+    exp_b = Experiment(spec=_probeless_spec("ord-b", ["0.1", "0.2"]))
+
+    def entry(i, exp, trial):
+        return fs.QueueEntry(
+            exp=exp, trials=[trial], needed=1, requested=1, seq=i, enqueued_at=0.0
+        )
+
+    entries = [
+        entry(0, exp_a, _trial("ord-a", "a1", lr="0.1")),
+        entry(1, exp_b, _trial("ord-b", "b1", lr="0.1")),
+        entry(2, exp_a, _trial("ord-a", "a2", lr="0.2")),
+        entry(3, exp_b, _trial("ord-b", "b2", lr="0.2")),
+    ]
+    ordered = sched._fingerprint_grouped(entries)
+    assert [e.trials[0].name for e in ordered] == ["a1", "a2", "b1", "b2"]
+    # pure-FIFO guarantee: no keys -> identity
+    program.set_enabled(False)
+    try:
+        ordered = sched._fingerprint_grouped(entries)
+        assert [e.trials[0].name for e in ordered] == ["a1", "b1", "a2", "b2"]
+    finally:
+        program.set_enabled(True)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_analyze_spec_text_and_json(capsys):
+    from katib_tpu.cli import main
+
+    rc = main(["analyze", os.path.join(REPO, "examples", "random.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fingerprint: ktfp-" in out
+    assert "runtime-scalar" in out
+
+    rc = main([
+        "analyze", os.path.join(REPO, "examples", "random.json"),
+        "--format", "json",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["analyzable"] is True
+    assert doc["fingerprint"].startswith("ktfp-")
+    assert {p["name"]: p["class"] for p in doc["parameters"]} == {
+        "lr": "runtime-scalar", "momentum": "runtime-scalar",
+    }
+    assert doc["cost"]["flops"] > 0
+
+
+def test_cli_analyze_module_target(capsys):
+    from katib_tpu.cli import main
+
+    rc = main(["analyze", "katib_tpu.models.mnist_cnn:run_mnist_trial"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fingerprint: ktfp-" in out
+
+
+def test_cli_analyze_bad_target_exits_2(capsys):
+    from katib_tpu.cli import main
+
+    assert main(["analyze", "katib_tpu.no_such_module:nope"]) == 2
+    assert main(["analyze", "not-a-module-or-file"]) == 2
+
+
+def test_ktx_findings_obey_inline_suppressions(tmp_path):
+    """KTX findings flow through the PR 6 suppression plumbing: an inline
+    ignore on the entry point's def line drops the finding."""
+    from katib_tpu.analysis.common import Finding
+    from katib_tpu.analysis.program import filter_findings
+
+    root = tmp_path
+    mod = root / "baked.py"
+    mod.write_text(
+        "def trial(a, ctx=None):  # katib-check: ignore[KTX401] reviewed\n"
+        "    pass\n"
+    )
+    finding = Finding("baked.py", 1, "KTX401", "baked parameter 'alpha'")
+    kept, n = filter_findings([finding], repo_root=str(root))
+    assert kept == [] and n == 1
+    # without the annotation it survives, stably sorted
+    mod.write_text("def trial(a, ctx=None):\n    pass\n")
+    kept, n = filter_findings([finding], repo_root=str(root))
+    assert kept == [finding] and n == 0

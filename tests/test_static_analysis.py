@@ -190,6 +190,86 @@ def test_ktc105_jit_then_call():
     assert "KTC105" not in rules_of(check_source(good, HOT))
 
 
+def test_ktc106_mutable_global_read_in_jitted_fn():
+    bad = (
+        "import jax\n"
+        "SCALE = {'v': 2.0}\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x * SCALE['v']\n"
+    )
+    good_arg = (
+        "import jax\n"
+        "SCALE = {'v': 2.0}\n"
+        "@jax.jit\n"
+        "def step(x, scale):\n"
+        "    return x * scale\n"
+        "def run(x):\n"
+        "    return step(x, SCALE['v'])\n"
+    )
+    good_immutable = (
+        "import jax\n"
+        "SCALE = 2.0\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x * SCALE\n"
+    )
+    assert "KTC106" in rules_of(check_source(bad, "x.py"))
+    assert "KTC106" not in rules_of(check_source(good_arg, "x.py"))
+    assert "KTC106" not in rules_of(check_source(good_immutable, "x.py"))
+
+
+def test_ktc106_global_rebound_scalar_and_by_name_jit():
+    bad = (
+        "import jax\n"
+        "_steps = 0\n"
+        "def bump():\n"
+        "    global _steps\n"
+        "    _steps += 1\n"
+        "def body(x):\n"
+        "    return x + _steps\n"
+        "step = jax.jit(body)\n"
+    )
+    good_local_shadow = (
+        "import jax\n"
+        "_steps = 0\n"
+        "def bump():\n"
+        "    global _steps\n"
+        "    _steps += 1\n"
+        "def body(x):\n"
+        "    _steps = 3\n"
+        "    return x + _steps\n"
+        "step = jax.jit(body)\n"
+    )
+    assert "KTC106" in rules_of(check_source(bad, "x.py"))
+    assert "KTC106" not in rules_of(check_source(good_local_shadow, "x.py"))
+
+
+def test_ktc106_mutable_self_attribute():
+    bad = (
+        "import jax\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self.scale = 1.0\n"
+        "    def set_scale(self, s):\n"
+        "        self.scale = s\n"
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        "        return x * self.scale\n"
+    )
+    good_frozen = (
+        "import jax\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self.scale = 1.0\n"
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        "        return x * self.scale\n"
+    )
+    assert "KTC106" in rules_of(check_source(bad, "x.py"))
+    assert "KTC106" not in rules_of(check_source(good_frozen, "x.py"))
+
+
 def locked_class(sig, body):
     return (
         "import threading\n"
@@ -392,6 +472,70 @@ def test_json_output_stable_and_sorted():
     parsed = json.loads(a)
     keys = [(f["path"], f["line"], f["rule"]) for f in parsed["findings"]]
     assert keys == sorted(keys)
+
+
+def test_sarif_output_schema_and_stability(tmp_path):
+    """`--format sarif` (ISSUE 7 satellite): valid SARIF 2.1.0 shape,
+    stably sorted like text/json, with per-rule metadata for every ruleId
+    that appears."""
+    from katib_tpu.analysis.engine import format_sarif
+
+    dirty = (
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda p: p)(x)\n"
+        "f2 = jax.jit(g, static_argnums=[0])\n"
+    )
+    findings = check_source(dirty, "katib_tpu/dirty.py")
+    assert findings
+    stats = {"files": 1, "findings": len(findings), "suppressed": 0,
+             "baselined": 0, "read_errors": 0}
+    a = format_sarif(findings, stats)
+    b = format_sarif(list(findings), dict(stats))
+    assert a == b  # byte-identical across calls
+    doc = json.loads(a)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "katib-tpu-check"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    assert {r["ruleId"] for r in run["results"]} <= set(rule_ids)
+    for res in run["results"]:
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "katib_tpu/dirty.py"
+        assert loc["region"]["startLine"] >= 1
+        assert res["message"]["text"]
+    keys = [
+        (r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+         r["locations"][0]["physicalLocation"]["region"]["startLine"],
+         r["ruleId"])
+        for r in run["results"]
+    ]
+    assert keys == sorted(keys)
+
+
+def test_sarif_via_cli(tmp_path):
+    from katib_tpu.cli import main
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda p: p)(x)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "katib_tpu.analysis.engine", str(dirty),
+         "--format", "sarif"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"]
+    # clean tree -> rc 0 and an empty results array, still valid SARIF
+    assert main(["check", "katib_tpu", "--format", "sarif"]) == 0
 
 
 def test_cli_check_exit_codes(tmp_path):
