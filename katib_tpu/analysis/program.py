@@ -598,6 +598,14 @@ def _grouping_values(
     )
 
 
+def probe_builder_for(template) -> Optional[Callable[..., ProgramProbe]]:
+    """The template's ``fn.abstract_program`` builder, or None (command
+    template / no probe). The compile service (compilesvc/service.py) uses
+    this to AOT-compile the canonical program it describes."""
+    fn = _resolve_template_fn(template)
+    return getattr(fn, "abstract_program", None) if fn is not None else None
+
+
 def pack_group_key(spec, trial):
     """Grouping key for pack formation: template digest + the values of
     every parameter that must be uniform across members (shape-affecting:
@@ -623,6 +631,24 @@ def dispatch_group_key(spec, trial):
     return (analysis.digest, _grouping_values(analysis, trial, (CLASS_SHAPE,)))
 
 
+def dispatch_group_key_for_assignments(spec, assignments: Dict[str, str]):
+    """dispatch_group_key over a bare assignment dict — the compile
+    service's admission-time prewarm has no Trial object yet (the baseline
+    group is enqueued at create_experiment, before the first suggestion
+    batch)."""
+    analysis = cached_analysis(spec)
+    if analysis is None or not analysis.analyzable:
+        return None
+    values = tuple(
+        sorted(
+            (name, value)
+            for name, value in assignments.items()
+            if analysis.classes.get(name) == CLASS_SHAPE
+        )
+    )
+    return (analysis.digest, values)
+
+
 def device_capacity_bytes() -> Optional[int]:
     """Accelerator memory per device, when knowable without side effects:
     only consulted if jax is already imported (same guard as telemetry.py)
@@ -631,7 +657,9 @@ def device_capacity_bytes() -> Optional[int]:
     if jax is None:
         return None
     try:
-        devices = jax.local_devices()
+        from ..utils.backend import bounded_local_devices
+
+        devices = bounded_local_devices()
         if not devices:
             return None
         stats = devices[0].memory_stats() or {}

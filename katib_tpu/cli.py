@@ -22,6 +22,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            since last report; --url asks a live controller's
                            /api/telemetry, --watch refreshes; else renders
                            the persisted series under <root>/telemetry/)
+  compile                  AOT compile service registry (fingerprint, state,
+                           cost estimate, compile time, trials served; --url
+                           asks a live controller's /api/compile, else reads
+                           the snapshot under <root>/compilesvc/)
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
   check [paths]            recompile-hazard / lock-discipline / repo-invariant
@@ -341,6 +345,67 @@ def cmd_top(args) -> int:
         print()
 
 
+def cmd_compile(args) -> int:
+    """AOT compile service registry (ISSUE 8 tentpole): which programs the
+    controller compiled ahead of dispatch, their fingerprint/state/cost and
+    how many trials each executable served. Live from a running
+    controller's /api/compile when --url is given; otherwise from the JSON
+    snapshot the service persists under <root>/compilesvc/."""
+    import os
+
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/api/compile"
+        try:
+            with urllib.request.urlopen(url) as r:
+                snap = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"no compile registry: HTTP {e.code} from {url}", file=sys.stderr)
+            return 1
+    else:
+        from .compilesvc.service import load_persisted_registry
+
+        snap = load_persisted_registry(os.path.join(args.root, "compilesvc"))
+        if snap is None:
+            print(
+                f"no persisted compile registry under {args.root}/compilesvc "
+                "(did the controller run with the compile service on and a "
+                "--root?); use --url for a live controller",
+                file=sys.stderr,
+            )
+            return 1
+    print(
+        f"compiled: {snap.get('compiled', 0)} | "
+        f"hits: {snap.get('hits', 0)} | misses: {snap.get('misses', 0)} | "
+        f"queued: {snap.get('queueDepth', 0)}"
+    )
+    rows = []
+    for e in snap.get("entries", []):
+        cost = e.get("costFlops") or 0
+        secs = e.get("compileSeconds")
+        rows.append(
+            (
+                e.get("fingerprint") or "-",
+                e.get("state", "?"),
+                e.get("experiment", "?"),
+                e.get("target", "?"),
+                f"{cost:.3g}" if cost else "-",
+                f"{secs:.2f}s" if secs is not None else "-",
+                str(e.get("trialsServed", 0)),
+            )
+        )
+    _table(
+        ["FINGERPRINT", "STATE", "EXPERIMENT", "TARGET", "COST-FLOPS",
+         "COMPILE", "TRIALS"],
+        rows,
+    )
+    if not rows:
+        print("(registry empty — no analyzable experiment has been admitted)")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -603,6 +668,20 @@ def main(argv=None) -> int:
     )
     tp.add_argument("--interval", type=float, default=5.0)
     tp.set_defaults(fn=cmd_top)
+
+    cp = sub.add_parser(
+        "compile",
+        help="AOT compile service registry (fingerprint, state, cost, "
+        "compile time, trials served)",
+    )
+    cp.add_argument(
+        "--url",
+        default=None,
+        help="base URL of a running 'katib-tpu ui' server for the live "
+        "/api/compile view (else reads the snapshot under "
+        "<root>/compilesvc/)",
+    )
+    cp.set_defaults(fn=cmd_compile)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")
     me.add_argument("trial")

@@ -1,0 +1,29 @@
+"""AOT compile service — compilation as a scheduled, cached resource.
+
+ROADMAP item 1 (ISSUE 8): BENCH_r02/r04 measured the e2e as
+compile-dominated (23–51s XLA compile vs ~2ms steps), and HPO is the
+pathological case — hundreds of trials differing only in runtime scalars.
+This package moves that cost off the dispatch path: a controller-owned
+:class:`~katib_tpu.compilesvc.service.CompileService` AOT-compiles each
+dispatch group's canonical program (the PR 7 ``ProgramProbe``) on a small
+worker pool and keeps a fingerprint-keyed executable registry the
+scheduler, pack formation and the runtime context consult as dict hits.
+"""
+
+from .service import (
+    STATE_COMPILING,
+    STATE_FAILED,
+    STATE_PENDING,
+    STATE_WARM,
+    CompileService,
+    WarmProgram,
+)
+
+__all__ = [
+    "CompileService",
+    "WarmProgram",
+    "STATE_PENDING",
+    "STATE_COMPILING",
+    "STATE_WARM",
+    "STATE_FAILED",
+]

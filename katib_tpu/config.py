@@ -60,6 +60,11 @@ class RuntimeConfig:
     stall_seconds: float = 120.0           # TrialStalled heartbeat threshold
     oom_risk_fraction: float = 0.9         # TrialOOMRisk host-memory fraction
     xla_cache_dir: Optional[str] = None
+    # persisted-entry threshold for the shared XLA cache
+    # (utils/compilation.py): 0.0 persists every compile — jax's own 1.0s
+    # default skipped sub-second programs and defeated warm-start for small
+    # CPU sweeps (ISSUE 8 satellite)
+    xla_cache_min_compile_seconds: float = 0.0
     devices_per_host: Optional[int] = None  # cap devices visible to the allocator
     metrics_poll_interval: float = 0.1
     # fair-share scheduling (controller/fairshare.py)
@@ -71,6 +76,15 @@ class RuntimeConfig:
     semantic_analysis: bool = True
     device_hbm_bytes: Optional[int] = None  # per-device capacity for the
     # pre-flight; None = detect from jax memory_stats when available
+    # AOT compile service (compilesvc/service.py): controller-side
+    # compilation plane — fingerprint-keyed executable registry, cost-
+    # ordered worker pool, compile-gated dispatch. compile_service=false /
+    # KATIB_TPU_COMPILE_SERVICE=0 restores legacy dispatch byte-identically.
+    compile_service: bool = True
+    compile_workers: int = 2               # AOT worker pool size
+    compile_gate_seconds: float = 0.0      # hold a dispatch unit up to this
+    # long for its warm executable (0 = never hold; inline-compile fallback)
+    compile_timeout_seconds: float = 600.0  # per-compile timeout (quarantine)
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -94,6 +108,7 @@ ENV_OVERRIDES: Dict[str, str] = {
     "stall_seconds": "KATIB_TPU_STALL_SECONDS",
     "oom_risk_fraction": "KATIB_TPU_OOM_RISK_FRACTION",
     "xla_cache_dir": "KATIB_TPU_XLA_CACHE",  # historical spelling
+    "xla_cache_min_compile_seconds": "KATIB_TPU_XLA_CACHE_MIN_COMPILE_SECONDS",
     "devices_per_host": "KATIB_TPU_DEVICES_PER_HOST",
     "metrics_poll_interval": "KATIB_TPU_METRICS_POLL_INTERVAL",
     "queue_stall_seconds": "KATIB_TPU_QUEUE_STALL_SECONDS",
@@ -101,6 +116,10 @@ ENV_OVERRIDES: Dict[str, str] = {
     "preemption_grace_seconds": "KATIB_TPU_PREEMPTION_GRACE_SECONDS",
     "semantic_analysis": "KATIB_TPU_SEMANTIC_ANALYSIS",
     "device_hbm_bytes": "KATIB_TPU_DEVICE_HBM_BYTES",
+    "compile_service": "KATIB_TPU_COMPILE_SERVICE",
+    "compile_workers": "KATIB_TPU_COMPILE_WORKERS",
+    "compile_gate_seconds": "KATIB_TPU_COMPILE_GATE_SECONDS",
+    "compile_timeout_seconds": "KATIB_TPU_COMPILE_TIMEOUT_SECONDS",
 }
 
 _FALSY = ("0", "false", "off")

@@ -329,6 +329,13 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_device_hbm_used_bytes": "Accelerator memory in use per local device (jax memory_stats).",
     "katib_xla_cache_entries": "Entries in the persistent XLA compilation cache.",
     "katib_xla_cache_bytes": "Total size of the persistent XLA compilation cache.",
+    # AOT compile service (katib_tpu/compilesvc) — the CompileFailed /
+    # BackendInitFailed warning events pair with these series
+    "katib_compile_queue_depth": "Compile jobs queued in the AOT compile service (cost-ordered).",
+    "katib_compile_cache_hit_total": "Trial submissions whose dispatch group was already warm in the executable registry.",
+    "katib_compile_cache_miss_total": "Trial submissions whose dispatch group was not yet warm (pending/compiling/new/failed).",
+    "katib_compile_failed_total": "AOT compiles that failed or timed out; the fingerprint group is quarantined.",
+    "katib_compile_seconds": "Wall-clock of AOT compiles executed by the service, per experiment.",
 }
 
 
@@ -377,4 +384,7 @@ EVENT_CATALOG: Dict[str, str] = {
     "TrialOOMRisk": "Monotonic RSS growth past runtime.oom_risk_fraction of host memory.",
     # semantic admission pre-flight (PR 7, analysis/program.py)
     "PredictedHbmNearCapacity": "Static peak-HBM estimate within the warning fraction of device memory.",
+    # AOT compile service (PR 8, katib_tpu/compilesvc)
+    "CompileFailed": "AOT compile failed or timed out; fingerprint quarantined, trials compile inline.",
+    "BackendInitFailed": "Accelerator backend init/probe failed or hung; device probing disabled for this process.",
 }

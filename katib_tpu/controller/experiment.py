@@ -83,6 +83,17 @@ class ExperimentController:
             # picked up by utils.compilation.enable_compilation_cache in
             # whichever process first touches JAX
             os.environ.setdefault("KATIB_TPU_XLA_CACHE", rt.xla_cache_dir)
+        if rt.xla_cache_min_compile_seconds:
+            from ..utils.compilation import ENV_MIN_COMPILE_SECS
+
+            # same propagation for the persisted-entry threshold: lazy
+            # enables in this process and trial subprocesses must agree on
+            # what gets persisted (ISSUE 8 satellite). Only a non-default
+            # threshold needs stamping — the in-repo default (persist
+            # everything) is what children fall back to anyway.
+            os.environ.setdefault(
+                ENV_MIN_COMPILE_SECS, str(rt.xla_cache_min_compile_seconds)
+            )
         self.root_dir = root_dir
         state_root = os.path.join(root_dir, "state") if (root_dir and persist) else None
         db_path = os.path.join(root_dir, "observations.db") if root_dir else None
@@ -135,6 +146,27 @@ class ExperimentController:
         )
         self._completed_seen: set = set()
         self._closed = threading.Event()
+        # AOT compile service (compilesvc/service.py, ISSUE 8): compilation
+        # as a scheduled resource — admission-time AOT compiles on a worker
+        # pool, fingerprint-keyed executable registry, compile-gated
+        # dispatch. Disabled (runtime.compile_service=false /
+        # KATIB_TPU_COMPILE_SERVICE=0) nothing is constructed and the
+        # scheduler's legacy dispatch is byte-identical.
+        self.compile_service = None
+        if rt.compile_service:
+            from ..compilesvc.service import CompileService
+
+            self.compile_service = CompileService(
+                workers=rt.compile_workers,
+                timeout_seconds=rt.compile_timeout_seconds,
+                metrics=self.metrics,
+                events=self.events,
+                tracer=self.tracer,
+                persist_dir=(
+                    os.path.join(root_dir, "compilesvc") if root_dir else None
+                ),
+            )
+            self.compile_service.start()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
         self.scheduler = TrialScheduler(
             self.state,
@@ -153,6 +185,8 @@ class ExperimentController:
             preemption_grace_seconds=rt.preemption_grace_seconds,
             tracer=self.tracer,
             telemetry=self.telemetry,
+            compile_service=self.compile_service,
+            compile_gate_seconds=rt.compile_gate_seconds,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -188,6 +222,15 @@ class ExperimentController:
                 spec.name, "Experiment", spec.name,
                 "PredictedHbmNearCapacity", hbm_warning, warning=True,
             )
+        if self.compile_service is not None:
+            # admission-time prewarm: the spec's baseline dispatch group
+            # starts compiling before the first suggestion batch, so a
+            # runtime-scalar sweep's shared executable is warm (or at least
+            # compiling) by the time trials queue
+            try:
+                self.compile_service.prewarm(spec)
+            except Exception:
+                log.debug("compile prewarm failed", exc_info=True)
         return exp
 
     def _semantic_preflight(self, spec: ExperimentSpec) -> Optional[str]:
@@ -531,5 +574,7 @@ class ExperimentController:
         self._closed.set()  # unhooks run() loops (incl. UI run-threads)
         self.scheduler.kill_all()
         self.scheduler.join(timeout=10)
+        if self.compile_service is not None:
+            self.compile_service.stop()
         self.telemetry.stop()
         self.obs_store.close()

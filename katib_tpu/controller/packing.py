@@ -120,6 +120,7 @@ def unpackable_reason(exp: Experiment, trial: Trial) -> Optional[str]:
 
 def plan_packs(
     waiting: Sequence[Tuple[Experiment, Trial]],
+    warm=None,
 ) -> List[Tuple[Experiment, List[Trial]]]:
     """Group the waiting queue into dispatch units, preserving order.
 
@@ -128,7 +129,14 @@ def plan_packs(
     grouped by (experiment name, stable template digest, fingerprint
     group) — mixed templates never pack, and members whose shape-affecting
     parameters differ (distinct compiled programs) never share a pack —
-    capped at the experiment's pack capacity K."""
+    capped at the experiment's pack capacity K.
+
+    ``warm`` (ISSUE 8): optional ``warm(exp, trial) -> bool`` predicate
+    from the AOT compile service. When given, units whose dispatch group
+    already has a warm executable are emitted ahead of cold units (stable
+    within each side), so pack formation prefers gangs that can start
+    without compiling. ``warm=None`` (service disabled) leaves the unit
+    order byte-identical to the legacy walk."""
     from ..analysis import program as semantic
 
     units: List[Tuple[Experiment, List[Trial]]] = []
@@ -154,6 +162,17 @@ def plan_packs(
             continue
         units.append((exp, [trial]))
         open_packs[key] = (len(units) - 1, k)
+    if warm is not None and len(units) > 1:
+        flags = []
+        for exp, members in units:
+            try:
+                flags.append(bool(warm(exp, members[0])))
+            except Exception:
+                flags.append(False)  # advisory: warmth must not break packs
+        if any(flags) and not all(flags):
+            units = [u for u, f in zip(units, flags) if f] + [
+                u for u, f in zip(units, flags) if not f
+            ]
     return units
 
 

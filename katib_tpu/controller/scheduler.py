@@ -103,6 +103,8 @@ class TrialScheduler:
         preemption_grace_seconds: float = 30.0,
         tracer=None,
         telemetry=None,
+        compile_service=None,
+        compile_gate_seconds: float = 0.0,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -158,6 +160,19 @@ class TrialScheduler:
         # backfill may only use free chips beyond the credits
         self._head_key: Optional[str] = None
         self._head_credits = 0
+        # -- AOT compile service (compilesvc/service.py) ---------------------
+        # None = disabled: every consult below is one `is None` check and
+        # dispatch is byte-identical to the legacy path
+        self.compile_service = compile_service
+        self.compile_gate_seconds = compile_gate_seconds
+        self._gate_since: Dict[Any, float] = {}  # group key -> hold start
+        self._gate_held: Dict[str, float] = {}   # trial -> hold start (spans)
+        self._gate_timer_live = False            # one wake timer per hold
+        if compile_service is not None:
+            # a program turning warm (or failing) re-runs the dispatch pass;
+            # the service notifies with NO service lock held, so the only
+            # lock edge is scheduler->service (from the dispatch walk)
+            compile_service.add_listener(self._on_compile_transition)
 
     # -- submission ----------------------------------------------------------
 
@@ -174,6 +189,19 @@ class TrialScheduler:
         same one-boolean-check contract as _tr()."""
         t = self.telemetry
         return t if (t is not None and t.enabled) else None
+
+    def _cs(self):
+        """The active compile service, or None when disabled — same
+        one-check contract as _tr()/_tm()."""
+        s = self.compile_service
+        return s if (s is not None and s.active) else None
+
+    def _on_compile_transition(self, key) -> None:
+        """CompileService listener (worker thread, no service lock held): a
+        group turned warm or was quarantined — re-run the dispatch pass so
+        gate-held units start (or fall back to inline compilation)."""
+        if not self._shutdown.is_set():
+            self._dispatch()
 
     def _trace_end_trial(self, exp_name: str, trial: Trial) -> None:
         """End the trial's root span once it is terminal (idempotent).
@@ -243,6 +271,20 @@ class TrialScheduler:
             return
         if tr is not None:
             tr.end_span(admission)
+        cs = self._cs()
+        if cs is not None:
+            # AOT compile request for this trial's dispatch group — dict hit
+            # after the first trial of a group; the compile itself runs on
+            # the service's worker pool, never on this thread
+            trace_ctx = None
+            if tr is not None:
+                root = tr.trial_root(exp.name, trial.name)
+                if root is not None:
+                    trace_ctx = (root.trace_id, root.span_id)
+            try:
+                cs.request(exp, trial, trace=trace_ctx)
+            except Exception:
+                log.debug("compile service request failed", exc_info=True)
         with self._lock:
             self._stamp_enqueue(exp, trial)
             self._waiting.append((exp, trial))
@@ -263,18 +305,33 @@ class TrialScheduler:
                     "queue_wait", exp.name, root.trace_id, root.span_id
                 )
 
-    def _clear_enqueue(self, trial_name: str) -> None:
+    def _clear_enqueue(self, trial_name: str, experiment: str = "") -> None:
         """Drop a trial's queue bookkeeping (dispatched or killed while
         pending); caller holds the scheduler lock."""
         self._enqueue_seq.pop(trial_name, None)
         self._enqueued_at.pop(trial_name, None)
         span = self._queue_spans.pop(trial_name, None)
+        gated_since = self._gate_held.pop(trial_name, None)
         if span is not None:
             tr = self._tr()
             if tr is not None:
                 # stall flag from PR 2's queue bookkeeping: was this wait
                 # long enough that TrialQueueStalled fired for it?
-                tr.end_span(span, stalled=trial_name in self._stall_emitted)
+                attrs: Dict[str, Any] = {
+                    "stalled": trial_name in self._stall_emitted
+                }
+                now = time.time()
+                if gated_since is not None:
+                    # Perfetto distinction: "waiting for chips" vs "waiting
+                    # for XLA" — this wait was (partly) the compile gate
+                    attrs["compileGated"] = True
+                    attrs["compileGateSeconds"] = round(now - gated_since, 3)
+                    if experiment:
+                        tr.record_span(
+                            "compile_gate", experiment, span.trace_id,
+                            span.parent_id, start=gated_since, end=now,
+                        )
+                tr.end_span(span, **attrs)
         self._stall_emitted.discard(trial_name)
 
     def dispatch(self) -> None:
@@ -346,7 +403,7 @@ class TrialScheduler:
                 if t.name == trial_name:
                     self._waiting.pop(i)
                     self._checkpoint_dirs.pop(trial_name, None)
-                    self._clear_enqueue(trial_name)
+                    self._clear_enqueue(trial_name, exp.name)
                     t.set_condition(TrialCondition.KILLED, "TrialKilled", "killed while pending")
                     self.state.update_trial(t)
                     self._trace_end_trial(exp.name, t)
@@ -370,6 +427,8 @@ class TrialScheduler:
             self._enqueued_at.clear()
             self._stall_emitted.clear()
             self._head_key, self._head_credits = None, 0
+            self._gate_since.clear()
+            self._gate_held.clear()
             queue_spans = dict(self._queue_spans)
             self._queue_spans.clear()
         for exp, t in waiting:
@@ -444,7 +503,17 @@ class TrialScheduler:
         now = time.time()
         with self._lock:
             self._threads = [t for t in self._threads if t.is_alive()]
-            units = plan_packs(self._waiting)
+            cs = self._cs()
+            warm = None
+            if cs is not None:
+                # pack formation prefers units whose dispatch group already
+                # has a warm executable (registry dict hit; advisory)
+                def warm(exp, trial, _cs=cs):
+                    try:
+                        return _cs.is_warm(exp.spec, trial)
+                    except Exception:
+                        return False
+            units = plan_packs(self._waiting, warm=warm)
             self._waiting = []
             entries: List[fs.QueueEntry] = []
             for exp, members in units:
@@ -483,6 +552,13 @@ class TrialScheduler:
                     # flow around freely
                     leftover.append(e)
                     continue
+                if not fairshare_on and self._gate_hold(e, now):
+                    # compile-gated: the unit's executable is still
+                    # compiling in the service — hold it (units behind flow
+                    # around, like a quota block) up to compile_gate_seconds,
+                    # then fall back to inline compilation
+                    leftover.append(e)
+                    continue
                 if fairshare_on:
                     if not head_seen and free < n:
                         # first blocked unit in policy order becomes the
@@ -515,19 +591,23 @@ class TrialScheduler:
             self._note_queue_state(leftover, now)
 
     def _fingerprint_grouped(self, entries):
-        """Legacy-path dispatch ordering (ISSUE 7): units whose trials
-        compile to the same program (equal semantic dispatch-group key,
-        analysis/program.py) dispatch consecutively, so the first unit's
-        trace/compile warms the jit and persistent-XLA caches for the rest
-        — the cheap precursor to ROADMAP 1's AOT compile service. Stable:
-        groups appear at their first member's arrival position, members
-        keep arrival order, and units with no key (analysis off, command
-        template, no probe) are singleton groups — with no keys the walk
+        """Legacy-path dispatch ordering (ISSUE 7 + ISSUE 8): units whose
+        trials compile to the same program (equal semantic dispatch-group
+        key, analysis/program.py) dispatch consecutively, so the first
+        unit's trace/compile warms the jit and persistent-XLA caches for
+        the rest; with the AOT compile service attached, groups whose
+        executable is already WARM in the registry dispatch before cold
+        groups (one dict lookup per group). Stable: groups appear at their
+        first member's arrival position, members keep arrival order, and
+        units with no key (analysis off, command template, no probe) are
+        singleton groups — with no keys (or no compile service) the walk
         is the identity, preserving FIFO exactly. Caller holds the
         scheduler lock."""
         from ..analysis import program as semantic
 
+        cs = self._cs()
         first_pos: Dict[Any, int] = {}
+        rank: Dict[Any, int] = {}
         keyed = []
         for i, e in enumerate(entries):
             try:
@@ -537,9 +617,62 @@ class TrialScheduler:
             gid = ("solo", i) if key is None else ("fp", key)
             if gid not in first_pos:
                 first_pos[gid] = i
-            keyed.append((first_pos[gid], i, e))
-        keyed.sort(key=lambda t: (t[0], t[1]))
-        return [e for _, _, e in keyed]
+                warm = False
+                if cs is not None and key is not None:
+                    from ..compilesvc.service import STATE_WARM
+
+                    warm = cs.state_for_key(key) == STATE_WARM
+                rank[gid] = 0 if warm else 1
+            keyed.append((rank[gid], first_pos[gid], i, e))
+        keyed.sort(key=lambda t: (t[0], t[1], t[2]))
+        return [e for _, _, _, e in keyed]
+
+    def _gate_hold(self, entry, now: float) -> bool:
+        """Compile-gated dispatch: True to hold a ready unit because the
+        service is still compiling its program (state pending/compiling)
+        and the hold is younger than compile_gate_seconds. The consult is a
+        dict lookup — dispatch never blocks inline on XLA; when the gate
+        expires (or the compile fails) the unit dispatches and compiles
+        inline exactly as before. Caller holds the scheduler lock."""
+        cs = self._cs()
+        if cs is None or self.compile_gate_seconds <= 0:
+            return False
+        from ..analysis import program as semantic
+        from ..compilesvc.service import STATE_COMPILING, STATE_PENDING
+
+        try:
+            key = semantic.dispatch_group_key(entry.exp.spec, entry.trials[0])
+        except Exception:
+            key = None
+        if key is None:
+            return False
+        state = cs.state_for_key(key)
+        if state not in (STATE_PENDING, STATE_COMPILING):
+            self._gate_since.pop(key, None)  # warm/failed/unknown: no hold
+            return False
+        since = self._gate_since.setdefault(key, now)
+        remaining = self.compile_gate_seconds - (now - since)
+        if remaining <= 0:
+            return False  # expired: inline-compile fallback (never re-held
+            # for this group until its state leaves pending/compiling)
+        for t in entry.trials:
+            # span bookkeeping: the queue_wait span of a gated trial gets
+            # compileGated/compileGateSeconds stamped at dispatch
+            self._gate_held.setdefault(t.name, since)
+        if not self._gate_timer_live:
+            # one wake timer per hold window so an expired gate re-runs the
+            # dispatch pass even if no compile transition fires
+            self._gate_timer_live = True
+            timer = threading.Timer(min(remaining, 1.0) + 0.02, self._gate_wake)
+            timer.daemon = True
+            timer.start()
+        return True
+
+    def _gate_wake(self) -> None:
+        with self._lock:
+            self._gate_timer_live = False
+        if not self._shutdown.is_set():
+            self._dispatch()
 
     def _start_unit(self, entry, devices) -> None:
         """Spawn the worker thread for one dispatch unit (solo or pack) and
@@ -552,7 +685,7 @@ class TrialScheduler:
             for t in members:
                 self._devices_clamped(exp, t, entry.requested, n)
         for t in members:
-            self._clear_enqueue(t.name)
+            self._clear_enqueue(t.name, exp.name)
         self._usage[exp.name] = self._usage.get(exp.name, 0) + n
         template = exp.spec.trial_template
         if len(members) == 1:
@@ -1433,6 +1566,16 @@ class TrialScheduler:
             workdir = os.path.join(self.workdir_root, exp.name, trial.name)
             os.makedirs(workdir, exist_ok=True)
         tm = self._tm()
+        compiled = None
+        cs = self._cs()
+        if cs is not None:
+            # warm handoff: the AOT-compiled executable for this trial's
+            # dispatch group (None when cold/evicted — the trial then
+            # compiles inline and the persistent XLA cache still applies)
+            try:
+                compiled = cs.warm_executable_for(exp.spec, trial)
+            except Exception:
+                compiled = None
         return TrialContext(
             trial_name=trial.name,
             experiment_name=exp.name,
@@ -1455,6 +1598,7 @@ class TrialScheduler:
                 (lambda pids, _t=trial.name, _tm=tm: _tm.set_pids(_t, pids))
                 if tm is not None else None
             ),
+            compiled_program=compiled,
         )
 
     CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions

@@ -44,6 +44,15 @@ class TrialContext:
     tracer: Optional[Any] = None
     trace_id: Optional[str] = None
     trace_parent: Optional[str] = None
+    # AOT compile service handoff (katib_tpu/compilesvc): the WarmProgram
+    # for this trial's dispatch group when the service compiled it ahead of
+    # dispatch — fingerprint + the jax.stages.Compiled executable, callable
+    # with concrete arrays matching the probe's avals. None when the
+    # service is off, the program is cold/evicted, or the template has no
+    # probe; trial code must treat it as an optional fast path and fall
+    # back to its own jit (which the shared persistent XLA cache still
+    # amortizes).
+    compiled_program: Optional[Any] = None
 
     def bind_trace(self, tracer, experiment: str, trace_id: str, parent_id: str) -> None:
         """Attach the trial's trace context (scheduler-side hook)."""

@@ -49,6 +49,7 @@ call site reduces to one boolean check: ``heartbeat``/``register_trial``/
 from __future__ import annotations
 
 import collections
+import functools
 import json
 import logging
 import os
@@ -167,21 +168,25 @@ def xla_cache_dir() -> Optional[str]:
     return os.environ.get("KATIB_TPU_XLA_CACHE", _DEFAULT_DIR)
 
 
-def read_device_memory() -> List[Dict[str, Any]]:
+def read_device_memory(events=None) -> List[Dict[str, Any]]:
     """Per-device accelerator memory from ``memory_stats()`` — ONLY when
     JAX is already imported (never initializes a backend from the sampler
     thread: a wedged tunnel would hang it), and tolerant of CPU backends
-    whose ``memory_stats`` is None/absent/empty."""
+    whose ``memory_stats`` is None/absent/empty. The device probe itself is
+    bounded (utils/backend.py): a wedged backend init costs one timeout,
+    emits ``BackendInitFailed`` once, and every later tick skips devices
+    instead of hanging the sampler."""
     import sys
 
     jax = sys.modules.get("jax")
     if jax is None:
         return []
+    from .utils.backend import bounded_local_devices
+
     out: List[Dict[str, Any]] = []
-    try:
-        devices = jax.local_devices()
-    except Exception:
-        return []  # backend not initialized / initialization failed
+    devices = bounded_local_devices(events=events)
+    if devices is None:
+        return []  # backend not initialized / init failed / probe wedged
     for d in devices:
         stats = None
         try:
@@ -273,10 +278,12 @@ class ResourceSampler:
         self._last_sample_at: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # overridable readers (tests inject synthetic RSS/CPU ramps)
+        # overridable readers (tests inject synthetic RSS/CPU ramps); the
+        # device reader carries the recorder so a wedged backend init
+        # surfaces as one BackendInitFailed event instead of a hung tick
         self._read_rss = read_rss_bytes
         self._read_cpu = read_cpu_seconds
-        self._read_devices = read_device_memory
+        self._read_devices = functools.partial(read_device_memory, events=events)
         if enabled and metrics is not None:
             metrics.add_collector(self._collect_gauges, names=COLLECTOR_GAUGES)
 

@@ -37,6 +37,11 @@ same information surface:
   GET /api/experiments/<e>/trials/<t>/telemetry one trial's resource time
                                                 series (live ring, or the
                                                 JSON persisted at trial end)
+  GET /api/compile                              AOT compile service registry
+                                                (fingerprint, state, cost,
+                                                compile time, trials served —
+                                                what `katib-tpu compile`
+                                                renders)
   GET /metrics                                  Prometheus text exposition
   GET /                                         single-page HTML dashboard
   GET /experiment/<name>                        experiment detail page (live
@@ -653,6 +658,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # trials with priority / wait / deficit, running units, and
                 # the device pool — the operator's starvation debugger
                 return self._send(ctrl.scheduler.queue_state())
+            if path == "/api/compile":
+                # AOT compile service registry (katib_tpu/compilesvc):
+                # fingerprint, state, cost, compile time, trials served —
+                # what `katib-tpu compile` renders
+                cs = getattr(ctrl, "compile_service", None)
+                if cs is None:
+                    return self._send(
+                        {"error": "compile service disabled on this controller"},
+                        code=404,
+                    )
+                return self._send(cs.registry_snapshot())
             if path == "/api/telemetry":
                 # cluster resource snapshot (telemetry.py): per-trial RSS/
                 # CPU/heartbeat age, per-device HBM, XLA cache — what

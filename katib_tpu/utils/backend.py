@@ -1,0 +1,113 @@
+"""Bounded accelerator-backend probing (ISSUE 8 satellite).
+
+Two bench rounds were lost to TPU backend init/probe failures
+(BENCH_r01–r05): the first ``jax.local_devices()`` of a process initializes
+the backend, and on a wedged tunneled runtime that call can block for
+minutes — inside the telemetry sampler tick, the admission pre-flight, or a
+compile worker. This module wraps the first probe in a
+retry-with-timeout helper that runs the init on a disposable daemon thread:
+a wedge costs the caller at most ``timeout_seconds`` per attempt, the
+failure is surfaced once as a ``BackendInitFailed`` warning event, and the
+process-wide verdict is cached so subsequent calls are either a direct
+(already-initialized, fast) call or an immediate None — never a second
+wedge.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, List, Optional
+
+log = logging.getLogger("katib_tpu.backend")
+
+_state_lock = threading.Lock()
+_BACKEND_OK: Optional[bool] = None  # None = not yet probed this process
+_EVENT_EMITTED = False
+
+
+def reset_probe_state() -> None:
+    """Test hook: forget the cached verdict + event dedup."""
+    global _BACKEND_OK, _EVENT_EMITTED
+    with _state_lock:
+        _BACKEND_OK = None
+        _EVENT_EMITTED = False
+
+
+def _emit_failed(events, reason: str) -> None:
+    global _EVENT_EMITTED
+    with _state_lock:
+        if _EVENT_EMITTED:
+            return
+        _EVENT_EMITTED = True
+    log.warning("accelerator backend init/probe failed: %s", reason)
+    if events is not None:
+        try:
+            events.event(
+                "", "Controller", "backend", "BackendInitFailed",
+                f"accelerator backend init/probe failed ({reason}); "
+                "device telemetry/capacity detection disabled for this "
+                "process — trials still run, but check the tunnel/runtime",
+                warning=True,
+            )
+        except Exception:
+            pass
+
+
+def bounded_local_devices(
+    timeout_seconds: float = 15.0,
+    retries: int = 2,
+    backoff_seconds: float = 1.0,
+    events=None,
+) -> Optional[List[Any]]:
+    """``jax.local_devices()`` with a bounded first init.
+
+    Returns the device list, or None when the backend cannot be probed —
+    after ``retries`` attempts of at most ``timeout_seconds`` each, a
+    ``BackendInitFailed`` warning event is emitted (once per process) and
+    every later call returns None immediately. Once a probe succeeds, later
+    calls go straight to ``jax.local_devices()`` (the backend is
+    initialized; the call is cheap)."""
+    global _BACKEND_OK
+    with _state_lock:
+        verdict = _BACKEND_OK
+    if verdict is False:
+        return None
+    if verdict is True:
+        import jax
+
+        try:
+            return jax.local_devices()
+        except Exception:
+            return None  # initialized backend lost mid-process; don't re-wedge
+
+    last_error = "?"
+    for attempt in range(max(int(retries), 1)):
+        box: dict = {}
+
+        def _probe():
+            try:
+                import jax
+
+                box["devices"] = jax.local_devices()
+            except BaseException as e:  # noqa: BLE001 — surfaced as the reason
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=_probe, daemon=True, name="backend-probe")
+        t.start()
+        t.join(timeout_seconds)
+        if t.is_alive():
+            last_error = f"probe hung past {timeout_seconds:.0f}s (attempt {attempt + 1})"
+        elif "error" in box:
+            last_error = box["error"]
+        else:
+            with _state_lock:
+                _BACKEND_OK = True
+            return box["devices"]
+        if attempt + 1 < max(int(retries), 1):
+            time.sleep(backoff_seconds)
+    with _state_lock:
+        _BACKEND_OK = False
+    _emit_failed(events, last_error)
+    return None

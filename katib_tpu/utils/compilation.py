@@ -14,6 +14,13 @@ import os
 from typing import Optional
 
 _DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache", "katib_tpu", "xla")
+# Persist EVERY compile by default (jax's own default of 1.0s skips
+# sub-second programs, which defeats warm-start for small CPU-bench sweeps
+# — ISSUE 8 satellite). Operators raise it via the RuntimeConfig field
+# `xla_cache_min_compile_seconds` / the env var below when cache-dir churn
+# matters more than warm-start.
+_DEFAULT_MIN_COMPILE_SECS = 0.0
+ENV_MIN_COMPILE_SECS = "KATIB_TPU_XLA_CACHE_MIN_COMPILE_SECONDS"
 _initialized = False
 
 
@@ -39,7 +46,23 @@ def _accelerator_platform(platforms: str, environ=None, libtpu_present=None) -> 
     )
 
 
-def enable_compilation_cache(directory: Optional[str] = None) -> str:
+def min_compile_seconds_from_env(default: float = _DEFAULT_MIN_COMPILE_SECS) -> float:
+    """The persisted-entry threshold: RuntimeConfig stamps
+    ``xla_cache_min_compile_seconds`` into the environment (so trial
+    subprocesses and lazy enables agree); a malformed value keeps the
+    default rather than crashing at import."""
+    raw = os.environ.get(ENV_MIN_COMPILE_SECS, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def enable_compilation_cache(
+    directory: Optional[str] = None, min_compile_seconds: Optional[float] = None
+) -> str:
     """Idempotently enable the persistent cache; returns the cache dir.
 
     Accelerator platforms only: XLA:CPU persists AOT results keyed loosely
@@ -63,9 +86,13 @@ def enable_compilation_cache(directory: Optional[str] = None) -> str:
     if not _accelerator_platform(platforms):
         _initialized = True
         return cache_dir
+    if min_compile_seconds is None:
+        min_compile_seconds = min_compile_seconds_from_env()
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+    )
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _initialized = True
     return cache_dir

@@ -24,11 +24,16 @@ echo "== katib-tpu analyze smoke (semantic program analysis) =="
 JAX_PLATFORMS=cpu python bench.py analyze_latency --smoke
 
 echo
+echo "== compile service smoke (AOT amortization) =="
+JAX_PLATFORMS=cpu python bench.py compile_amortization --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \
     "tests/test_telemetry.py::TestSampler::test_lock_order_under_concurrent_register_sample_scrape" \
     tests/test_obslog_pipeline.py::test_read_your_writes_under_concurrent_writers \
+    tests/test_compilesvc.py::test_lockgraph_stress_with_worker_pool_active \
     tests/test_static_analysis.py
 
 echo

@@ -604,6 +604,25 @@ def test_analyze_latency_smoke_stays_fast(bench):
     assert mnist["flops"] > 0 and lm["peak_bytes"] > 0
 
 
+def test_compile_amortization_smoke_wiring(bench):
+    """--smoke mode of the compile_amortization scenario (ISSUE 8): the
+    cold (service off, inline synthetic compile) and pre-warmed (service
+    on, executable handed via ctx.compiled_program) sweeps both run
+    end-to-end, the service compiled/traced the shared program exactly
+    once, and the warm side actually skipped the synthetic compile (its
+    e2e must undercut the cold side's floor — the synthetic cost — which
+    CI contention cannot fake). The >=2x target is the timed run's
+    acceptance number, reported as within_target."""
+    out = bench._bench_compile_amortization(smoke=True)
+    assert out["smoke"] is True
+    assert out["trials"] == 6
+    assert out["service_compiles"] == 1 and out["service_traces"] == 1
+    assert out["cold_s"] >= out["synthetic_compile_cost_s"]
+    assert 0 < out["warm_s"] < out["cold_s"]
+    assert out["target_speedup"] == 2.0
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -76,6 +76,20 @@ class TestUIServer:
         assert all(t["reason"] == "TrialSucceeded" for t in trials)
         assert all("x" in t["assignments"] for t in trials)
 
+    def test_compile_registry_endpoint(self, stack):
+        """GET /api/compile: the `katib-tpu compile` backend — the AOT
+        compile service's fingerprint-keyed registry with request stats.
+        The fixture's lambda template has no probe, so the registry is
+        empty — but the endpoint and stats shape must hold."""
+        base, ctrl, _ = stack
+        status, ctype, body = get(f"{base}/api/compile")
+        assert status == 200 and "json" in ctype
+        snap = json.loads(body)
+        assert "entries" in snap and isinstance(snap["entries"], list)
+        for field in ("compiled", "hits", "misses", "queueDepth"):
+            assert field in snap
+        assert snap == ctrl.compile_service.registry_snapshot()
+
     @pytest.mark.smoke
     def test_trials_pagination_envelope(self, stack):
         """Angular trials-table parity: offset/limit return a paged envelope
