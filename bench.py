@@ -1049,6 +1049,138 @@ def _bench_compile_amortization(smoke: bool = False):
     }
 
 
+def _bench_pbt_fused_throughput(smoke: bool = False):
+    """Fused population loops (ISSUE 9): generations/sec of one
+    lax.scan-fused PBT sweep vs the per-generation job-queue driver on the
+    same ``simple_pbt`` workload, plus the fused-vs-stepwise lineage
+    parity check (chunk=G vs chunk=1 of the identical program must match
+    bit-for-bit under the fixed seed). Target: >=5x generations/sec on
+    CPU — the legacy driver pays suggestion sync + dispatch walk + thread
+    spawn + DB commits per generation, the fused sweep pays them once.
+    ``smoke`` trims generation counts to wiring-check scale (no ratio
+    assertion: sub-second walls are scheduler noise)."""
+    import tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from katib_tpu.api import (
+        AlgorithmSetting, AlgorithmSpec, ExperimentSpec, FeasibleSpace,
+        ObjectiveSpec, ObjectiveType, ParameterSpec, ParameterType,
+        TrialTemplate,
+    )
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.models.simple_pbt import run_pbt_trial_packed
+    from katib_tpu.runtime import population as pop
+
+    population = 5
+    # multiple of the default chunk (16) so the sweep reuses ONE compiled
+    # scan program end to end (a ragged tail would compile a second)
+    fused_gens = 6 if smoke else 32
+    legacy_gens = 2 if smoke else 4  # the slow side: bounded on purpose
+
+    def spec_for(name, fused: bool, gens: int, root: str):
+        settings = [
+            AlgorithmSetting("n_population", str(population)),
+            AlgorithmSetting("truncation_threshold", "0.4"),
+            AlgorithmSetting("random_state", "13"),
+            AlgorithmSetting(
+                "suggestion_trial_dir", os.path.join(root, "pbt-state")
+            ),
+        ]
+        if fused:
+            settings.append(AlgorithmSetting("fused_generations", str(gens)))
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.0001", max="0.02"),
+                )
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="Validation-accuracy",
+            ),
+            algorithm=AlgorithmSpec("pbt", algorithm_settings=settings),
+            trial_template=TrialTemplate(function=run_pbt_trial_packed),
+            max_trial_count=population * gens,
+            parallel_trial_count=population,
+        )
+
+    def run_once(fused: bool, gens: int):
+        root = tempfile.mkdtemp(prefix="bench-fusedpop-")
+        cfg = KatibConfig()
+        cfg.runtime.fused_population = fused
+        cfg.runtime.telemetry = False
+        cfg.runtime.tracing = False
+        c = ExperimentController(
+            root_dir=root, devices=list(range(population)), config=cfg
+        )
+        try:
+            name = f"fusedpop-{'fused' if fused else 'legacy'}"
+            spec = spec_for(name, fused, gens, root)
+            c.create_experiment(spec)
+            if fused:
+                # let the admission prewarm land so the measured wall is the
+                # steady-state sweep, not the one-time AOT compile (the
+                # legacy side's jit cache is equally warm after gen 0)
+                key = pop.fused_group_key(spec, min(16, gens))
+                deadline = _time.time() + 60
+                while _time.time() < deadline:
+                    if c.compile_service is None or (
+                        c.compile_service.warm_executable_for_key(key)
+                        is not None
+                    ):
+                        break
+                    _time.sleep(0.02)
+            t0 = _time.time()
+            exp = c.run(name, timeout=600)
+            wall = _time.time() - t0
+            assert exp.status.is_succeeded, exp.status.message
+            if fused:
+                completed = gens
+            else:
+                # one legacy "generation" = one K-trial population round
+                # (suggestion sync + dispatch + K reports); the PBT lineage
+                # label lags this by a round, so count dispatched rounds
+                completed = len(c.state.list_trials(name)) // population
+            return completed / wall, completed, wall
+        finally:
+            c.close()
+
+    legacy_rate, legacy_done, legacy_wall = run_once(False, legacy_gens)
+    fused_rate, fused_done, fused_wall = run_once(True, fused_gens)
+
+    # lineage parity: the fused scan vs the per-generation (chunk=1) drive
+    # of the SAME program must agree bit-for-bit — score, best/median, and
+    # the exploit/explore lineage record
+    parity_spec = spec_for("fusedpop-parity", True, 8, tempfile.mkdtemp())
+    program = pop.build_program(parity_spec)
+    _, fused_ys = pop.run_generations(program, 8)
+    _, step_ys = pop.run_generations(program, 8, chunk=1)
+    parity = all(
+        _np.array_equal(fused_ys[k], step_ys[k]) for k in fused_ys
+    )
+
+    speedup = fused_rate / legacy_rate if legacy_rate else float("inf")
+    return {
+        "population": population,
+        "fused_generations": fused_done,
+        "legacy_generations": legacy_done,
+        "fused_gen_per_s": round(fused_rate, 2),
+        "legacy_gen_per_s": round(legacy_rate, 2),
+        "fused_wall_s": round(fused_wall, 3),
+        "legacy_wall_s": round(legacy_wall, 3),
+        "speedup": round(speedup, 2),
+        "lineage_bit_identical": parity,
+        "target_speedup": 5.0,
+        "within_target": speedup >= 5.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1534,6 +1666,13 @@ def child_main(platform: str) -> None:
             extras["fairshare_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
+    if os.environ.get("BENCH_SKIP_FUSEDPOP") != "1" and gate("pbt_fused", 90.0):
+        try:
+            extras["pbt_fused_throughput"] = _bench_pbt_fused_throughput()
+        except Exception as e:
+            extras["pbt_fused_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
     if os.environ.get("BENCH_SKIP_OBSLOG") != "1" and gate("obslog", 30.0):
         try:
             extras["obslog_report_throughput"] = _bench_obslog_report_throughput()
@@ -2003,6 +2142,7 @@ OBSLOG_SCENARIOS = {
     "check_latency": _bench_check_latency,
     "analyze_latency": _bench_analyze_latency,
     "compile_amortization": _bench_compile_amortization,
+    "pbt_fused_throughput": _bench_pbt_fused_throughput,
 }
 
 

@@ -165,7 +165,14 @@ def _canon_aval(aval) -> str:
     if dtype is None or shape is None:
         return f"opaque:{type(aval).__name__}"
     w = "w" if getattr(aval, "weak_type", False) else ""
-    return f"{np.dtype(dtype).name}[{'x'.join(str(d) for d in shape)}]{w}"
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        # jax extended dtypes (typed PRNG keys such as key<fry> appear in
+        # any jaxpr whose body calls jax.random) have no numpy equivalent;
+        # their str() form is deterministic and impl-qualified
+        name = str(dtype)
+    return f"{name}[{'x'.join(str(d) for d in shape)}]{w}"
 
 
 def _canon_value(v) -> str:

@@ -406,6 +406,62 @@ def cmd_compile(args) -> int:
     return 0
 
 
+def cmd_population(args) -> int:
+    """Fused population sweep view (ISSUE 9): per-generation best/median
+    from the ``<experiment>-population`` pseudo-trial rows the fused
+    executor demuxes, plus the in-flight sweep checkpoint (generations
+    done / demux progress) when one is persisted under
+    ``<root>/fusedpop/<experiment>/``."""
+    import os
+
+    from .db.store import open_store
+    from .runtime.population import CARRY_META_FILE
+
+    meta_path = os.path.join(
+        args.root, "fusedpop", args.experiment, CARRY_META_FILE
+    )
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            print(
+                f"in-flight sweep: {meta.get('generationDone', 0)} "
+                f"generation(s) computed, {meta.get('reported', 0)} of the "
+                "interrupted chunk demuxed (resumes bit-identically)"
+            )
+        except (OSError, ValueError):
+            print("in-flight sweep: checkpoint unreadable", file=sys.stderr)
+    db = os.path.join(args.root, "observations.db")
+    store = open_store(db if os.path.exists(db) else None)
+    # rows arrive in demux order (best, median per generation); group
+    # sequentially — two fast generations can share a float timestamp
+    rows = []
+    slot: dict = {}
+    for log in store.get_observation_log(f"{args.experiment}-population"):
+        if log.metric_name in slot:
+            rows.append(slot)
+            slot = {}
+        slot[log.metric_name] = log.value
+    if slot:
+        rows.append(slot)
+    store.close()
+    table = [
+        (
+            str(gen),
+            s.get("population-best", "-"),
+            s.get("population-median", "-"),
+        )
+        for gen, s in enumerate(rows)
+    ]
+    _table(["GEN", "BEST", "MEDIAN"], table)
+    if not table:
+        print(
+            "(no population rows — was this experiment run with the fused "
+            "population driver and a --root?)"
+        )
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -687,6 +743,14 @@ def main(argv=None) -> int:
     me.add_argument("trial")
     me.add_argument("--metric", default=None)
     me.set_defaults(fn=cmd_metrics)
+
+    po = sub.add_parser(
+        "population",
+        help="fused population sweep: per-generation best/median + "
+        "in-flight checkpoint state",
+    )
+    po.add_argument("experiment")
+    po.set_defaults(fn=cmd_population)
 
     sub.add_parser("algorithms", help="list registered algorithms").set_defaults(fn=cmd_algorithms)
 

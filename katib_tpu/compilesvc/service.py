@@ -319,7 +319,7 @@ class CompileService:
             else:
                 hit = False
                 entry, job = self._admit_locked(
-                    key, exp.spec, dict(trial.assignments_dict()), trace,
+                    key, exp.spec.name, dict(trial.assignments_dict()), trace,
                     admission,
                 )
                 if entry is not None:
@@ -354,13 +354,73 @@ class CompileService:
             entry = self._by_key.get(key)
             if entry is None:
                 entry, job = self._admit_locked(
-                    key, spec, dict(baseline), None, admission
+                    key, spec.name, dict(baseline), None, admission
                 )
                 if entry is not None:
                     entry.prewarmed = True
         if job is not None:
             self._enqueue(job)
         return key
+
+    def request_group(
+        self,
+        key: Any,
+        experiment: str,
+        target: str,
+        builder: Callable[[Dict[str, str]], Any],
+        assignments: Optional[Dict[str, str]] = None,
+        cost_flops: float = 0.0,
+        trace: Optional[Tuple[str, str]] = None,
+    ) -> Optional[Any]:
+        """Generic group admission — the fused population runtime (and any
+        future non-per-trial program source) registers its program under an
+        explicit registry key with its own ProgramProbe builder. Same
+        lifecycle as a per-trial dispatch group: pending → compiling →
+        warm/failed, fingerprint-deduplicated, cost-ordered, quarantined on
+        failure. Returns the key (None when the service is stopped)."""
+        if not self._running:
+            return None
+        job = None
+        with self._lock:
+            entry = self._by_key.get(key)
+            if entry is not None:
+                entry.trials_served += 1
+                if entry.trace is None and trace is not None:
+                    entry.trace = trace
+                hit = entry.state == STATE_WARM
+            else:
+                hit = False
+                entry, job = self._admit_locked(
+                    key, experiment, dict(assignments or {}), trace,
+                    (builder, target, float(cost_flops)),
+                )
+                if entry is not None:
+                    entry.trials_served = 1
+        self._count_request(experiment, hit)
+        if job is not None:
+            self._enqueue(job)
+        return key
+
+    def warm_executable_for_key(self, key: Any) -> Optional[WarmProgram]:
+        """The compiled executable for an explicit registry key, when warm
+        and still resident — the request_group counterpart of
+        ``warm_executable_for``."""
+        if key is None:
+            return None
+        with self._lock:
+            entry = self._by_key.get(key)
+            if (
+                entry is None
+                or entry.state != STATE_WARM
+                or entry.executable is None
+            ):
+                return None
+            return WarmProgram(
+                fingerprint=entry.fingerprint,
+                executable=entry.executable,
+                target=entry.target,
+                compile_seconds=entry.compile_seconds or 0.0,
+            )
 
     @staticmethod
     def _resolve_admission(spec) -> Optional[Tuple[Callable, str, float]]:
@@ -380,7 +440,7 @@ class CompileService:
         return builder, target, cost
 
     def _admit_locked(
-        self, key, spec, assignments: Dict[str, str], trace, admission
+        self, key, experiment: str, assignments: Dict[str, str], trace, admission
     ) -> Tuple[Optional[CompileEntry], Optional[_Job]]:
         """Create the registry entry + job for a new group. Caller holds the
         service lock; ``admission`` was resolved outside it."""
@@ -388,13 +448,13 @@ class CompileService:
             return None, None
         builder, target, cost = admission
         entry = CompileEntry(
-            key=key, experiment=spec.name, target=target, cost_flops=cost,
+            key=key, experiment=experiment, target=target, cost_flops=cost,
             trace=trace,
         )
         self._by_key[key] = entry
         job = _Job(
             key=key,
-            experiment=spec.name,
+            experiment=experiment,
             target=target,
             builder=builder,
             assignments=assignments,

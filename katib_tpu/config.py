@@ -85,6 +85,22 @@ class RuntimeConfig:
     compile_gate_seconds: float = 0.0      # hold a dispatch unit up to this
     # long for its warm executable (0 = never hold; inline-compile fallback)
     compile_timeout_seconds: float = 600.0  # per-compile timeout (quarantine)
+    # Fused on-device population loops (runtime/population.py): a PBT/ENAS
+    # spec that opts in (algorithm setting fused/fused_generations) and
+    # whose trial function exposes a population_program probe runs its
+    # WHOLE sweep as one lax.scan program per gang dispatch.
+    # fused_population=false / KATIB_TPU_FUSED_POPULATION=0 restores the
+    # per-generation job-queue driver byte-identically.
+    fused_population: bool = True
+    # scan chunk length: the sweep checkpoints its carry (and honors
+    # cooperative preemption) at every chunk boundary. 0 = one chunk per
+    # sweep (no intermediate checkpoints).
+    population_chunk_generations: int = 16
+    # io_callback stream of {generation, best, median} from inside the
+    # compiled scan: live `katib-tpu top` visibility plus the watchdog
+    # heartbeat for chunks longer than stall_seconds. Off by default — the
+    # callback is a per-generation host sync.
+    population_stream_telemetry: bool = False
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -120,6 +136,9 @@ ENV_OVERRIDES: Dict[str, str] = {
     "compile_workers": "KATIB_TPU_COMPILE_WORKERS",
     "compile_gate_seconds": "KATIB_TPU_COMPILE_GATE_SECONDS",
     "compile_timeout_seconds": "KATIB_TPU_COMPILE_TIMEOUT_SECONDS",
+    "fused_population": "KATIB_TPU_FUSED_POPULATION",
+    "population_chunk_generations": "KATIB_TPU_POPULATION_CHUNK_GENERATIONS",
+    "population_stream_telemetry": "KATIB_TPU_POPULATION_STREAM_TELEMETRY",
 }
 
 _FALSY = ("0", "false", "off")

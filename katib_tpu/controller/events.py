@@ -336,6 +336,9 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_compile_cache_miss_total": "Trial submissions whose dispatch group was not yet warm (pending/compiling/new/failed).",
     "katib_compile_failed_total": "AOT compiles that failed or timed out; the fingerprint group is quarantined.",
     "katib_compile_seconds": "Wall-clock of AOT compiles executed by the service, per experiment.",
+    # fused population loops (katib_tpu/runtime/population.py, ISSUE 9)
+    "katib_population_generations_total": "PBT/ENAS generations executed by the fused population runtime.",
+    "katib_population_fused_seconds": "Wall-clock of fused population scan chunks (one compiled program per chunk).",
 }
 
 
@@ -387,4 +390,6 @@ EVENT_CATALOG: Dict[str, str] = {
     # AOT compile service (PR 8, katib_tpu/compilesvc)
     "CompileFailed": "AOT compile failed or timed out; fingerprint quarantined, trials compile inline.",
     "BackendInitFailed": "Accelerator backend init/probe failed or hung; device probing disabled for this process.",
+    # fused population loops (PR 9, katib_tpu/runtime/population.py)
+    "PopulationFused": "Opted-in PBT/ENAS sweep dispatched as one fused on-device population program.",
 }

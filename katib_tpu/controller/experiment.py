@@ -79,6 +79,12 @@ class ExperimentController:
         # one switch for every consumer, including the lock-free dispatch
         # paths (packing keys, fingerprint-grouped ordering)
         semantic_analysis.set_enabled(rt.semantic_analysis)
+        from ..runtime import population as fused_population
+
+        # same one-switch pattern for the fused population runtime: pack
+        # capacity, executor selection and the fused reconcile branch all
+        # consult runtime_enabled()
+        fused_population.set_enabled(rt.fused_population)
         if rt.xla_cache_dir:
             # picked up by utils.compilation.enable_compilation_cache in
             # whichever process first touches JAX
@@ -187,6 +193,9 @@ class ExperimentController:
             telemetry=self.telemetry,
             compile_service=self.compile_service,
             compile_gate_seconds=rt.compile_gate_seconds,
+            fused_population=rt.fused_population,
+            population_chunk_generations=rt.population_chunk_generations,
+            population_stream=rt.population_stream_telemetry,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -231,6 +240,16 @@ class ExperimentController:
                 self.compile_service.prewarm(spec)
             except Exception:
                 log.debug("compile prewarm failed", exc_info=True)
+            # fused population sweeps: the whole G-generation scan program
+            # is fingerprinted and AOT-prewarmed like any dispatch group,
+            # so the sweep compiles exactly once — in the service, before
+            # chips are allocated (best-effort inside prewarm_fused)
+            from ..runtime import population as fused_population
+
+            fused_population.prewarm_fused(
+                self.compile_service, spec,
+                self.config.runtime.population_chunk_generations,
+            )
         return exp
 
     def _semantic_preflight(self, spec: ExperimentSpec) -> Optional[str]:
@@ -348,6 +367,13 @@ class ExperimentController:
         return gauges
 
     def _reconcile_trials(self, exp: Experiment, trials: List[Trial]) -> None:
+        from ..runtime import population as fused_population
+
+        if fused_population.fused_applicable(exp.spec) is None:
+            # opted-in population sweep: no per-generation suggestion sync —
+            # the whole sweep dispatches once as one fused gang unit
+            self._reconcile_fused(exp, trials)
+            return
         sts = exp.status
         parallel = exp.spec.parallel_trial_count or 1
         active = sts.trials_pending + sts.trials_running
@@ -406,6 +432,81 @@ class ExperimentController:
             self.scheduler.submit(
                 exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
             )
+        self.scheduler.dispatch()
+
+    def _reconcile_fused(self, exp: Experiment, trials: List[Trial]) -> None:
+        """Dispatch (or supervise) one fused population sweep
+        (runtime/population.py): K member trials — one per population slot,
+        alive for the whole sweep — are created once, submitted as a batch
+        and pack-formed into ONE gang unit that the scheduler routes to the
+        FusedPopulationExecutor. The suggestion plane never runs; search
+        end is declared at submission, so the experiment completes exactly
+        when the sweep's members reach their terminal conditions."""
+        from ..api.spec import ParameterAssignment
+        from ..runtime import population as pop
+
+        if trials:
+            if all(t.is_terminal for t in trials):
+                # re-assert after a controller restart (the fresh
+                # SuggestionService lost the in-memory search-end mark)
+                self.suggestions.mark_search_ended(exp.name)
+            return
+        try:
+            program = pop.build_program(exp.spec)
+            members = (
+                program.initial_assignments(program.seed)
+                if program.initial_assignments is not None
+                else [{} for _ in range(program.n_population)]
+            )
+            total = pop.generation_count(exp.spec, program)
+        except Exception as e:
+            raise SuggestionFailed(
+                f"fused population program construction failed: "
+                f"{type(e).__name__}: {e}"
+            )
+        self.events.event(
+            exp.name, "Experiment", exp.name, "PopulationFused",
+            f"dispatching {program.n_population} members x {total} "
+            "generations as one fused compiled program "
+            f"({pop.SETTING_GENERATIONS}={total})",
+        )
+        ck_root = (
+            os.path.join(self.root_dir, "fusedpop", exp.name)
+            if self.root_dir
+            else None
+        )
+        suggest_ts = time.time()
+        for i, params in enumerate(members):
+            trial = Trial(
+                name=pop.member_name(exp.spec, i),
+                experiment_name=exp.name,
+                parameter_assignments=[
+                    ParameterAssignment(k, v) for k, v in sorted(params.items())
+                ],
+                labels={
+                    pop.FUSED_LABEL: str(i),
+                    "katib-tpu/experiment": exp.name,
+                },
+            )
+            self.state.create_trial(trial)
+            if self.tracer.enabled:
+                root = self.tracer.begin_trial(
+                    exp.name, trial.name, start=suggest_ts
+                )
+                if root is not None:
+                    self.tracer.record_span(
+                        "suggestion", exp.name, root.trace_id, root.span_id,
+                        start=suggest_ts, end=suggest_ts,
+                        algorithm=exp.spec.algorithm.algorithm_name,
+                        fused=True, batch=len(members),
+                    )
+            self.scheduler.submit(
+                exp, trial, checkpoint_dir=ck_root, dispatch=False
+            )
+        # the sweep IS the search: once its members finish, no further
+        # suggestions exist, and active==0 + search-end completes the
+        # experiment
+        self.suggestions.mark_search_ended(exp.name)
         self.scheduler.dispatch()
 
     @staticmethod

@@ -105,6 +105,9 @@ class TrialScheduler:
         telemetry=None,
         compile_service=None,
         compile_gate_seconds: float = 0.0,
+        fused_population: bool = True,
+        population_chunk_generations: int = 16,
+        population_stream: bool = False,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -165,6 +168,12 @@ class TrialScheduler:
         # dispatch is byte-identical to the legacy path
         self.compile_service = compile_service
         self.compile_gate_seconds = compile_gate_seconds
+        # -- fused population loops (runtime/population.py) ------------------
+        # off, or for any pack that is not an opted-in fused sweep, the
+        # PackedTrialExecutor path below is byte-identical to before
+        self.fused_population = fused_population
+        self.population_chunk_generations = population_chunk_generations
+        self.population_stream = population_stream
         self._gate_since: Dict[Any, float] = {}  # group key -> hold start
         self._gate_held: Dict[str, float] = {}   # trial -> hold start (spans)
         self._gate_timer_live = False            # one wake timer per hold
@@ -1036,7 +1045,7 @@ class TrialScheduler:
         each member is classified/finalized independently, exactly like K
         solo trials would be."""
         from ..tracing import pop_log_context, push_log_context
-        from .packing import PACK_LABEL, PackedTrialExecutor
+        from .packing import PACK_LABEL
 
         timer = None
         started = time.time()
@@ -1101,7 +1110,7 @@ class TrialScheduler:
                 # shared compiled program: compile/steps/flush spans land in
                 # the gang trace under the pack root
                 ctx.bind_trace(tr, exp.name, gang.trace_id, gang.root.span_id)
-            executor = PackedTrialExecutor(self.obs_store)
+            executor = self._pack_executor(exp, trials)
             results, abandoned = self._execute_pack_bounded(
                 executor, exp, trials, ctx, handles, timed_out
             )
@@ -1176,6 +1185,29 @@ class TrialScheduler:
             for t in trials:
                 self.events.put(TrialEvent(exp.name, t.name, t.condition))
             self._dispatch()
+
+    def _pack_executor(self, exp: Experiment, trials: List[Trial]):
+        """Executor for one formed pack: an opted-in fused population sweep
+        (every member carries the fused label and the template exposes a
+        population_program probe) runs through the FusedPopulationExecutor
+        — the whole sweep in compiled lax.scan chunks; anything else keeps
+        the PackedTrialExecutor path unchanged."""
+        from ..runtime import population as pop
+        from .packing import FusedPopulationExecutor, PackedTrialExecutor
+
+        if (
+            self.fused_population
+            and all(pop.FUSED_LABEL in t.labels for t in trials)
+            and pop.fused_applicable(exp.spec) is None
+        ):
+            return FusedPopulationExecutor(
+                self.obs_store,
+                chunk_generations=self.population_chunk_generations,
+                stream=self.population_stream,
+                compile_service=self._cs(),
+                metrics=self.metrics_registry,
+            )
+        return PackedTrialExecutor(self.obs_store)
 
     def _execute_pack_bounded(
         self,

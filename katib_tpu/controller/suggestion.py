@@ -120,6 +120,13 @@ class SuggestionService:
     def search_ended(self, experiment_name: str) -> bool:
         return self._search_ended.get(experiment_name, False)
 
+    def mark_search_ended(self, experiment_name: str) -> None:
+        """Declare search end without a suggester round-trip — the fused
+        population path (controller/experiment._reconcile_fused) submits
+        its whole sweep up front, so there are no further suggestions by
+        construction."""
+        self._search_ended[experiment_name] = True
+
     def get_or_create(self, exp: Experiment, requests: int) -> SuggestionState:
         """reference experiment/suggestion/suggestion.go:53-112."""
         s = self.state.get_suggestion(exp.name)

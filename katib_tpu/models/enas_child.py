@@ -230,3 +230,293 @@ def run_enas_trial(assignments: Dict[str, str], ctx=None) -> None:
             print(f"Epoch {epoch+1}:")
             print(f"Validation-accuracy={acc}")
             print(f"Train-loss={float(loss)}")
+
+
+# ---------------------------------------------------------------------------
+# Fused population probes (ISSUE 9): ENAS as one compiled generation program
+# ---------------------------------------------------------------------------
+
+def abstract_enas_child_program(assignments: Dict[str, str]):
+    """Abstract program probe (katib_tpu.analysis.program): the canonical
+    jitted child train step under a default (or assignment-supplied)
+    architecture, with learning_rate as a traced f32 scalar input — the
+    analyzer classifies the ENAS child instead of raising KTX404, and the
+    compile service can prewarm the child program at admission."""
+    from ..analysis.program import ProgramProbe
+
+    if "architecture" in assignments and "nn_config" in assignments:
+        arch = json.loads(assignments["architecture"].replace("'", '"'))
+        nn_config = json.loads(assignments["nn_config"].replace("'", '"'))
+        embedding = nn_config["embedding"]
+        num_classes = int(nn_config["output_sizes"][-1])
+    else:
+        # probe-default architecture: 2 conv layers, one skip bit
+        arch = [[0], [0, 1]]
+        embedding = {
+            "0": {
+                "opt_id": 0,
+                "opt_type": "convolution",
+                "opt_params": {"num_filter": 8, "filter_size": 3},
+            }
+        }
+        num_classes = 10
+    batch_size = int(assignments.get("batch_size", "8"))
+    model = EnasChildNet(
+        arch=tuple(tuple(l) for l in arch),
+        embedding=embedding,
+        num_classes=num_classes,
+    )
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    probe_x = jax.ShapeDtypeStruct((2, 32, 32, 3), jnp.float32)
+    params = jax.eval_shape(
+        lambda r, x: model.init(
+            {"params": r, "dropout": r}, x, train=True
+        )["params"],
+        rng, probe_x,
+    )
+    bx = jax.ShapeDtypeStruct((batch_size, 32, 32, 3), jnp.float32)
+    by = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def train_step(params, lr, key, bx, by):
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p}, bx, train=True, rngs={"dropout": key}
+            )
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, by
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return ProgramProbe(
+        fn=train_step,
+        args=(params, lr, rng, bx, by),
+        params=params,
+        hyperparams={"learning_rate": lr},
+        host_params={"num_epochs", "num_train_examples", "dataset"},
+    )
+
+
+run_enas_trial.abstract_program = abstract_enas_child_program
+
+
+def _supernet_init(key, num_layers: int, op_kernels, in_ch: int,
+                   channels: int, num_classes: int):
+    """Shared-supernet parameters: one stem conv, per-(layer, op) kernels
+    for the conv-family ops (pool ops are parameterless), one classifier."""
+    n_params = 2 + sum(1 for ks in op_kernels if ks is not None) * num_layers
+    keys = jax.random.split(key, n_params)
+    it = iter(keys)
+
+    def conv_init(k, kh, kw, cin, cout):
+        scale = 1.0 / np.sqrt(kh * kw * cin)
+        return jax.random.uniform(
+            k, (kh, kw, cin, cout), minval=-scale, maxval=scale
+        )
+
+    params = {"stem": conv_init(next(it), 3, 3, in_ch, channels)}
+    for l in range(num_layers):
+        layer = {}
+        for o, ks in enumerate(op_kernels):
+            if ks is not None:
+                layer[f"op{o}"] = conv_init(next(it), ks, ks, channels, channels)
+        params[f"layer{l}"] = layer
+    params["head"] = conv_init(next(it), 1, 1, channels, num_classes)
+    return params
+
+
+def _supernet_apply(params, x, arc_flat, num_layers: int, op_kinds):
+    """Forward one architecture through the shared supernet: every op
+    branch is computed and the sampled op selected via one-hot mixing
+    (jnp.where-style traceable selection — the weight-sharing trick that
+    makes ENAS architectures indexable instead of rebuilt per sample), and
+    skip bits gate additive connections to earlier layers."""
+    h = jax.lax.conv_general_dilated(
+        x, params["stem"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    outs = [h]
+    offset = 0
+    num_ops = len(op_kinds)
+    for l in range(num_layers):
+        op_id = arc_flat[offset]
+        skips = arc_flat[offset + 1: offset + 1 + l]
+        offset += 1 + l
+        inp = outs[-1]
+        if l > 0:
+            gates = skips.astype(jnp.float32)
+            mixed = inp
+            for i in range(l):
+                mixed = mixed + gates[i] * outs[i]
+            inp = mixed / (1.0 + gates.sum())
+        branches = []
+        layer_params = params[f"layer{l}"]
+        for o, kind in enumerate(op_kinds):
+            if kind == "pool_avg":
+                branches.append(
+                    jax.lax.reduce_window(
+                        inp, 0.0, jax.lax.add, (1, 2, 2, 1), (1, 1, 1, 1),
+                        "SAME",
+                    ) / 4.0
+                )
+            elif kind == "pool_max":
+                branches.append(
+                    jax.lax.reduce_window(
+                        inp, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
+                        (1, 1, 1, 1), "SAME",
+                    )
+                )
+            else:
+                branches.append(
+                    jax.nn.relu(
+                        jax.lax.conv_general_dilated(
+                            inp, layer_params[f"op{o}"], (1, 1), "SAME",
+                            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                        )
+                    )
+                )
+        stacked = jnp.stack(branches)           # [O, N, H, W, C]
+        onehot = jax.nn.one_hot(op_id, num_ops) # traced op selection
+        h = jnp.einsum("o,onhwc->nhwc", onehot, stacked)
+        outs.append(h)
+    pooled = outs[-1].mean(axis=(1, 2))         # global average pool
+    logits = jnp.einsum(
+        "nc,cd->nd", pooled, params["head"][0, 0]
+    )
+    return logits
+
+
+def _op_kind(cfg: Dict[str, Any]):
+    """Map one expanded NAS operation onto the supernet op bank: conv-family
+    ops keep a per-(layer, op) kernel of their configured size; reductions
+    become shape-preserving pools (stride-1 SAME — the weight-sharing
+    surrogate of the pooling op)."""
+    t = cfg.get("opt_type", "convolution")
+    if t == "reduction":
+        if cfg.get("opt_params", {}).get("reduction_type") == "avg_pooling":
+            return "pool_avg", None
+        return "pool_max", None
+    size = int(cfg.get("opt_params", {}).get("filter_size", 3))
+    return "conv", size
+
+
+def enas_population_program(spec):
+    """Fused population probe (katib_tpu.runtime.population): the whole
+    ENAS search — controller-LSTM sampling, weight-shared child
+    train/eval, REINFORCE update — as one generation step run under
+    ``lax.scan``. The child is the shared supernet above trained on the
+    real bundled digits set (a small slice, so a CPU test sweep stays
+    fast); settings ``fused_child_examples`` / ``fused_child_batch`` /
+    ``fused_child_steps`` size it."""
+    from ..runtime import population as pop
+    from ..suggest.nas.enas import expand_operations, parse_enas_settings
+    from ..utils.datasets import load_dataset
+
+    settings = parse_enas_settings(spec)
+    raw = spec.algorithm.settings_dict()
+    nas = spec.nas_config
+    num_layers = int(nas.graph_config.num_layers)
+    ops = expand_operations(nas)
+    kinds, sizes = [], []
+    for cfg in ops:
+        kind, size = _op_kind(cfg)
+        kinds.append(kind)
+        sizes.append(size)
+    op_kernels = [s for s in sizes]
+    num_classes = int(nas.graph_config.output_sizes[-1])
+    channels = int(raw.get("fused_child_channels", "8"))
+    n_examples = int(raw.get("fused_child_examples", "192"))
+    batch = int(raw.get("fused_child_batch", "32"))
+    train_steps = int(raw.get("fused_child_steps", "1"))
+    k_pop = int(raw.get("n_population", raw.get("fused_population_size", "8")))
+    lr = float(raw.get("fused_child_lr", "0.05"))
+
+    x, y = load_dataset("digits", "train", n=n_examples)
+    split = max(int(len(x) * 0.75), 1)
+    x_t = jnp.asarray(x[:split], jnp.float32)
+    y_t = jnp.asarray(y[:split], jnp.int32)
+    x_v = jnp.asarray(x[split:], jnp.float32)
+    y_v = jnp.asarray(y[split:], jnp.int32)
+    in_ch = x_t.shape[-1]
+    n_train = x_t.shape[0]
+    batch = min(batch, n_train)
+
+    def child_init(key):
+        return {
+            "params": _supernet_init(
+                key, num_layers, op_kernels, in_ch, channels, num_classes
+            ),
+            "step": jnp.asarray(0, jnp.int32),
+        }
+
+    def child_train_eval(child_state, arcs, key, active):
+        del key
+        params = child_state["params"]
+        step = child_state["step"]
+        weights = active.astype(jnp.float32)
+        weights = weights / jnp.maximum(weights.sum(), 1.0)
+
+        def one_train_step(i, st):
+            params, step = st
+            start = ((step + i) * batch) % jnp.maximum(n_train - batch + 1, 1)
+            bx = jax.lax.dynamic_slice_in_dim(x_t, start, batch, axis=0)
+            by = jax.lax.dynamic_slice_in_dim(y_t, start, batch, axis=0)
+
+            def loss_fn(p):
+                logits = jax.vmap(
+                    lambda a: _supernet_apply(p, bx, a, num_layers, kinds)
+                )(arcs)                                    # [K, B, classes]
+                per_arc = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, by[None, :].repeat(arcs.shape[0], axis=0)
+                ).mean(axis=1)                             # [K]
+                return (per_arc * weights).sum()
+
+            grads = jax.grad(loss_fn)(params)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads
+            )
+            return params, step
+
+        params, _ = jax.lax.fori_loop(
+            0, train_steps, one_train_step, (params, step)
+        )
+
+        def arc_acc(a):
+            logits = _supernet_apply(params, x_v, a, num_layers, kinds)
+            return (jnp.argmax(logits, -1) == y_v).mean()
+
+        accs = jax.vmap(arc_acc)(arcs)
+        return (
+            {"params": params, "step": step + train_steps},
+            accs.astype(jnp.float32),
+        )
+
+    goal = 1.0
+    if spec.objective.type.value == "minimize":
+        goal = -1.0
+    return pop.enas_program(
+        name="katib_tpu.models.enas_child:run_enas_trial",
+        metric=spec.objective.objective_metric_name or "Validation-accuracy",
+        n_population=k_pop,
+        num_layers=num_layers,
+        num_ops=len(ops),
+        child_init=child_init,
+        child_train_eval=child_train_eval,
+        hidden_size=int(settings["controller_hidden_size"]),
+        temperature=settings["controller_temperature"],
+        tanh_const=settings["controller_tanh_const"],
+        entropy_weight=settings["controller_entropy_weight"],
+        baseline_decay=float(settings["controller_baseline_decay"]),
+        learning_rate=float(settings["controller_learning_rate"]),
+        skip_target=float(settings["controller_skip_target"]),
+        skip_weight=settings["controller_skip_weight"],
+        controller_steps=int(raw.get("fused_controller_steps", "10")),
+        goal_scale=goal,
+        seed=int(raw.get("random_state", "0") or 0),
+    )
+
+
+run_enas_trial.population_program = enas_population_program

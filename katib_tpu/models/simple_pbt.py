@@ -145,3 +145,115 @@ def _generation_program():
 
 
 run_pbt_trial_packed.supports_packing = True
+
+
+def abstract_pbt_program(assignments: Dict[str, str]):
+    """Abstract program probe (katib_tpu.analysis.program, ISSUE 9
+    satellite): the canonical per-member generation scorer with lr as a
+    traced f32 scalar input — the analyzer classifies ``lr`` runtime-scalar
+    (one executable covers the whole population) and the PR 8 compile
+    service can AOT-prewarm the program at admission instead of raising
+    KTX404."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..analysis.program import ProgramProbe
+
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+    step0 = jax.ShapeDtypeStruct((), jnp.float32)
+    score0 = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def member_round(lr, step0, score0):
+        def body(i, score):
+            step = step0 + i
+            phase = (step % _LR_PERIOD) / _LR_PERIOD
+            tri = jnp.where(phase < 0.5, 2.0 * phase, 2.0 * (1.0 - phase))
+            target = 0.02 * tri
+            return score + jnp.maximum(0.0, 1.0 - jnp.abs(lr - target) / 0.02) * 0.01
+
+        return jax.lax.fori_loop(0, _STEPS_PER_ROUND, body, score0)
+
+    return ProgramProbe(
+        fn=member_round,
+        args=(lr, step0, score0),
+        hyperparams={"lr": lr},
+    )
+
+
+run_pbt_trial.abstract_program = abstract_pbt_program
+run_pbt_trial_packed.abstract_program = abstract_pbt_program
+
+
+def pbt_population_program(spec):
+    """Fused population probe (katib_tpu.runtime.population): the whole
+    triangle-wave PBT benchmark as ONE generation step — the per-member
+    fori_loop scorer vmapped over the population, truncation
+    exploit/explore selection fused behind it — run as a single
+    ``lax.scan`` program per sweep instead of one job-queue round-trip per
+    generation. Member state (step, score) is the checkpoint lineage the
+    job-queue driver keeps in ``training.json``; exploit copies a top
+    performer's lr AND its accumulated state, exactly the lineage-copy
+    semantics of the suggestion-PVC ``shutil.copytree``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..runtime import population as pop
+    from ..suggest.internal.search_space import MIN_GOAL, SearchSpace
+
+    space = SearchSpace.from_experiment(spec)
+    settings = spec.algorithm.settings_dict()
+    numeric = [p for p in space.params if p.is_numeric]
+    if not numeric:
+        raise ValueError("simple_pbt fused program needs numeric parameters")
+    names = [p.name for p in numeric]
+    lower = [p.min for p in numeric]
+    upper = [p.max for p in numeric]
+    # the suggest/pbt.py _Sampler grid: explicit step, else span/100
+    grid = [
+        p.step if p.step else ((p.max - p.min) / 100.0 or 1.0) for p in numeric
+    ]
+    lr_col = names.index("lr") if "lr" in names else 0
+
+    def init_member(key, hp_row):
+        del key, hp_row
+        return {
+            "step": jnp.zeros((), jnp.float32),
+            "score": jnp.zeros((), jnp.float32),
+        }
+
+    def member_step(state, hp_row, key):
+        del key
+        lr = hp_row[lr_col]
+        step0 = state["step"]
+
+        def body(i, score):
+            step = step0 + i
+            phase = (step % _LR_PERIOD) / _LR_PERIOD
+            tri = jnp.where(phase < 0.5, 2.0 * phase, 2.0 * (1.0 - phase))
+            target = 0.02 * tri
+            return score + jnp.maximum(0.0, 1.0 - jnp.abs(lr - target) / 0.02) * 0.01
+
+        score = jax.lax.fori_loop(0, _STEPS_PER_ROUND, body, state["score"])
+        return {"step": step0 + _STEPS_PER_ROUND, "score": score}, score
+
+    resample = settings.get("resample_probability")
+    seed = int(settings.get("random_state", "0") or 0)
+    return pop.pbt_program(
+        name="katib_tpu.models.simple_pbt:run_pbt_trial_packed",
+        metric=spec.objective.objective_metric_name or "Validation-accuracy",
+        n_population=int(settings.get("n_population", "8")),
+        hyperparams=names,
+        lower=lower,
+        upper=upper,
+        grid_step=grid,
+        truncation=float(settings.get("truncation_threshold", "0.2")),
+        resample_probability=float(resample) if resample is not None else None,
+        goal_scale=-1.0 if space.goal == MIN_GOAL else 1.0,
+        init_member=init_member,
+        member_step=member_step,
+        seed=seed,
+    )
+
+
+run_pbt_trial.population_program = pbt_population_program
+run_pbt_trial_packed.population_program = pbt_population_program

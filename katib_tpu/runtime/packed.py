@@ -194,9 +194,52 @@ class PackedTrialContext:
             if span is not None:
                 self._tracer.end_span(span)
 
+    def record_stage(self, name: str, start: float, end: float, **attrs) -> None:
+        """Record one instantaneous-or-spanning runtime stage into the gang
+        trace (fused population chunks use this for their per-chunk
+        compile/execute spans). No-op when tracing is off."""
+        if self._tracer is not None:
+            self._tracer.record_span(
+                name, self._trace_experiment, self._trace_id,
+                self._trace_parent, start=start, end=end, **attrs,
+            )
+
     @property
     def pack_size(self) -> int:
         return len(self.trial_names)
+
+    # -- traceable membership masking (ISSUE 9 tentpole) ---------------------
+    #
+    # The fused population runtime carries the membership mask INSIDE its
+    # compiled scan (a jnp bool[K] consulted via jnp.where each generation)
+    # and syncs it with this host-side context only at chunk boundaries:
+    # population_mask() seeds the carry from the host view (kills, preempts,
+    # early-stops absorbed so far), and absorb_population_mask() folds the
+    # program's final mask back — a member the *program* deactivated (e.g.
+    # divergence guard) finalizes as early-stopped rather than silently
+    # completing.
+
+    def population_mask(self):
+        """The current membership mask as a jnp bool[K] array — the carried
+        form of ``active_mask`` a fused program scans over."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.active_mask)
+
+    def absorb_population_mask(self, mask, reason: str = "deactivated by population program") -> None:
+        """Fold a program-produced final mask into the host view: members
+        inactive in ``mask`` but still active here are marked stopped (the
+        in-program analogue of an early-stopping trip)."""
+        arr = np.asarray(mask).reshape(-1)
+        if arr.shape[0] != self.pack_size:
+            raise ValueError(
+                f"population mask has {arr.shape[0]} entries for a pack of "
+                f"{self.pack_size}"
+            )
+        for i, alive in enumerate(arr):
+            if not bool(alive) and self._active[i]:
+                self._active[i] = False
+                self._stopped[i] = True
 
     @property
     def active_mask(self) -> np.ndarray:

@@ -80,6 +80,23 @@ _NONE_ALLOWED = {
     "controller_entropy_weight",
     "controller_skip_weight",
 }
+# Settings consumed outside the controller proper: the shared seed knob and
+# the fused-population opt-in family (runtime/population.py +
+# models/enas_child.py enas_population_program). Validated loosely — the
+# fused program builder coerces and bounds them itself.
+_PASSTHROUGH_SETTINGS = {
+    "random_state",
+    "fused",
+    "fused_generations",
+    "fused_population_size",
+    "fused_controller_steps",
+    "fused_child_examples",
+    "fused_child_batch",
+    "fused_child_steps",
+    "fused_child_channels",
+    "fused_child_lr",
+    "n_population",
+}
 _SETTING_RANGES = {
     "controller_hidden_size": (1, float("inf")),
     "controller_temperature": (0, float("inf")),
@@ -272,6 +289,8 @@ class ENAS(Suggester):
         if not expand_operations(nas):
             raise ValueError("nasConfig.operations expand to an empty search space")
         for s in experiment.algorithm.algorithm_settings:
+            if s.name in _PASSTHROUGH_SETTINGS:
+                continue
             if s.name not in _SETTING_TYPES:
                 raise ValueError(f"unknown ENAS setting {s.name!r}")
             if s.value == "None":
@@ -298,12 +317,23 @@ class ENAS(Suggester):
             return self._state
         path = self._ckpt_path()
         if path and os.path.exists(path):
-            with open(path, "rb") as f:
-                raw = pickle.load(f)
-            raw["params"] = jax.tree.map(jnp.asarray, raw["params"])
-            raw["opt_state"] = jax.tree.map(jnp.asarray, raw["opt_state"])
-            self._state = raw
-            return raw
+            try:
+                with open(path, "rb") as f:
+                    raw = pickle.load(f)
+                raw["params"] = jax.tree.map(jnp.asarray, raw["params"])
+                raw["opt_state"] = jax.tree.map(jnp.asarray, raw["opt_state"])
+                self._state = raw
+                return raw
+            except Exception as e:
+                # a corrupt/truncated controller checkpoint must not wedge
+                # the experiment: reseed the controller from scratch (the
+                # trial history is still in the store) and say so loudly
+                import logging
+
+                logging.getLogger("katib_tpu.enas").warning(
+                    "corrupt ENAS controller state at %s (%s: %s); "
+                    "reseeding controller", path, type(e).__name__, e,
+                )
 
     # fresh state
         spec = request.experiment
@@ -337,8 +367,12 @@ class ENAS(Suggester):
         raw["params"] = jax.tree.map(np.asarray, raw["params"])
         raw["opt_state"] = jax.tree.map(np.asarray, raw["opt_state"])
         raw["rng"] = np.asarray(raw["rng"])
-        with open(path, "wb") as f:
+        # atomic: a crash mid-dump must leave the previous (complete)
+        # checkpoint for the restore path, never a truncated pickle
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             pickle.dump(raw, f)
+        os.replace(tmp, path)
 
     def _evaluation_result(self, request: SuggestionRequest) -> Optional[float]:
         """Average objective over succeeded trials (service.py:400-431)."""

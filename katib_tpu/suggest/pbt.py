@@ -175,13 +175,26 @@ class PBT(Suggester):
             return False
         import pickle
 
-        with open(self._state_path(), "rb") as f:
-            payload = pickle.load(f)
-        self.pending = payload["pending"]
-        self.running = payload["running"]
-        self.completed = payload["completed"]
-        self.sample_pool = payload["sample_pool"]
-        self.rng = payload["rng"]
+        try:
+            with open(self._state_path(), "rb") as f:
+                payload = pickle.load(f)
+            self.pending = payload["pending"]
+            self.running = payload["running"]
+            self.completed = payload["completed"]
+            self.sample_pool = payload["sample_pool"]
+            self.rng = payload["rng"]
+        except Exception as e:
+            # a corrupt/truncated queue snapshot must not wedge the
+            # experiment: fall back to a fresh population reseed, loudly
+            import logging
+
+            logging.getLogger("katib_tpu.pbt").warning(
+                "corrupt PBT queue state at %s (%s: %s); reseeding "
+                "population", self._state_path(), type(e).__name__, e,
+            )
+            self.pending, self.running, self.completed = [], {}, {}
+            self.sample_pool = {"previous": [], "current": []}
+            return False
         for s in self.samplers:
             # samplers were built against the fresh seed rng before the
             # restore — rebind so perturb/sample continue the restored

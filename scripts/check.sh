@@ -28,6 +28,10 @@ echo "== compile service smoke (AOT amortization) =="
 JAX_PLATFORMS=cpu python bench.py compile_amortization --smoke
 
 echo
+echo "== fused population smoke (lax.scan PBT sweep vs job-queue driver) =="
+JAX_PLATFORMS=cpu python bench.py pbt_fused_throughput --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

@@ -623,6 +623,24 @@ def test_compile_amortization_smoke_wiring(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_pbt_fused_throughput_smoke_wiring(bench):
+    """--smoke mode of the pbt_fused_throughput scenario (ISSUE 9): the
+    legacy job-queue PBT sweep and the fused lax.scan sweep both run
+    end-to-end on the simple_pbt workload, and the fused-vs-stepwise
+    lineage parity (chunk=G vs chunk=1 of the identical program, fixed
+    seed) holds bit-for-bit. No speed ratio assertion in smoke — trimmed
+    walls are scheduler noise; the >=5x target is the timed run's
+    acceptance number, reported as within_target."""
+    out = bench._bench_pbt_fused_throughput(smoke=True)
+    assert out["smoke"] is True
+    assert out["lineage_bit_identical"] is True
+    assert out["fused_generations"] == 6
+    assert out["legacy_generations"] >= 1
+    assert out["fused_gen_per_s"] > 0 and out["legacy_gen_per_s"] > 0
+    assert out["target_speedup"] == 5.0
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -1,0 +1,675 @@
+"""Fused on-device population loops (ISSUE 9: runtime/population.py +
+FusedPopulationExecutor).
+
+Tentpole invariants:
+- fused-vs-legacy equivalence: the one-scan program and the per-generation
+  (chunk=1) job-queue-style driver produce bit-identical exploit/explore
+  lineage and per-generation best/median/score under a fixed seed;
+- masking is traceable and sticky: a member frozen mid-sweep stays frozen
+  (constant hyperparams/score, excluded from selection) inside later
+  compiled chunks;
+- chunk-boundary preemption: carry checkpoint + demux progress persist
+  before the members requeue, and the resumed sweep's combined observation
+  rows are bit-identical to an uninterrupted run;
+- the controller path: opted-in specs dispatch as ONE fused gang unit, the
+  compile service AOT-prewarms the scan program at admission (svc trace
+  counter: the G-generation sweep compiles exactly once),
+  KATIB_TPU_FUSED_POPULATION=0 restores the legacy job-queue driver;
+- satellites: corrupted suggester state (PBT queue pickle, ENAS controller
+  pickle) falls back to reseed instead of wedging the experiment.
+"""
+
+import json
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.api.status import Experiment, Trial, TrialCondition
+from katib_tpu.controller.experiment import ExperimentController
+from katib_tpu.runtime import population as pop
+
+
+@pytest.fixture(autouse=True)
+def _reset_fused_switch():
+    """Controller construction flips the module-level switch; restore the
+    env-resolved default so test order cannot leak a disabled state."""
+    yield
+    pop.set_enabled(True)
+    pop._ENABLED = None
+
+
+def _toy_program(k=6, seed=7, truncation=0.3, resample=None):
+    """A minimal PBT program over one hyperparameter: score accumulates
+    closeness of lr to 0.01 — deterministic, a few microseconds per
+    generation."""
+    import jax.numpy as jnp
+
+    def init_member(key, hp):
+        del key, hp
+        return {"score": jnp.zeros((), jnp.float32)}
+
+    def member_step(state, hp, key):
+        del key
+        score = state["score"] + jnp.maximum(
+            0.0, 1.0 - jnp.abs(hp[0] - 0.01) / 0.02
+        )
+        return {"score": score}, score
+
+    return pop.pbt_program(
+        name="toy", metric="acc", n_population=k, hyperparams=["lr"],
+        lower=[0.0001], upper=[0.02], grid_step=[0.0001],
+        truncation=truncation, resample_probability=resample,
+        init_member=init_member, member_step=member_step, seed=seed,
+    )
+
+
+def _pbt_spec(name, generations=6, population=5, seed=11, extra=()):
+    from katib_tpu.models.simple_pbt import run_pbt_trial_packed
+
+    settings = [
+        AlgorithmSetting("n_population", str(population)),
+        AlgorithmSetting("truncation_threshold", "0.4"),
+        AlgorithmSetting("fused_generations", str(generations)),
+        AlgorithmSetting("random_state", str(seed)),
+    ]
+    settings.extend(AlgorithmSetting(k, v) for k, v in extra)
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec(
+                "lr", ParameterType.DOUBLE,
+                FeasibleSpace(min="0.0001", max="0.02"),
+            )
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE,
+            objective_metric_name="Validation-accuracy",
+        ),
+        algorithm=AlgorithmSpec("pbt", algorithm_settings=settings),
+        trial_template=TrialTemplate(function=run_pbt_trial_packed),
+        max_trial_count=population * generations,
+        parallel_trial_count=population,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Program-level: fused vs stepwise equivalence, masking, selection math
+# ---------------------------------------------------------------------------
+
+class TestFusedVsLegacyEquivalence:
+    def test_fused_scan_matches_per_generation_driver_bit_for_bit(self):
+        """chunk=G (one compiled scan) and chunk=1 (the per-generation host
+        round-trip the job-queue driver pays) must agree bit-for-bit on
+        every summary field: scores, best/median, and the exploit/explore
+        lineage (parents, exploited mask, perturb factors)."""
+        prog = _toy_program()
+        _, fused = pop.run_generations(prog, 9)
+        _, stepwise = pop.run_generations(prog, 9, chunk=1)
+        _, mixed = pop.run_generations(prog, 9, chunk=4)
+        assert set(fused) == {
+            "score", "best", "median", "hparams", "parent", "exploited",
+            "factors", "active",
+        }
+        for key in fused:
+            assert np.array_equal(fused[key], stepwise[key]), key
+            assert np.array_equal(fused[key], mixed[key]), key
+
+    def test_resample_mode_matches_too(self):
+        prog = _toy_program(seed=3, resample=0.5)
+        _, fused = pop.run_generations(prog, 6)
+        _, stepwise = pop.run_generations(prog, 6, chunk=1)
+        for key in fused:
+            assert np.array_equal(fused[key], stepwise[key]), key
+
+    def test_selection_mirrors_truncation_semantics(self):
+        """Exploited members are exactly those strictly below the lower
+        truncation quantile of the active scores, and every exploit parent
+        sits in the upper quantile pool."""
+        prog = _toy_program(k=8, seed=5, truncation=0.25)
+        _, ys = pop.run_generations(prog, 5)
+        for g in range(5):
+            scores = ys["score"][g]
+            active = ys["active"][g]
+            lo = np.quantile(scores[active], 0.25)
+            hi = np.quantile(scores[active], 0.75)
+            exploited = ys["exploited"][g]
+            assert np.array_equal(exploited, active & (scores < lo))
+            for i in np.where(exploited)[0]:
+                parent = ys["parent"][g][i]
+                assert parent >= 0
+                assert scores[parent] >= hi
+
+    def test_masked_quantile_matches_numpy(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        values = rng.normal(size=16).astype(np.float32)
+        mask = rng.random(16) > 0.4
+        for q in (0.0, 0.2, 0.5, 0.8, 1.0):
+            got = float(pop.masked_quantile(jnp.asarray(values), jnp.asarray(mask), q))
+            want = float(np.quantile(values[mask], q))
+            assert abs(got - want) < 1e-5, (q, got, want)
+
+
+class TestTraceableMasking:
+    def test_member_frozen_mid_sweep_stays_frozen(self):
+        """A member deactivated in the carry holds its hyperparams and
+        score constant through later compiled chunks and never serves as an
+        exploit parent — masking inside the scan, not host-side."""
+        prog = _toy_program(k=6, seed=9)
+        carry, _ = pop.run_generations(prog, 3)
+        frozen = 2
+        carry = dict(carry)
+        carry["active"] = carry["active"].at[frozen].set(False)
+        _, ys = pop.run_generations(prog, 6, carry=carry)
+        assert np.all(ys["hparams"][:, frozen, :] == ys["hparams"][0, frozen, :])
+        assert np.all(ys["score"][:, frozen] == ys["score"][0, frozen])
+        assert not np.any(ys["active"][:, frozen])
+        assert not np.any(ys["parent"] == frozen), "frozen member was exploited"
+
+    def test_context_mask_roundtrip(self):
+        """PackedTrialContext <-> carry mask sync: the host view seeds a
+        traceable jnp mask, and a program-deactivated member folds back as
+        stopped."""
+        from katib_tpu.db.store import InMemoryObservationStore
+        from katib_tpu.runtime.metrics import MetricsReporter
+        from katib_tpu.runtime.packed import PackedTrialContext
+
+        store = InMemoryObservationStore()
+        ctx = PackedTrialContext(
+            trial_names=["a", "b", "c"],
+            experiment_name="m",
+            assignments={},
+            reporters=[
+                MetricsReporter(store=store, trial_name=n, raise_on_stop=False)
+                for n in ("a", "b", "c")
+            ],
+            kill_events=[None, None, None],
+        )
+        mask = np.asarray(ctx.population_mask())
+        assert mask.tolist() == [True, True, True]
+        ctx.absorb_population_mask(np.array([True, False, True]))
+        outcomes = ctx.member_outcomes()
+        assert outcomes[1][0] is True  # stopped
+        assert outcomes[0][0] is False
+
+
+class TestSweepCheckpoint:
+    def test_checkpoint_roundtrip_resumes_bit_identically(self, tmp_path):
+        prog = _toy_program(seed=21)
+        _, full = pop.run_generations(prog, 8)
+
+        carry, first = pop.run_generations(prog, 4)
+        pop.save_sweep_checkpoint(str(tmp_path), carry, 4)
+        loaded = pop.load_sweep_checkpoint(str(tmp_path), prog)
+        assert loaded is not None
+        carry2, done, pending, reported = loaded
+        assert done == 4 and pending == {} and reported == 0
+        _, rest = pop.run_generations(prog, 8, carry=carry2, start_generation=4)
+        for key in full:
+            combined = np.concatenate([first[key], rest[key]], axis=0)
+            assert np.array_equal(full[key], combined), key
+
+    def test_corrupt_checkpoint_falls_back_to_fresh(self, tmp_path):
+        (tmp_path / pop.CARRY_FILE).write_bytes(b"not an npz")
+        (tmp_path / pop.CARRY_META_FILE).write_text("{nope")
+        assert pop.load_sweep_checkpoint(str(tmp_path), _toy_program()) is None
+
+    def test_checkpoint_write_is_atomic(self, tmp_path):
+        prog = _toy_program()
+        carry, ys = pop.run_generations(prog, 2)
+        pop.save_sweep_checkpoint(
+            str(tmp_path), carry, 2, pending_ys=ys, reported=1
+        )
+        leftovers = [p for p in os.listdir(tmp_path) if p.endswith(".tmp.npz") or p.endswith(".tmp")]
+        assert leftovers == []
+        loaded = pop.load_sweep_checkpoint(str(tmp_path), prog)
+        assert loaded is not None
+        _, done, pending, reported = loaded
+        assert done == 2 and reported == 1
+        assert np.array_equal(pending["score"], ys["score"])
+
+
+# ---------------------------------------------------------------------------
+# Controller path: one fused gang unit, AOT prewarm, legacy fallback
+# ---------------------------------------------------------------------------
+
+class TestFusedControllerPath:
+    def test_fused_sweep_e2e(self, tmp_path):
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(4)))
+        try:
+            spec = _pbt_spec("pf-e2e", generations=6, population=5)
+            c.create_experiment(spec)
+            exp = c.run("pf-e2e", timeout=180)
+            assert exp.status.is_succeeded, exp.status.message
+            trials = c.state.list_trials("pf-e2e")
+            # exactly K member trials, each alive the whole sweep
+            assert len(trials) == 5
+            assert all(t.condition == TrialCondition.SUCCEEDED for t in trials)
+            assert all(pop.FUSED_LABEL in t.labels for t in trials)
+            for t in trials:
+                logs = c.obs_store.get_observation_log(t.name)
+                assert len(logs) == 6  # one objective row per generation
+                values = [float(l.value) for l in logs]
+                assert values == sorted(values) or len(set(values)) > 1
+            # population-level best/median rows under the pseudo-trial
+            poplog = c.obs_store.get_observation_log("pf-e2e-population")
+            assert len(poplog) == 12
+            # the PopulationFused event and the generation counter
+            reasons = [e.reason for e in c.events.list("pf-e2e")]
+            assert "PopulationFused" in reasons
+            rendered = c.metrics.render()
+            assert (
+                'katib_population_generations_total{experiment="pf-e2e"} 6.0'
+                in rendered
+            )
+        finally:
+            c.close()
+
+    def test_sweep_compiles_exactly_once_in_service(self, tmp_path):
+        """Satellite 1 acceptance: with the population/abstract probes
+        shipped, the compile service prewarms the fused scan program at
+        admission and the G-generation sweep adds ZERO further service
+        traces — the sweep compiled exactly once, before chips were
+        allocated."""
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(4)))
+        try:
+            spec = _pbt_spec("pf-once", generations=6, population=5)
+            c.create_experiment(spec)
+            key = pop.fused_group_key(spec, 6)
+            deadline = time.time() + 60
+            wp = None
+            while time.time() < deadline:
+                wp = c.compile_service.warm_executable_for_key(key)
+                if wp is not None:
+                    break
+                time.sleep(0.05)
+            assert wp is not None, c.compile_service.registry_snapshot()
+            assert wp.fingerprint.startswith("ktfp-")
+            traces_before = c.compile_service.stats()["traces"]
+            exp = c.run("pf-once", timeout=180)
+            assert exp.status.is_succeeded
+            assert c.compile_service.stats()["traces"] == traces_before
+        finally:
+            c.close()
+
+    def test_disabled_env_restores_legacy_driver(self, tmp_path, monkeypatch):
+        """KATIB_TPU_FUSED_POPULATION=0: the opted-in spec runs the
+        job-queue PBT driver byte-identically — the fused machinery never
+        engages (no fused member trials, no PopulationFused event, no
+        population pseudo-rows, no sweep checkpoint dir), the PBT suggester
+        creates the usual per-generation trials with lineage labels, and
+        the trial budget follows legacy semantics exactly. (Cross-run float
+        identity is not asserted: legacy PBT's suggestion timing vs the
+        pack finalize loop is thread-scheduling dependent — pre-existing
+        behavior this PR must not change.)"""
+        monkeypatch.setenv("KATIB_TPU_FUSED_POPULATION", "0")
+        root = str(tmp_path / "legacy")
+        c = ExperimentController(root_dir=root, devices=list(range(5)))
+        try:
+            spec = _pbt_spec(
+                "pf-legacy", generations=3, population=5,
+                extra=(("suggestion_trial_dir", os.path.join(root, "pbt-state")),),
+            )
+            spec.max_trial_count = 15
+            assert pop.fused_applicable(spec) is not None  # knob gates it off
+            c.create_experiment(spec)
+            exp = c.run("pf-legacy", timeout=180)
+            assert exp.status.is_succeeded, exp.status.message
+            trials = c.state.list_trials("pf-legacy")
+            assert len(trials) == 15  # legacy budget: one trial per slot
+            assert all(pop.FUSED_LABEL not in t.labels for t in trials)
+            # PBT's own uids + lineage labels, not fused member names
+            assert all("-fused-m" not in t.name for t in trials)
+            gens = {
+                int(t.labels.get("pbt.katib-tpu/generation", "0")) for t in trials
+            }
+            assert max(gens) >= 1, f"population never advanced: {gens}"
+            reasons = [e.reason for e in c.events.list("pf-legacy")]
+            assert "PopulationFused" not in reasons
+            assert c.obs_store.get_observation_log("pf-legacy-population") == []
+            assert not os.path.exists(os.path.join(root, "fusedpop"))
+        finally:
+            c.close()
+
+    def test_applicability_gating(self):
+        spec = _pbt_spec("pf-gate")
+        assert pop.fused_applicable(spec) is None
+        # no opt-in -> job-queue path
+        plain = _pbt_spec("pf-plain")
+        plain.algorithm.algorithm_settings = [
+            s
+            for s in plain.algorithm.algorithm_settings
+            if s.name not in ("fused", "fused_generations")
+        ]
+        assert pop.fused_applicable(plain) is not None
+        # runtime switch off -> job-queue path even for opted-in specs
+        pop.set_enabled(False)
+        assert pop.fused_applicable(spec) is not None
+        pop.set_enabled(True)
+        assert pop.fused_applicable(spec) is None
+        # command templates cannot fuse
+        cmd = _pbt_spec("pf-cmd")
+        cmd.trial_template = TrialTemplate(command=["echo", "hi"])
+        assert pop.fused_applicable(cmd) is not None
+
+
+class TestChunkBoundaryPreemption:
+    def _make_ctx(self, store, names, preempt_events):
+        from katib_tpu.runtime.metrics import MetricsReporter
+        from katib_tpu.runtime.packed import PackedTrialContext
+
+        return PackedTrialContext(
+            trial_names=list(names),
+            experiment_name="pf-preempt",
+            assignments={},
+            reporters=[
+                MetricsReporter(store=store, trial_name=n, raise_on_stop=False)
+                for n in names
+            ],
+            kill_events=[None] * len(names),
+            preempt_events=list(preempt_events),
+        )
+
+    def test_preempt_then_resume_is_bit_identical(self, tmp_path):
+        """Preempt the sweep mid-demux after the second chunk, resume with
+        a fresh context, and require the combined per-member observation
+        rows to equal an uninterrupted run's exactly — the PR 2 invariant
+        at chunk granularity."""
+        from katib_tpu.controller.packing import FusedPopulationExecutor
+        from katib_tpu.controller.executor import TrialExecution, TrialOutcome
+        from katib_tpu.db.store import InMemoryObservationStore
+
+        spec = _pbt_spec("pf-preempt", generations=6, population=5)
+        exp = Experiment(spec=spec)
+        names = [pop.member_name(spec, i) for i in range(5)]
+        trials = [
+            Trial(name=n, experiment_name="pf-preempt", labels={pop.FUSED_LABEL: str(i)})
+            for i, n in enumerate(names)
+        ]
+
+        def run_rows(store):
+            return {n: [l.value for l in store.get_observation_log(n)] for n in names}
+
+        # uninterrupted reference
+        ref_store = InMemoryObservationStore()
+        ckdir_a = str(tmp_path / "a")
+        ctx = self._make_ctx(ref_store, names, [None] * 5)
+        ctx.checkpoint_dirs = [ckdir_a] * 5
+        execu = FusedPopulationExecutor(ref_store, chunk_generations=2)
+        handles = [TrialExecution() for _ in names]
+        results = execu.execute(exp, trials, ctx, handles)
+        assert all(r.outcome == TrialOutcome.COMPLETED for r in results)
+        reference = run_rows(ref_store)
+        assert all(len(v) == 6 for v in reference.values())
+
+        # preempted run: the preempt signal lands while the 2nd chunk's
+        # rows demux, so the freeze happens mid-chunk
+        store = InMemoryObservationStore()
+        ckdir = str(tmp_path / "b")
+        events = [threading.Event() for _ in names]
+        ctx = self._make_ctx(store, names, events)
+        ctx.checkpoint_dirs = [ckdir] * 5
+        reports = {"n": 0}
+
+        def heartbeat():
+            reports["n"] += 1
+            if reports["n"] == 3:  # mid-demux of the second chunk
+                for e in events:
+                    e.set()
+
+        ctx.on_report = heartbeat
+        execu = FusedPopulationExecutor(store, chunk_generations=2)
+        results = execu.execute(exp, trials, ctx, [TrialExecution() for _ in names])
+        assert all(r.outcome == TrialOutcome.PREEMPTED for r in results)
+        partial = run_rows(store)
+        assert all(0 < len(v) < 6 for v in partial.values())
+
+        # resume: fresh context, same checkpoint dir — replay the
+        # unreported tail, then continue the same key stream
+        ctx = self._make_ctx(store, names, [None] * 5)
+        ctx.checkpoint_dirs = [ckdir] * 5
+        execu = FusedPopulationExecutor(store, chunk_generations=2)
+        results = execu.execute(exp, trials, ctx, [TrialExecution() for _ in names])
+        assert all(r.outcome == TrialOutcome.COMPLETED for r in results)
+        assert run_rows(store) == reference
+        # the finished sweep cleared its carry checkpoint
+        assert not os.path.exists(os.path.join(ckdir, pop.CARRY_FILE))
+
+    def test_pack_short_one_member_freezes_that_slot(self, tmp_path):
+        """A member killed while still PENDING leaves the formed pack one
+        short of the program's K: its population slot freezes at the first
+        mask sync, the remaining members sweep to completion, and the
+        demux maps pack positions to slots (no length-mismatch)."""
+        from katib_tpu.controller.packing import FusedPopulationExecutor
+        from katib_tpu.controller.executor import TrialExecution, TrialOutcome
+        from katib_tpu.db.store import InMemoryObservationStore
+        from katib_tpu.runtime.metrics import MetricsReporter
+        from katib_tpu.runtime.packed import PackedTrialContext
+
+        spec = _pbt_spec("pf-short", generations=4, population=5)
+        exp = Experiment(spec=spec)
+        present = [0, 1, 3, 4]  # slot 2's member was killed while pending
+        names = [pop.member_name(spec, i) for i in present]
+        trials = [
+            Trial(name=n, experiment_name="pf-short", labels={pop.FUSED_LABEL: str(i)})
+            for i, n in zip(present, names)
+        ]
+        store = InMemoryObservationStore()
+        ctx = PackedTrialContext(
+            trial_names=names,
+            experiment_name="pf-short",
+            assignments={},
+            reporters=[
+                MetricsReporter(store=store, trial_name=n, raise_on_stop=False)
+                for n in names
+            ],
+            kill_events=[None] * 4,
+            member_labels=[dict(t.labels) for t in trials],
+        )
+        ctx.checkpoint_dirs = [str(tmp_path)] * 4
+        execu = FusedPopulationExecutor(store, chunk_generations=2)
+        results = execu.execute(exp, trials, ctx, [TrialExecution() for _ in names])
+        assert all(r.outcome == TrialOutcome.COMPLETED for r in results)
+        for n in names:
+            assert len(store.get_observation_log(n)) == 4
+        assert store.get_observation_log(pop.member_name(spec, 2)) == []
+
+    def test_killed_member_stays_frozen_in_later_chunks(self, tmp_path):
+        from katib_tpu.controller.packing import FusedPopulationExecutor
+        from katib_tpu.controller.executor import TrialExecution, TrialOutcome
+        from katib_tpu.db.store import InMemoryObservationStore
+        from katib_tpu.runtime.metrics import MetricsReporter
+        from katib_tpu.runtime.packed import PackedTrialContext
+
+        spec = _pbt_spec("pf-kill", generations=6, population=5)
+        exp = Experiment(spec=spec)
+        names = [pop.member_name(spec, i) for i in range(5)]
+        trials = [
+            Trial(name=n, experiment_name="pf-kill", labels={pop.FUSED_LABEL: str(i)})
+            for i, n in enumerate(names)
+        ]
+        store = InMemoryObservationStore()
+        kill_events = [None, threading.Event(), None, None, None]
+        ctx = PackedTrialContext(
+            trial_names=names,
+            experiment_name="pf-kill",
+            assignments={},
+            reporters=[
+                MetricsReporter(store=store, trial_name=n, raise_on_stop=False)
+                for n in names
+            ],
+            kill_events=kill_events,
+        )
+        ctx.checkpoint_dirs = [str(tmp_path)] * 5
+        reports = {"n": 0}
+
+        def heartbeat():
+            reports["n"] += 1
+            if reports["n"] == 2:
+                kill_events[1].set()
+
+        ctx.on_report = heartbeat
+        execu = FusedPopulationExecutor(store, chunk_generations=2)
+        results = execu.execute(exp, trials, ctx, [TrialExecution() for _ in names])
+        assert results[1].outcome == TrialOutcome.KILLED
+        assert all(
+            r.outcome == TrialOutcome.COMPLETED
+            for i, r in enumerate(results)
+            if i != 1
+        )
+        # the killed member's log ends where it froze; survivors got all 6
+        assert len(store.get_observation_log(names[1])) < 6
+        assert len(store.get_observation_log(names[0])) == 6
+
+
+# ---------------------------------------------------------------------------
+# ENAS: fused controller+child program
+# ---------------------------------------------------------------------------
+
+def _enas_spec(name):
+    from katib_tpu.api.spec import GraphConfig, NasConfig, NasOperation
+    from katib_tpu.models.enas_child import run_enas_trial
+
+    return ExperimentSpec(
+        name=name,
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE,
+            objective_metric_name="Validation-accuracy",
+        ),
+        algorithm=AlgorithmSpec(
+            "enas",
+            algorithm_settings=[
+                AlgorithmSetting("n_population", "4"),
+                AlgorithmSetting("fused_generations", "2"),
+                AlgorithmSetting("fused_child_examples", "96"),
+                AlgorithmSetting("fused_child_batch", "16"),
+                AlgorithmSetting("fused_controller_steps", "2"),
+                AlgorithmSetting("controller_train_steps", "2"),
+            ],
+        ),
+        nas_config=NasConfig(
+            graph_config=GraphConfig(
+                num_layers=2, input_sizes=[32, 32, 3], output_sizes=[10]
+            ),
+            operations=[
+                NasOperation(
+                    "convolution",
+                    [
+                        ParameterSpec(
+                            "filter_size", ParameterType.CATEGORICAL,
+                            FeasibleSpace(list=["3"]),
+                        ),
+                        ParameterSpec(
+                            "num_filter", ParameterType.CATEGORICAL,
+                            FeasibleSpace(list=["8"]),
+                        ),
+                    ],
+                ),
+                NasOperation(
+                    "reduction",
+                    [
+                        ParameterSpec(
+                            "reduction_type", ParameterType.CATEGORICAL,
+                            FeasibleSpace(list=["max_pooling"]),
+                        )
+                    ],
+                ),
+            ],
+        ),
+        trial_template=TrialTemplate(function=run_enas_trial),
+        max_trial_count=8,
+        parallel_trial_count=4,
+    )
+
+
+class TestEnasFused:
+    def test_enas_program_fused_vs_stepwise(self):
+        """The ENAS generation step (LSTM sample -> shared-child train/eval
+        -> REINFORCE) is scan-fusable: one compiled program and the
+        per-generation driver agree bit-for-bit on scores and sampled
+        architectures."""
+        from katib_tpu.models.enas_child import enas_population_program
+
+        spec = _enas_spec("enas-fused-prog")
+        prog = enas_population_program(spec)
+        assert prog.n_population == 4
+        _, fused = pop.run_generations(prog, 2)
+        _, stepwise = pop.run_generations(prog, 2, chunk=1)
+        for key in fused:
+            assert np.array_equal(fused[key], stepwise[key]), key
+        assert fused["arc"].shape[:2] == (2, 4)
+        assert fused["score"].shape == (2, 4)
+
+    def test_enas_spec_validates_and_is_applicable(self):
+        from katib_tpu.suggest.nas.enas import ENAS
+
+        spec = _enas_spec("enas-fused-ok")
+        ENAS().validate_algorithm_settings(spec)
+        assert pop.fused_applicable(spec) is None
+
+
+# ---------------------------------------------------------------------------
+# Satellite: suggester state robustness (atomic writes, corrupt fallback)
+# ---------------------------------------------------------------------------
+
+class TestSuggesterStateRobustness:
+    def test_pbt_corrupt_state_falls_back_to_reseed(self, tmp_path):
+        from katib_tpu.suggest.base import SuggestionRequest
+        from katib_tpu.suggest.pbt import PBT
+
+        spec = _pbt_spec("pbt-corrupt")
+        spec.algorithm.algorithm_settings = [
+            AlgorithmSetting("n_population", "5"),
+            AlgorithmSetting("truncation_threshold", "0.4"),
+        ]
+        root = str(tmp_path / "pbt")
+        os.makedirs(root)
+        with open(os.path.join(root, "_state.pkl"), "wb") as f:
+            f.write(b"\x80\x04 truncated garbage")
+        suggester = PBT(checkpoint_root=root)
+        reply = suggester.get_suggestions(
+            SuggestionRequest(experiment=spec, trials=[], current_request_number=5)
+        )
+        assert len(reply.assignments) == 5  # reseeded population
+        # and the save after the round is again a valid snapshot
+        with open(os.path.join(root, "_state.pkl"), "rb") as f:
+            payload = pickle.load(f)
+        assert set(payload) >= {"pending", "running", "completed", "rng"}
+
+    def test_enas_corrupt_state_falls_back_to_reseed(self, tmp_path):
+        from katib_tpu.suggest.base import SuggestionRequest
+        from katib_tpu.suggest.nas.enas import ENAS
+
+        spec = _enas_spec("enas-corrupt")
+        state_dir = str(tmp_path / "enas")
+        os.makedirs(state_dir)
+        with open(os.path.join(state_dir, "enas_controller.pkl"), "wb") as f:
+            f.write(b"definitely not a pickle")
+        suggester = ENAS(state_dir=state_dir)
+        reply = suggester.get_suggestions(
+            SuggestionRequest(experiment=spec, trials=[], current_request_number=2)
+        )
+        assert len(reply.assignments) == 2
+        # the post-round save is atomic: no stale tmp, reloadable pickle
+        assert not os.path.exists(
+            os.path.join(state_dir, "enas_controller.pkl.tmp")
+        )
+        with open(os.path.join(state_dir, "enas_controller.pkl"), "rb") as f:
+            payload = pickle.load(f)
+        assert "params" in payload and "rng" in payload

@@ -277,3 +277,60 @@ def test_500_trial_experiment_overhead(tmp_path):
         assert c.scheduler.active_count() == 0
     finally:
         c.close()
+
+
+def test_fused_population_dispatch_under_lockgraph(tmp_path):
+    """Fused population sweeps (ISSUE 9) exercise a new lock neighborhood:
+    the scheduler's dispatch walk consults the compile service for the
+    warm scan executable while the pack worker demuxes generations through
+    the buffered obslog and the carry checkpoints to disk. Two back-to-back
+    fused sweeps run under lockgraph instrumentation; any cross-thread
+    lock-order cycle fails the test."""
+    from katib_tpu.api import AlgorithmSetting
+    from katib_tpu.models.simple_pbt import run_pbt_trial_packed
+    from katib_tpu.runtime import population as pop
+
+    def fused_spec(name, seed):
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.0001", max="0.02"),
+                )
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="Validation-accuracy",
+            ),
+            algorithm=AlgorithmSpec(
+                "pbt",
+                algorithm_settings=[
+                    AlgorithmSetting("n_population", "5"),
+                    AlgorithmSetting("truncation_threshold", "0.4"),
+                    AlgorithmSetting("fused_generations", "4"),
+                    AlgorithmSetting("random_state", str(seed)),
+                ],
+            ),
+            trial_template=TrialTemplate(function=run_pbt_trial_packed),
+            max_trial_count=20,
+            parallel_trial_count=5,
+        )
+
+    with lockgraph.instrument() as lock_order:
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(8)))
+        try:
+            for i, name in enumerate(("fused-stress-a", "fused-stress-b")):
+                c.create_experiment(fused_spec(name, seed=i))
+                exp = c.run(name, timeout=180)
+                assert exp.status.is_succeeded, exp.status.message
+                trials = c.state.list_trials(name)
+                assert len(trials) == 5
+                assert all(pop.FUSED_LABEL in t.labels for t in trials)
+                assert all(
+                    t.condition == TrialCondition.SUCCEEDED for t in trials
+                )
+        finally:
+            c.close()
+    lock_order.assert_no_cycles()
+    assert lock_order.acquisitions > 0
