@@ -1181,6 +1181,287 @@ def _bench_pbt_fused_throughput(smoke: bool = False):
     }
 
 
+def _bench_suggestion_throughput(smoke: bool = False):
+    """Vectorized suggestion plane (ISSUE 10): candidates/sec of the
+    batched jitted TPE / CMA-ES / BO kernels (suggest/vectorized.py) vs the
+    legacy NumPy suggesters on identical seeded histories, with parity
+    asserted — the vectorized path must reproduce the legacy selections
+    (same rng call sequence, f64 refinement) within fp tolerance.
+
+    Honesty note on the speedup target: the ≥5x goal assumes an
+    accelerator backend (the kernels are single fused batched programs —
+    exactly the shape TPUs eat). On the 1-core CI box XLA's CPU elementwise
+    throughput is only ~2x NumPy's staged pipelines and the GP solves race
+    OpenBLAS, so CPU-measured speedups land ~1.5-2x (BO's flop structure —
+    ONE factorization + half-triangle batched solves vs per-pick refits —
+    is a 4x flop cut that shows at larger histories). The bench records
+    the measured ratio and the target verdict rather than asserting a
+    number this box cannot honestly produce; the floor assertion is that
+    the vectorized path is parity-exact and not slower."""
+    import time as _time
+
+    import numpy as _np
+
+    from katib_tpu.api import (
+        AlgorithmSetting, AlgorithmSpec, ExperimentSpec, FeasibleSpace,
+        Metric, Observation, ObjectiveSpec, ObjectiveType,
+        ParameterAssignment, ParameterSpec, ParameterType, Trial,
+        TrialCondition, TrialTemplate,
+    )
+    from katib_tpu.suggest import vectorized
+    from katib_tpu.suggest.base import SuggestionRequest, create
+
+    def spec_for(algo, settings, dim):
+        return ExperimentSpec(
+            name="suggest-bench",
+            parameters=[
+                ParameterSpec(
+                    f"x{i:02d}", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.0", max="1.0"),
+                )
+                for i in range(dim)
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MINIMIZE, objective_metric_name="loss"
+            ),
+            algorithm=AlgorithmSpec(
+                algo,
+                algorithm_settings=[
+                    AlgorithmSetting(k, str(v)) for k, v in settings.items()
+                ],
+            ),
+            trial_template=TrialTemplate(function=lambda a, c: None),
+            max_trial_count=100000,
+            parallel_trial_count=64,
+        )
+
+    def history(n, dim, labels_fn=None, seed=0):
+        r = _np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            a = {
+                f"x{j:02d}": round(float(r.random()), 8) for j in range(dim)
+            }
+            v = round(float(sum((x - 0.3) ** 2 for x in a.values())), 8)
+            t = Trial(
+                name=f"t{i:04d}",
+                experiment_name="suggest-bench",
+                parameter_assignments=[
+                    ParameterAssignment(k, str(x)) for k, x in a.items()
+                ],
+                labels=labels_fn(i) if labels_fn else {},
+            )
+            t.observation = Observation(
+                metrics=[
+                    Metric(name="loss", min=str(v), max=str(v), latest=str(v))
+                ]
+            )
+            t.condition = TrialCondition.SUCCEEDED
+            t.start_time = 1.0
+            out.append(t)
+        return out
+
+    if smoke:
+        configs = [
+            ("tpe", {"random_state": 7, "n_ei_candidates": 16,
+                     "n_startup_trials": 8}, 4, 30, 4, None),
+            ("cmaes", {"random_state": 7, "popsize": 6}, 4, 24, 4,
+             lambda i: {"cmaes-generation": str(i // 6)}),
+            ("bayesianoptimization",
+             {"random_state": 7, "acq_func": "gp_hedge",
+              "n_initial_points": 8}, 4, 24, 3,
+             lambda i: {"bo-acq": ["ei", "pi", "lcb"][i % 3]}),
+        ]
+        rounds = 1
+    else:
+        configs = [
+            ("tpe", {"random_state": 7, "n_ei_candidates": 64}, 16, 256, 32,
+             None),
+            ("cmaes", {"random_state": 7, "popsize": 8}, 8, 512, 16,
+             lambda i: {"cmaes-generation": str(i // 8)}),
+            ("bayesianoptimization",
+             {"random_state": 7, "acq_func": "gp_hedge"}, 8, 384, 32,
+             lambda i: {"bo-acq": ["ei", "pi", "lcb"][i % 3]}),
+        ]
+        rounds = 3
+
+    prev_enabled = vectorized.enabled()
+    results = {}
+    try:
+        for algo, settings, dim, hist_n, batch, labels_fn in configs:
+            trials = history(hist_n, dim, labels_fn)
+            spec = spec_for(algo, settings, dim)
+            request = SuggestionRequest(
+                experiment=spec, trials=trials, current_request_number=batch
+            )
+            suggester = create(algo)
+            walls = {}
+            picks = {}
+            for vec in (False, True):
+                vectorized.set_enabled(vec)
+                suggester.get_suggestions(request)  # warmup / compile
+                t0 = _time.perf_counter()
+                for _ in range(rounds):
+                    reply = suggester.get_suggestions(request)
+                walls[vec] = (_time.perf_counter() - t0) / rounds
+                picks[vec] = _np.array(
+                    [
+                        [float(v) for _, v in sorted(a.assignments_dict().items())]
+                        for a in reply.assignments
+                    ]
+                )
+            parity_err = float(_np.abs(picks[False] - picks[True]).max())
+            assert parity_err < 1e-6, (
+                f"{algo}: vectorized selections diverged from the legacy "
+                f"oracle by {parity_err}"
+            )
+            speedup = walls[False] / walls[True]
+            if not smoke:
+                assert speedup > 1.0, (
+                    f"{algo}: vectorized path slower than legacy "
+                    f"({walls[True]*1e3:.1f}ms vs {walls[False]*1e3:.1f}ms)"
+                )
+            results[algo] = {
+                "dim": dim,
+                "history": hist_n,
+                "batch": batch,
+                "legacy_cands_per_s": round(batch / walls[False], 1),
+                "vectorized_cands_per_s": round(batch / walls[True], 1),
+                "legacy_ms": round(walls[False] * 1e3, 2),
+                "vectorized_ms": round(walls[True] * 1e3, 2),
+                "speedup": round(speedup, 2),
+                "parity_err": parity_err,
+                "within_target": speedup >= 5.0,
+            }
+    finally:
+        vectorized.set_enabled(prev_enabled)
+    return {
+        "algos": results,
+        "target_speedup": 5.0,
+        "target_note": (
+            "target assumes an accelerator backend; 1-core CPU measures the "
+            "fusion + flop-cut share only (see docs/suggestion-plane.md)"
+        ),
+        "parity_exact": all(r["parity_err"] < 1e-6 for r in results.values()),
+        "smoke": smoke,
+    }
+
+
+def _bench_suggestion_pipeline_latency(smoke: bool = False):
+    """Async pipelined suggestion (ISSUE 10): mean scheduler-observed
+    `suggestion` span (the PR 4 span around sync_assignments in the
+    reconcile loop) on a TPE sweep with the prefetch worker on vs the
+    inline legacy path, plus the no-duplicate/no-loss integrity check.
+    Target: >=3x lower mean span with async on. The legacy NumPy suggester
+    (vector_suggest off) runs on BOTH sides so the ratio isolates the
+    pipeline, not the kernels."""
+    import tempfile
+    import time as _time
+
+    from katib_tpu.api import (
+        AlgorithmSetting, AlgorithmSpec, ExperimentSpec, FeasibleSpace,
+        ObjectiveSpec, ObjectiveType, ParameterSpec, ParameterType,
+        TrialTemplate,
+    )
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.tracing import SPAN_DURATION_METRIC
+
+    n_trials = 8 if smoke else 64
+    candidates = 256 if smoke else 2048  # weight the inline compute
+    # Pipelining needs the trial window to cover the precompute, as real
+    # sweeps do (trials run minutes; suggestion batches take ms-s). The
+    # sleep is idle time, so on the 1-core box the prefetch worker
+    # computes in it without contending with trial work.
+    trial_seconds = 0.02 if smoke else 0.06
+
+    def trial_fn(assignments, ctx):
+        x = float(assignments["x0"])
+        _time.sleep(trial_seconds)
+        ctx.report(loss=(x - 0.4) ** 2)
+
+    def spec_for(name):
+        return ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec(
+                    f"x{i}", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.0", max="1.0"),
+                )
+                for i in range(6)
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MINIMIZE, objective_metric_name="loss"
+            ),
+            algorithm=AlgorithmSpec(
+                "tpe",
+                algorithm_settings=[
+                    AlgorithmSetting("random_state", "11"),
+                    AlgorithmSetting("n_startup_trials", "4"),
+                    AlgorithmSetting("n_ei_candidates", str(candidates)),
+                ],
+            ),
+            trial_template=TrialTemplate(function=trial_fn),
+            max_trial_count=n_trials,
+            parallel_trial_count=4,
+        )
+
+    def run_once(async_on: bool):
+        root = tempfile.mkdtemp(prefix="bench-suggest-pipe-")
+        cfg = KatibConfig()
+        cfg.runtime.async_suggest = async_on
+        cfg.runtime.vector_suggest = False  # isolate the pipeline
+        cfg.runtime.telemetry = False
+        cfg.runtime.compile_service = False
+        c = ExperimentController(
+            root_dir=root, devices=list(range(4)), config=cfg
+        )
+        try:
+            name = f"pipe-{'async' if async_on else 'inline'}"
+            c.create_experiment(spec_for(name))
+            t0 = _time.time()
+            exp = c.run(name, timeout=600)
+            wall = _time.time() - t0
+            assert exp.status.is_succeeded, exp.status.message
+            trials = c.state.list_trials(name)
+            names = [t.name for t in trials]
+            # integrity: zero duplicate or lost assignments
+            assert len(names) == len(set(names)) == n_trials, (
+                len(names), len(set(names)))
+            key = (SPAN_DURATION_METRIC, (("stage", "suggestion"),))
+            hist = c.metrics._histograms.get(key)
+            mean_span = (hist.sum / hist.count) if hist and hist.count else 0.0
+            hits = sum(
+                v for (metric, _), v in c.metrics._counters.items()
+                if metric == "katib_suggestion_buffer_ready_total"
+            )
+            return mean_span, wall, hits
+        finally:
+            c.close()
+
+    inline_span, inline_wall, _ = run_once(False)
+    async_span, async_wall, async_hits = run_once(True)
+    ratio = inline_span / async_span if async_span else float("inf")
+    if not smoke:
+        assert async_hits > 0, "async sweep never hit the prefetch buffer"
+        assert ratio >= 3.0, (
+            f"mean suggestion span only improved {ratio:.1f}x "
+            f"({inline_span*1e3:.2f}ms -> {async_span*1e3:.2f}ms)"
+        )
+    return {
+        "trials": n_trials,
+        "inline_mean_span_ms": round(inline_span * 1e3, 3),
+        "async_mean_span_ms": round(async_span * 1e3, 3),
+        "span_ratio": round(ratio, 2),
+        "inline_wall_s": round(inline_wall, 2),
+        "async_wall_s": round(async_wall, 2),
+        "async_buffer_hits": async_hits,
+        "target_ratio": 3.0,
+        "within_target": ratio >= 3.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -1673,6 +1954,17 @@ def child_main(platform: str) -> None:
             extras["pbt_fused_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _checkpoint_stage(payload)
 
+    if os.environ.get("BENCH_SKIP_SUGGEST") != "1" and gate("suggestion", 90.0):
+        try:
+            extras["suggestion_throughput"] = _bench_suggestion_throughput()
+        except Exception as e:
+            extras["suggestion_throughput"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        try:
+            extras["suggestion_pipeline_latency"] = _bench_suggestion_pipeline_latency()
+        except Exception as e:
+            extras["suggestion_pipeline_latency"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _checkpoint_stage(payload)
+
     if os.environ.get("BENCH_SKIP_OBSLOG") != "1" and gate("obslog", 30.0):
         try:
             extras["obslog_report_throughput"] = _bench_obslog_report_throughput()
@@ -2143,6 +2435,8 @@ OBSLOG_SCENARIOS = {
     "analyze_latency": _bench_analyze_latency,
     "compile_amortization": _bench_compile_amortization,
     "pbt_fused_throughput": _bench_pbt_fused_throughput,
+    "suggestion_throughput": _bench_suggestion_throughput,
+    "suggestion_pipeline_latency": _bench_suggestion_pipeline_latency,
 }
 
 

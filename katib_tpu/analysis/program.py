@@ -284,6 +284,14 @@ def _search_signature(spec) -> str:
     return hashlib.sha1(basis.encode()).hexdigest()[:12]
 
 
+def search_signature(spec) -> str:
+    """Public form of the search-space digest — the transfer-HPO matching
+    key (ISSUE 10 warm start) shares the exact digest the analysis cache
+    already uses, so two experiments warm-start-match iff their parameter
+    specs serialize identically."""
+    return _search_signature(spec)
+
+
 # ---------------------------------------------------------------------------
 # Search-space probing points
 # ---------------------------------------------------------------------------

@@ -101,6 +101,25 @@ class RuntimeConfig:
     # heartbeat for chunks longer than stall_seconds. Off by default — the
     # callback is a per-generation host sync.
     population_stream_telemetry: bool = False
+    # Vectorized suggestion plane (suggest/vectorized.py, ISSUE 10): the
+    # TPE/CMA-ES/BO hot kernels run as batched jitted programs.
+    # vector_suggest=false / KATIB_TPU_VECTOR_SUGGEST=0 restores the
+    # legacy NumPy suggesters byte-identically.
+    vector_suggest: bool = True
+    # Async pipelined suggestion (controller/suggestion.py): a background
+    # worker precomputes the next batch per experiment so scheduler
+    # dispatch consults a ready buffer instead of blocking inline. Opt-in:
+    # precomputed batches may lag the freshest completion by one pipeline
+    # step (the constant-liar staleness model).
+    async_suggest: bool = False
+    # Precomputed assignments beyond the predicted request; 0 = the
+    # experiment's parallel_trial_count.
+    suggest_readahead: int = 0
+    # Cross-experiment warm start (transfer HPO): seed TPE/BO priors and
+    # the CMA-ES mean from completed experiments with a matching
+    # search-space + objective signature. Opt-in.
+    warm_start: bool = False
+    warm_start_max_points: int = 256  # cap on transferred observations
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -139,6 +158,11 @@ ENV_OVERRIDES: Dict[str, str] = {
     "fused_population": "KATIB_TPU_FUSED_POPULATION",
     "population_chunk_generations": "KATIB_TPU_POPULATION_CHUNK_GENERATIONS",
     "population_stream_telemetry": "KATIB_TPU_POPULATION_STREAM_TELEMETRY",
+    "vector_suggest": "KATIB_TPU_VECTOR_SUGGEST",
+    "async_suggest": "KATIB_TPU_ASYNC_SUGGEST",
+    "suggest_readahead": "KATIB_TPU_SUGGEST_READAHEAD",
+    "warm_start": "KATIB_TPU_WARM_START",
+    "warm_start_max_points": "KATIB_TPU_WARM_START_MAX_POINTS",
 }
 
 _FALSY = ("0", "false", "off")

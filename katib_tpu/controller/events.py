@@ -339,6 +339,13 @@ _HELP_CATALOG: Dict[str, str] = {
     # fused population loops (katib_tpu/runtime/population.py, ISSUE 9)
     "katib_population_generations_total": "PBT/ENAS generations executed by the fused population runtime.",
     "katib_population_fused_seconds": "Wall-clock of fused population scan chunks (one compiled program per chunk).",
+    # vectorized / async suggestion plane (ISSUE 10, suggest/vectorized.py
+    # + controller/suggestion.py) — WarmStartApplied pairs with the
+    # warm-start counter
+    "katib_suggestion_batch_seconds": "Wall-clock of suggestion batch computes, by algorithm and mode (inline vs prefetch).",
+    "katib_suggestion_buffer_ready_total": "Assignments served from the async prefetch buffer.",
+    "katib_suggestion_buffer_miss_total": "Buffer consults that fell back to the inline compute (cold or stale buffer).",
+    "katib_warm_start_total": "Experiments whose suggester was seeded from matching completed-experiment history.",
 }
 
 
@@ -392,4 +399,6 @@ EVENT_CATALOG: Dict[str, str] = {
     "BackendInitFailed": "Accelerator backend init/probe failed or hung; device probing disabled for this process.",
     # fused population loops (PR 9, katib_tpu/runtime/population.py)
     "PopulationFused": "Opted-in PBT/ENAS sweep dispatched as one fused on-device population program.",
+    # vectorized suggestion plane / transfer HPO (PR 10)
+    "WarmStartApplied": "Suggester seeded from completed experiments with a matching search-space signature.",
 }

@@ -46,7 +46,11 @@ from ..earlystop.medianstop import registered_early_stoppers
 from ..suggest.base import registered_algorithms
 from .scheduler import TrialScheduler
 from .status import is_completed_experiment_restartable, update_experiment_status
-from .suggestion import SuggestionFailed, SuggestionService
+from .suggestion import (
+    SuggestionFailed,
+    SuggestionService,
+    suggestion_request_plan,
+)
 
 log = logging.getLogger("katib_tpu.experiment")
 
@@ -85,6 +89,13 @@ class ExperimentController:
         # capacity, executor selection and the fused reconcile branch all
         # consult runtime_enabled()
         fused_population.set_enabled(rt.fused_population)
+        from ..suggest import vectorized as vectorized_suggest
+
+        # vectorized suggestion plane (suggest/vectorized.py, ISSUE 10):
+        # one switch consulted by the TPE/CMA-ES/BO hot paths;
+        # vector_suggest=false / KATIB_TPU_VECTOR_SUGGEST=0 restores the
+        # legacy NumPy suggesters byte-identically
+        vectorized_suggest.set_enabled(rt.vector_suggest)
         if rt.xla_cache_dir:
             # picked up by utils.compilation.enable_compilation_cache in
             # whichever process first touches JAX
@@ -143,7 +154,13 @@ class ExperimentController:
             ring_size=rt.telemetry_ring_samples,
         )
         self.telemetry.start()
-        self.suggestions = SuggestionService(self.state, self.obs_store, config=self.config)
+        self.suggestions = SuggestionService(
+            self.state,
+            self.obs_store,
+            config=self.config,
+            metrics=self.metrics,
+            events=self.events,
+        )
         # add_collector, not set_collector: the telemetry sampler registered
         # its own gauge hook on the same registry
         self.metrics.add_collector(
@@ -196,6 +213,14 @@ class ExperimentController:
             fused_population=rt.fused_population,
             population_chunk_generations=rt.population_chunk_generations,
             population_stream=rt.population_stream_telemetry,
+            # async suggestion pipeline (ISSUE 10): a terminal trial means
+            # the next batch's history just changed — the hook starts the
+            # precompute before the reconcile loop consults
+            suggestion_prefetch=(
+                self.suggestions.notify_trials_changed
+                if rt.async_suggest
+                else None
+            ),
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -377,62 +402,55 @@ class ExperimentController:
         sts = exp.status
         parallel = exp.spec.parallel_trial_count or 1
         active = sts.trials_pending + sts.trials_running
-        completed = (
-            sts.trials_succeeded + sts.trials_failed + sts.trials_killed + sts.trials_early_stopped
-        )
 
         if active > parallel:
             self._delete_trials(exp, trials, active - parallel)
             return
         if active >= parallel:
             return
-        if exp.spec.max_trial_count is None:
-            required_active = parallel
-        else:
-            required_active = min(exp.spec.max_trial_count - completed, parallel)
-        add_count = required_active - active
+        # Budget math + incomplete-early-stopped exclusion
+        # (experiment_controller.go:274-330, :449-461) — shared with the
+        # async prefetch worker so both compute identical request numbers.
+        add_count, requests = suggestion_request_plan(
+            exp, trials, lambda t: self._observation_available(exp, t)
+        )
         if add_count <= 0:
             return
-
-        # Exclude incomplete early-stopped trials from the request total
-        # (experiment_controller.go:449-461).
-        incomplete_es = sum(
-            1
-            for t in trials
-            if t.condition == TrialCondition.EARLY_STOPPED and not self._observation_available(exp, t)
-        )
-        requests = len(trials) + add_count - incomplete_es
 
         suggest_start = time.time()
         assignments = self.suggestions.sync_assignments(exp, trials, requests)
         suggest_end = time.time()
-        # Deferred dispatch: queue the whole batch first, then one dispatch
-        # pass — pack formation (controller/packing.py) needs the batch's
-        # packable trials waiting TOGETHER, or the first would start solo on
-        # free devices before its pack-mates are submitted.
-        for assignment in assignments[:add_count]:
-            trial = Trial.from_assignment(assignment, exp.name)
-            trial.labels["katib-tpu/experiment"] = exp.name
-            self.state.create_trial(trial)
-            if self.tracer.enabled:
-                # the trial's trace starts where its lifecycle did: at the
-                # suggestion batch that produced it. Every trial of the
-                # batch carries the same `suggestion` child span window.
-                root = self.tracer.begin_trial(
-                    exp.name, trial.name, start=suggest_start
-                )
-                if root is not None:
-                    self.tracer.record_span(
-                        "suggestion", exp.name, root.trace_id, root.span_id,
-                        start=suggest_start, end=suggest_end,
-                        algorithm=exp.spec.algorithm.algorithm_name,
-                        batch=len(assignments),
+        # Deferred dispatch under the scheduler's barrier: queue the whole
+        # batch first, then one dispatch pass — pack formation
+        # (controller/packing.py) needs the batch's packable trials waiting
+        # TOGETHER, or the first would start solo on free devices before
+        # its pack-mates are submitted. The barrier also blocks CONCURRENT
+        # dispatch triggers (a compile finishing in the service, another
+        # trial releasing its gang) from splitting the batch mid-submit.
+        with self.scheduler.dispatch_barrier():
+            for assignment in assignments[:add_count]:
+                trial = Trial.from_assignment(assignment, exp.name)
+                trial.labels["katib-tpu/experiment"] = exp.name
+                self.state.create_trial(trial)
+                if self.tracer.enabled:
+                    # the trial's trace starts where its lifecycle did: at
+                    # the suggestion batch that produced it. Every trial of
+                    # the batch carries the same `suggestion` child span
+                    # window.
+                    root = self.tracer.begin_trial(
+                        exp.name, trial.name, start=suggest_start
                     )
-            checkpoint_dir = self._checkpoint_dir_for(exp, trial)
-            self.scheduler.submit(
-                exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
-            )
-        self.scheduler.dispatch()
+                    if root is not None:
+                        self.tracer.record_span(
+                            "suggestion", exp.name, root.trace_id, root.span_id,
+                            start=suggest_start, end=suggest_end,
+                            algorithm=exp.spec.algorithm.algorithm_name,
+                            batch=len(assignments),
+                        )
+                checkpoint_dir = self._checkpoint_dir_for(exp, trial)
+                self.scheduler.submit(
+                    exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
+                )
 
     def _reconcile_fused(self, exp: Experiment, trials: List[Trial]) -> None:
         """Dispatch (or supervise) one fused population sweep
@@ -476,38 +494,43 @@ class ExperimentController:
             else None
         )
         suggest_ts = time.time()
-        for i, params in enumerate(members):
-            trial = Trial(
-                name=pop.member_name(exp.spec, i),
-                experiment_name=exp.name,
-                parameter_assignments=[
-                    ParameterAssignment(k, v) for k, v in sorted(params.items())
-                ],
-                labels={
-                    pop.FUSED_LABEL: str(i),
-                    "katib-tpu/experiment": exp.name,
-                },
-            )
-            self.state.create_trial(trial)
-            if self.tracer.enabled:
-                root = self.tracer.begin_trial(
-                    exp.name, trial.name, start=suggest_ts
+        # The barrier makes the K-member submission atomic: a concurrent
+        # dispatch (e.g. the admission-prewarmed fused program turning warm
+        # in the compile service mid-submit) must never see a partial
+        # population — a split fused pack would run each fragment as its
+        # own full sweep.
+        with self.scheduler.dispatch_barrier():
+            for i, params in enumerate(members):
+                trial = Trial(
+                    name=pop.member_name(exp.spec, i),
+                    experiment_name=exp.name,
+                    parameter_assignments=[
+                        ParameterAssignment(k, v) for k, v in sorted(params.items())
+                    ],
+                    labels={
+                        pop.FUSED_LABEL: str(i),
+                        "katib-tpu/experiment": exp.name,
+                    },
                 )
-                if root is not None:
-                    self.tracer.record_span(
-                        "suggestion", exp.name, root.trace_id, root.span_id,
-                        start=suggest_ts, end=suggest_ts,
-                        algorithm=exp.spec.algorithm.algorithm_name,
-                        fused=True, batch=len(members),
+                self.state.create_trial(trial)
+                if self.tracer.enabled:
+                    root = self.tracer.begin_trial(
+                        exp.name, trial.name, start=suggest_ts
                     )
-            self.scheduler.submit(
-                exp, trial, checkpoint_dir=ck_root, dispatch=False
-            )
-        # the sweep IS the search: once its members finish, no further
-        # suggestions exist, and active==0 + search-end completes the
-        # experiment
-        self.suggestions.mark_search_ended(exp.name)
-        self.scheduler.dispatch()
+                    if root is not None:
+                        self.tracer.record_span(
+                            "suggestion", exp.name, root.trace_id, root.span_id,
+                            start=suggest_ts, end=suggest_ts,
+                            algorithm=exp.spec.algorithm.algorithm_name,
+                            fused=True, batch=len(members),
+                        )
+                self.scheduler.submit(
+                    exp, trial, checkpoint_dir=ck_root, dispatch=False
+                )
+            # the sweep IS the search: once its members finish, no further
+            # suggestions exist, and active==0 + search-end completes the
+            # experiment
+            self.suggestions.mark_search_ended(exp.name)
 
     @staticmethod
     def _observation_available(exp: Experiment, trial: Trial) -> bool:
@@ -543,6 +566,10 @@ class ExperimentController:
             self.state.put_suggestion(suggestion)
 
     def _on_completed(self, exp: Experiment) -> None:
+        # transfer-HPO index (ISSUE 10): completed observations become
+        # warm-start priors for future experiments with a matching
+        # search-space + objective signature
+        self.suggestions.index_completed_history(exp)
         self.suggestions.cleanup(exp)
         outcome = "succeeded" if exp.status.is_succeeded else "failed"
         self.metrics.inc(f"katib_experiment_{outcome}_total", experiment=exp.name)
@@ -664,6 +691,7 @@ class ExperimentController:
             if not t.is_terminal:
                 self.scheduler.kill(t.name)
             self.obs_store.delete_observation_log(t.name)
+        self.obs_store.delete_experiment_history(name)
         self.suggestions.forget(name)
         self.scheduler.forget_experiment(name)
         self.tracer.forget(name)
@@ -673,6 +701,7 @@ class ExperimentController:
 
     def close(self) -> None:
         self._closed.set()  # unhooks run() loops (incl. UI run-threads)
+        self.suggestions.close()
         self.scheduler.kill_all()
         self.scheduler.join(timeout=10)
         if self.compile_service is not None:

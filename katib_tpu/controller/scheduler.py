@@ -108,6 +108,7 @@ class TrialScheduler:
         fused_population: bool = True,
         population_chunk_generations: int = 16,
         population_stream: bool = False,
+        suggestion_prefetch: Optional[Callable[[str], None]] = None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -117,6 +118,11 @@ class TrialScheduler:
         self.metrics_registry = metrics
         self.tracer = tracer  # katib_tpu.tracing.Tracer (None = no tracing)
         self.telemetry = telemetry  # telemetry.ResourceSampler (None = off)
+        # async suggestion pipeline hook (ISSUE 10): called with the
+        # experiment name whenever a trial reaches a terminal condition, so
+        # the SuggestionService can precompute the next batch before the
+        # reconcile loop consults it
+        self.suggestion_prefetch = suggestion_prefetch
         self._queue_spans: Dict[str, Any] = {}  # trial -> open queue_wait span
         if devices is None:
             devices = list(range(8))  # abstract slots when JAX not involved
@@ -145,6 +151,7 @@ class TrialScheduler:
         self._quarantined = 0  # devices held by abandoned zombie trials
         self._shutdown = threading.Event()
         self._intentional_kills: set = set()  # kill() targets, vs shutdown kills
+        self._dispatch_paused = 0  # dispatch_barrier depth (batch submits)
         # -- fair-share scheduling state (controller/fairshare.py) -----------
         self.queue_stall_seconds = queue_stall_seconds
         self.preemption_grace_seconds = preemption_grace_seconds
@@ -348,6 +355,31 @@ class TrialScheduler:
         public form of the internal dispatch pass, for deferred submits)."""
         self._dispatch()
 
+    def dispatch_barrier(self):
+        """Context manager making a batch submission atomic with respect to
+        dispatch: passes triggered while the barrier is held (a compile
+        finishing in the service, a concurrent trial releasing its gang)
+        return immediately, and one pass runs at exit. Without this, a
+        dispatch landing between a batch's submit() calls sees a PARTIAL
+        batch — which split a fused population sweep into two smaller
+        packs, each running a full independent sweep (doubled population
+        rows, wrong population semantics), and starts packable trials solo
+        before their pack-mates arrive."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def barrier():
+            with self._lock:
+                self._dispatch_paused += 1
+            try:
+                yield
+            finally:
+                with self._lock:
+                    self._dispatch_paused -= 1
+                self._dispatch()
+
+        return barrier()
+
     def _reuse_duplicate(self, exp: Experiment, trial: Trial) -> bool:
         """Opt-in duplicate-result reuse (spec.reuse_duplicate_results): if a
         Succeeded trial of this experiment has exactly the same parameter
@@ -511,6 +543,10 @@ class TrialScheduler:
 
         now = time.time()
         with self._lock:
+            if self._dispatch_paused:
+                # a batch submission holds the dispatch barrier: this pass
+                # would see a partial batch; the barrier exit re-runs it
+                return
             self._threads = [t for t in self._threads if t.is_alive()]
             cs = self._cs()
             warm = None
@@ -1772,6 +1808,12 @@ class TrialScheduler:
         final condition (_finalize and _reuse_duplicate): persist, count,
         record the event, apply retainRun workdir semantics."""
         self.state.update_trial(trial)
+        if self.suggestion_prefetch is not None:
+            # fire-and-forget: the hook only enqueues a precompute job
+            try:
+                self.suggestion_prefetch(exp.name)
+            except Exception:
+                log.debug("suggestion prefetch hook failed", exc_info=True)
         if self.metrics_registry is not None:
             bucket = {
                 TrialCondition.SUCCEEDED: "succeeded",

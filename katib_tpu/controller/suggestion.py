@@ -8,12 +8,39 @@ Replaces three reference components with one in-process driver:
 - suggestionclient/suggestionclient.go:83-198 (SyncAssignments: request delta
   computation, algorithm-settings overlay + feedback merge, early-stopping
   rule fetch, trial naming).
+
+ISSUE 10 adds two throughput layers on top of the sync contract:
+
+- **Async pipelined suggestion** (``runtime.async_suggest``, opt-in): a
+  background worker precomputes the next suggestion batch per experiment —
+  kicked when a trial reaches a terminal condition (scheduler
+  ``suggestion_prefetch`` hook) and re-armed after every consult — so the
+  reconcile loop's ``sync_assignments`` commits a ready buffer instead of
+  blocking on KDE/GP/CMA math inline (the PR 4 ``suggestion`` span
+  measures exactly this wait). A cold or mismatched buffer falls back to
+  the inline compute, so nothing is ever lost; the commit path is locked,
+  so nothing is ever served twice. Precomputed batches may lag the very
+  freshest completions by one pipeline step — the same staleness the
+  constant-liar treatment of pending trials already models — and only
+  stateless-per-call algorithms are eligible (``ASYNC_SAFE``).
+- **Cross-experiment warm start** (``runtime.warm_start``, opt-in):
+  completed experiments are indexed in db/store.py by search-space
+  signature (the PR 7 digest + objective identity); a new experiment with
+  a matching signature receives those observations as
+  :class:`~katib_tpu.suggest.base.WarmStartData` priors — TPE/BO count
+  them as history (skipping the random startup phase), CMA-ES anchors its
+  initial mean at the best matching point. ``WarmStartApplied`` is emitted
+  once per experiment.
 """
 
 from __future__ import annotations
 
-import copy
-from typing import Dict, List, Optional, Sequence
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api.spec import (
     AlgorithmSetting,
@@ -23,15 +50,113 @@ from ..api.spec import (
 )
 from ..api.status import Experiment, SuggestionState, Trial, TrialCondition
 from ..db.state import ExperimentStateStore
-from ..db.store import ObservationStore
+from ..db.store import ObservationStore, observation_available
 from ..earlystop.medianstop import EarlyStopper, create_early_stopper
-from ..suggest.base import Suggester, SuggestionReply, SuggestionRequest, create
+from ..suggest.base import (
+    Suggester,
+    SuggestionReply,
+    SuggestionRequest,
+    WarmStartData,
+    create,
+)
 from ..suggest.hyperband import TrialsNotCompleted
+
+log = logging.getLogger("katib_tpu.suggestion")
+
+# Algorithms eligible for background precompute: stateless-per-call (no
+# on-disk side effects a discarded speculative batch could corrupt — PBT
+# checkpoints and the ENAS controller pickle rule those out) and tolerant
+# of one pipeline step of history staleness because they already model
+# pending evaluations via the constant liar. Custom import-path/service
+# overrides are excluded at the _async_for gate.
+ASYNC_SAFE = frozenset({"tpe", "multivariate-tpe", "bayesianoptimization", "cmaes"})
+
+_TERMINAL_BUCKETS = frozenset(
+    {
+        TrialCondition.KILLED,
+        TrialCondition.FAILED,
+        TrialCondition.SUCCEEDED,
+        TrialCondition.EARLY_STOPPED,
+        TrialCondition.METRICS_UNAVAILABLE,
+    }
+)
+
+
+def suggestion_request_plan(
+    exp: Experiment,
+    trials: Sequence[Trial],
+    observation_available_fn: Callable[[Trial], bool],
+) -> Tuple[int, int]:
+    """(add_count, requests): the reconcile budget math, shared by
+    ExperimentController._reconcile_trials and the async prefetch worker.
+
+    Mirrors ReconcileTrials (experiment_controller.go:274-330) — addCount =
+    min(parallel, max - completed) - active — plus the incomplete
+    early-stopped exclusion from the request total (:449-461). Counts come
+    from raw trial conditions using exactly update_trials_summary's bucket
+    rules, so the worker needs no status-aggregation pass and the numbers
+    match the controller's byte for byte.
+    """
+    parallel = exp.spec.parallel_trial_count or 1
+    completed = 0
+    active = 0
+    for t in trials:
+        if t.condition in (
+            TrialCondition.SUCCEEDED,
+            TrialCondition.FAILED,
+            TrialCondition.KILLED,
+            TrialCondition.EARLY_STOPPED,
+        ):
+            completed += 1
+        if t.condition == TrialCondition.RUNNING or t.condition not in _TERMINAL_BUCKETS:
+            active += 1
+    if exp.spec.max_trial_count is None:
+        required_active = parallel
+    else:
+        required_active = min(exp.spec.max_trial_count - completed, parallel)
+    add_count = required_active - active
+    incomplete_es = sum(
+        1
+        for t in trials
+        if t.condition == TrialCondition.EARLY_STOPPED and not observation_available_fn(t)
+    )
+    requests = len(trials) + add_count - incomplete_es
+    return add_count, requests
+
+
+def warm_start_signature(spec: ExperimentSpec) -> str:
+    """Transfer-HPO matching key: the PR 7 search-space digest
+    (analysis/program.py) extended with the objective identity, so history
+    only transfers between experiments optimizing the same metric in the
+    same direction over the same space."""
+    from ..analysis.program import search_signature
+
+    return (
+        f"{search_signature(spec)}:{spec.objective.objective_metric_name}"
+        f":{spec.objective.type.value}"
+    )
 
 
 class SuggestionFailed(Exception):
     """Marks the suggestion failed -> experiment fails
     (experiment_controller.go:470-473)."""
+
+
+@dataclass
+class _BufferEntry:
+    """One precomputed suggestion batch. Exactly-once serving (the
+    no-duplicate / no-loss invariant under concurrent sync_assignments)
+    comes from popping under the service lock plus unique random trial
+    names — NOT from the ``base_count`` tag, which records the
+    suggestion_count the batch was computed against purely to bound how
+    stale a served batch may be. Bounded staleness is load-bearing on a
+    busy box: requiring an exact count match starves the pipeline (one
+    inline miss burns the core, the worker's batch goes stale, repeat)."""
+
+    base_count: int
+    assignments: List[TrialAssignment] = field(default_factory=list)
+    algorithm_settings: Dict[str, str] = field(default_factory=dict)
+    search_ended: bool = False
 
 
 class SuggestionService:
@@ -43,13 +168,50 @@ class SuggestionService:
         state: ExperimentStateStore,
         obs_store: ObservationStore,
         config=None,
+        metrics=None,
+        events=None,
     ):
         self.state = state
         self.obs_store = obs_store
         self.config = config  # KatibConfig; per-algorithm overrides (types.go)
+        self.metrics = metrics
+        self.events = events
+        # RLock: the consult/commit path holds it across suggester_for and
+        # the search-end mark; the prefetch worker only takes it for buffer
+        # swaps — never while computing — so inline fallbacks cannot
+        # deadlock behind a slow precompute.
+        self._lock = threading.RLock()
         self._suggesters: Dict[str, Suggester] = {}
         self._early_stoppers: Dict[str, EarlyStopper] = {}
         self._search_ended: Dict[str, bool] = {}
+        self._buffer: Dict[str, _BufferEntry] = {}
+        self._warm: Dict[str, Optional[WarmStartData]] = {}
+        self._prefetch_pending: set = set()
+        self._prefetch_queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- knob plumbing -------------------------------------------------------
+
+    def _runtime(self):
+        return self.config.runtime if self.config is not None else None
+
+    def _async_for(self, exp: Experiment) -> bool:
+        rt = self._runtime()
+        if rt is None or not getattr(rt, "async_suggest", False):
+            return False
+        algo = exp.spec.algorithm.algorithm_name
+        if algo not in ASYNC_SAFE:
+            return False
+        scfg = self.config.suggestions.get(algo) if self.config else None
+        if scfg is not None and (scfg.service_address or scfg.import_path):
+            return False  # custom implementations: side effects unknown
+        return True
+
+    def _readahead(self, exp: Experiment) -> int:
+        rt = self._runtime()
+        n = int(getattr(rt, "suggest_readahead", 0) or 0) if rt else 0
+        return n if n > 0 else (exp.spec.parallel_trial_count or 1)
 
     @staticmethod
     def _import_class(import_path: str):
@@ -62,46 +224,48 @@ class SuggestionService:
 
     def suggester_for(self, exp: Experiment) -> Suggester:
         name = exp.name
-        if name not in self._suggesters:
-            algo = exp.spec.algorithm.algorithm_name
-            kwargs = {}
-            # stateful algorithms get the experiment directory for their
-            # checkpoints (the FromVolume PVC equivalent, composer.go:296+)
-            exp_dir = self.state.experiment_dir(name)
-            if algo == "pbt":
-                import os
+        with self._lock:
+            if name not in self._suggesters:
+                algo = exp.spec.algorithm.algorithm_name
+                kwargs = {}
+                # stateful algorithms get the experiment directory for their
+                # checkpoints (the FromVolume PVC equivalent, composer.go:296+)
+                exp_dir = self.state.experiment_dir(name)
+                if algo == "pbt":
+                    import os
 
-                kwargs["checkpoint_root"] = (
-                    None if exp_dir is None else os.path.join(exp_dir, "pbt")
-                )
-            elif algo == "enas":
-                kwargs["state_dir"] = exp_dir
-            # KatibConfig per-algorithm override: out-of-process service
-            # address (the reference's per-experiment suggestion pod) or a
-            # custom implementation import path (the custom container image).
-            scfg = self.config.suggestions.get(algo) if self.config else None
-            if scfg is not None and scfg.service_address:
-                from ..service.rpc import RemoteSuggester
+                    kwargs["checkpoint_root"] = (
+                        None if exp_dir is None else os.path.join(exp_dir, "pbt")
+                    )
+                elif algo == "enas":
+                    kwargs["state_dir"] = exp_dir
+                # KatibConfig per-algorithm override: out-of-process service
+                # address (the reference's per-experiment suggestion pod) or a
+                # custom implementation import path (the custom container image).
+                scfg = self.config.suggestions.get(algo) if self.config else None
+                if scfg is not None and scfg.service_address:
+                    from ..service.rpc import RemoteSuggester
 
-                self._suggesters[name] = RemoteSuggester(scfg.service_address)
-            elif scfg is not None and scfg.import_path:
-                self._suggesters[name] = self._import_class(scfg.import_path)(**kwargs)
-            else:
-                self._suggesters[name] = create(algo, **kwargs)
-        return self._suggesters[name]
+                    self._suggesters[name] = RemoteSuggester(scfg.service_address)
+                elif scfg is not None and scfg.import_path:
+                    self._suggesters[name] = self._import_class(scfg.import_path)(**kwargs)
+                else:
+                    self._suggesters[name] = create(algo, **kwargs)
+            return self._suggesters[name]
 
     def early_stopper_for(self, exp: Experiment) -> Optional[EarlyStopper]:
         if exp.spec.early_stopping is None:
             return None
         name = exp.name
-        if name not in self._early_stoppers:
-            algo = exp.spec.early_stopping.algorithm_name
-            ecfg = self.config.early_stopping.get(algo) if self.config else None
-            if ecfg is not None and ecfg.import_path:
-                self._early_stoppers[name] = self._import_class(ecfg.import_path)()
-            else:
-                self._early_stoppers[name] = create_early_stopper(algo)
-        return self._early_stoppers[name]
+        with self._lock:
+            if name not in self._early_stoppers:
+                algo = exp.spec.early_stopping.algorithm_name
+                ecfg = self.config.early_stopping.get(algo) if self.config else None
+                if ecfg is not None and ecfg.import_path:
+                    self._early_stoppers[name] = self._import_class(ecfg.import_path)()
+                else:
+                    self._early_stoppers[name] = create_early_stopper(algo)
+            return self._early_stoppers[name]
 
     def validate(self, exp: Experiment) -> None:
         """ValidateAlgorithmSettings + ValidateEarlyStoppingSettings before
@@ -118,14 +282,16 @@ class SuggestionService:
                 raise SuggestionFailed(f"early stopping settings invalid: {e}") from e
 
     def search_ended(self, experiment_name: str) -> bool:
-        return self._search_ended.get(experiment_name, False)
+        with self._lock:
+            return self._search_ended.get(experiment_name, False)
 
     def mark_search_ended(self, experiment_name: str) -> None:
         """Declare search end without a suggester round-trip — the fused
         population path (controller/experiment._reconcile_fused) submits
         its whole sweep up front, so there are no further suggestions by
         construction."""
-        self._search_ended[experiment_name] = True
+        with self._lock:
+            self._search_ended[experiment_name] = True
 
     def get_or_create(self, exp: Experiment, requests: int) -> SuggestionState:
         """reference experiment/suggestion/suggestion.go:53-112."""
@@ -148,28 +314,64 @@ class SuggestionService:
         """Returns assignments that do not have trials yet.
 
         Mirrors ReconcileSuggestions (experiment_controller.go:445-493) +
-        SyncAssignments (suggestionclient.go:83-198).
+        SyncAssignments (suggestionclient.go:83-198). With async suggestion
+        enabled the compute is consumed from the prefetch buffer when one
+        matches (inline fallback otherwise) and the next batch is scheduled
+        on the worker; without it this is the legacy inline path verbatim.
         """
         suggestion = self.get_or_create(exp, requests)
         if suggestion.failed:
             raise SuggestionFailed(suggestion.message or "Suggestion has failed")
 
-        current_request = suggestion.requests - suggestion.suggestion_count
-        if current_request > 0:
-            # Overlay settings feedback (hyperband state) onto a spec copy
-            # before calling the algorithm (suggestionclient.go:106-109).
-            filled = ExperimentSpec.from_json(exp.spec.to_json())
-            if exp.spec.trial_template.function is not None:
-                filled.trial_template.function = exp.spec.trial_template.function
-            self._apply_config_defaults(filled)
-            self._overlay_settings(filled, suggestion.algorithm_settings)
+        if self._async_for(exp):
+            with self._lock:
+                self._sync_once(exp, trials, suggestion, buffered=True)
+            self._schedule_prefetch(exp.name)
+        else:
+            self._sync_once(exp, trials, suggestion, buffered=False)
 
+        trial_names = {t.name for t in trials}
+        return [a for a in suggestion.suggestions if a.name not in trial_names]
+
+    def _sync_once(
+        self,
+        exp: Experiment,
+        trials: Sequence[Trial],
+        suggestion: SuggestionState,
+        buffered: bool,
+    ) -> None:
+        """One request-delta fill. ``buffered=True`` runs under self._lock
+        (caller holds it) so concurrent sync_assignments serialize on the
+        consult/commit and a buffer entry is committed exactly once."""
+        current_request = suggestion.requests - suggestion.suggestion_count
+        if current_request <= 0:
+            return
+        # Overlay settings feedback (hyperband state) onto a spec copy
+        # before calling the algorithm (suggestionclient.go:106-109).
+        filled = self._filled_spec(exp, suggestion.algorithm_settings)
+
+        served: List[TrialAssignment] = []
+        feedback: Dict[str, str] = {}
+        ended = False
+        if buffered:
+            taken, feedback, ended = self._consume_buffer(
+                exp.name,
+                suggestion.suggestion_count,
+                current_request,
+                self._readahead(exp),
+            )
+            served.extend(taken)
+
+        shortfall = current_request - len(served)
+        if shortfall > 0 and not ended:
             request = SuggestionRequest(
                 experiment=filled,
                 trials=list(trials),
-                current_request_number=current_request,
+                current_request_number=shortfall,
                 total_request_number=suggestion.requests,
+                warm_start=self._warm_start_for(exp),
             )
+            t0 = time.perf_counter()
             try:
                 reply = self.suggester_for(exp).get_suggestions(request)
             except TrialsNotCompleted:
@@ -181,25 +383,259 @@ class SuggestionService:
                 suggestion.message = f"{type(e).__name__}: {e}"
                 self.state.put_suggestion(suggestion)
                 raise SuggestionFailed(suggestion.message) from e
+            self._observe_batch(exp, time.perf_counter() - t0, "inline")
+            served.extend(reply.assignments)
+            feedback.update(reply.algorithm_settings)
+            ended = ended or reply.search_ended
 
-            # early stopping rules are fetched after suggestions and attached
-            # to every new assignment (suggestionclient.go:131-170)
-            rules: List[EarlyStoppingRule] = []
-            stopper = self.early_stopper_for(exp)
-            if stopper is not None and reply.assignments:
-                rules = stopper.get_early_stopping_rules(filled, trials, self.obs_store)
-            for a in reply.assignments:
-                a.early_stopping_rules = list(rules)
+        # early stopping rules are fetched after suggestions and attached
+        # to every new assignment (suggestionclient.go:131-170)
+        rules: List[EarlyStoppingRule] = []
+        stopper = self.early_stopper_for(exp)
+        if stopper is not None and served:
+            rules = stopper.get_early_stopping_rules(filled, trials, self.obs_store)
+        for a in served:
+            a.early_stopping_rules = list(rules)
 
-            suggestion.suggestions.extend(reply.assignments)
-            if reply.algorithm_settings:
-                suggestion.algorithm_settings.update(reply.algorithm_settings)
-            if reply.search_ended:
-                self._search_ended[exp.name] = True
-            self.state.put_suggestion(suggestion)
+        suggestion.suggestions.extend(served)
+        if feedback:
+            suggestion.algorithm_settings.update(feedback)
+        if ended:
+            self.mark_search_ended(exp.name)
+        self.state.put_suggestion(suggestion)
 
-        trial_names = {t.name for t in trials}
-        return [a for a in suggestion.suggestions if a.name not in trial_names]
+    def _filled_spec(self, exp: Experiment, settings: Dict[str, str]) -> ExperimentSpec:
+        filled = ExperimentSpec.from_json(exp.spec.to_json())
+        if exp.spec.trial_template.function is not None:
+            filled.trial_template.function = exp.spec.trial_template.function
+        self._apply_config_defaults(filled)
+        self._overlay_settings(filled, settings)
+        return filled
+
+    def _observe_batch(self, exp: Experiment, seconds: float, mode: str) -> None:
+        if self.metrics is not None:
+            self.metrics.observe(
+                "katib_suggestion_batch_seconds",
+                seconds,
+                algorithm=exp.spec.algorithm.algorithm_name,
+                mode=mode,
+            )
+
+    # -- async pipeline ------------------------------------------------------
+
+    def _consume_buffer(
+        self, name: str, live_count: int, wanted: int, stale_budget: int
+    ) -> Tuple[List[TrialAssignment], Dict[str, str], bool]:
+        """Pop up to ``wanted`` precomputed assignments. The entry serves
+        while the live suggestion_count has not advanced more than
+        ``stale_budget`` (the readahead depth) past its base — a batch one
+        pipeline step behind the freshest commits is exactly the staleness
+        the constant-liar treatment of pending trials already models, and
+        serving it is what keeps the consult off the inline path. A
+        fresher recompute (scheduled at every consult and completion)
+        replaces the entry as soon as it lands. Caller holds _lock."""
+        entry = self._buffer.get(name)
+        if (
+            entry is None
+            or not entry.assignments
+            or live_count - entry.base_count > max(stale_budget, 1)
+        ):
+            if entry is not None and entry.assignments:
+                self._buffer.pop(name, None)  # beyond the staleness budget
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "katib_suggestion_buffer_miss_total", experiment=name
+                )
+            return [], {}, False
+        taken = entry.assignments[:wanted]
+        entry.assignments = entry.assignments[len(taken):]
+        entry.base_count += len(taken)
+        feedback = dict(entry.algorithm_settings)
+        ended = entry.search_ended and not entry.assignments
+        if not entry.assignments:
+            self._buffer.pop(name, None)
+        if self.metrics is not None:
+            self.metrics.inc(
+                "katib_suggestion_buffer_ready_total",
+                value=float(len(taken)),
+                experiment=name,
+            )
+        return taken, feedback, ended
+
+    def notify_trials_changed(self, experiment_name: str) -> None:
+        """Scheduler hook: a trial reached a terminal condition, so the next
+        suggestion batch's history just changed — start precomputing it now,
+        before the reconcile loop gets around to asking."""
+        self._schedule_prefetch(experiment_name)
+
+    def _schedule_prefetch(self, name: str) -> None:
+        rt = self._runtime()
+        if rt is None or not getattr(rt, "async_suggest", False):
+            return
+        with self._lock:
+            if self._closed or name in self._prefetch_pending:
+                return
+            self._prefetch_pending.add(name)
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._prefetch_loop, daemon=True, name="suggestion-prefetch"
+                )
+                self._worker.start()
+        self._prefetch_queue.put(name)
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            name = self._prefetch_queue.get()
+            if name is None:
+                return
+            with self._lock:
+                self._prefetch_pending.discard(name)
+                if self._closed:
+                    return
+            try:
+                self._compute_prefetch(name)
+            except Exception:
+                log.debug("suggestion prefetch failed for %s", name, exc_info=True)
+
+    def _compute_prefetch(self, name: str) -> None:
+        """Compute the next batch from a fresh state snapshot and stage it.
+        Never mutates suggestion state: the consult path commits. A batch
+        whose base_count went stale while computing is simply never served."""
+        exp = self.state.get_experiment(name)
+        if exp is None or exp.status.is_completed or not self._async_for(exp):
+            with self._lock:
+                self._buffer.pop(name, None)
+            return
+        if self.search_ended(name):
+            return
+        trials = self.state.list_trials(name)
+        suggestion = self.state.get_suggestion(name)
+        base = suggestion.suggestion_count if suggestion is not None else 0
+        settings = dict(suggestion.algorithm_settings) if suggestion is not None else {}
+        _, requests = suggestion_request_plan(
+            exp,
+            trials,
+            lambda t: observation_available(t.observation, exp.spec.objective),
+        )
+        want = max(0, requests - base) + self._readahead(exp)
+        if want <= 0:
+            return
+        with self._lock:
+            entry = self._buffer.get(name)
+            if (
+                entry is not None
+                and entry.base_count >= base
+                and len(entry.assignments) >= want
+            ):
+                return  # a batch at least this fresh is already staged
+        filled = self._filled_spec(exp, settings)
+        request = SuggestionRequest(
+            experiment=filled,
+            trials=list(trials),
+            current_request_number=want,
+            total_request_number=max(requests, base + want),
+            warm_start=self._warm_start_for(exp),
+        )
+        t0 = time.perf_counter()
+        try:
+            reply = self.suggester_for(exp).get_suggestions(request)
+        except TrialsNotCompleted:
+            return
+        except Exception:
+            log.debug("prefetch compute failed for %s", name, exc_info=True)
+            return
+        self._observe_batch(exp, time.perf_counter() - t0, "prefetch")
+        with self._lock:
+            if self._closed:
+                return
+            current = self._buffer.get(name)
+            # never replace a fresher batch with an older compute (a
+            # consult-side refill can land after a later notify-side one)
+            if current is None or current.base_count <= base or not current.assignments:
+                self._buffer[name] = _BufferEntry(
+                    base_count=base,
+                    assignments=list(reply.assignments),
+                    algorithm_settings=dict(reply.algorithm_settings),
+                    search_ended=reply.search_ended,
+                )
+
+    # -- transfer HPO (warm start) -------------------------------------------
+
+    def _warm_start_for(self, exp: Experiment) -> Optional[WarmStartData]:
+        """Matching-history priors for this experiment, resolved once and
+        cached (None caches too — absence is an answer). Opt-in via
+        runtime.warm_start; failures degrade to no priors, never to a
+        failed suggestion."""
+        rt = self._runtime()
+        if rt is None or not getattr(rt, "warm_start", False):
+            return None
+        with self._lock:
+            if exp.name in self._warm:
+                return self._warm[exp.name]
+        data: Optional[WarmStartData] = None
+        try:
+            import numpy as np
+
+            from ..suggest.internal.search_space import SearchSpace
+
+            limit = int(getattr(rt, "warm_start_max_points", 256))
+            rows = self.obs_store.matching_history(
+                warm_start_signature(exp.spec),
+                exclude_experiment=exp.name,
+                limit=limit,
+            )
+            if rows:
+                space = SearchSpace.from_experiment(exp.spec)
+                xs = np.array([r.x for r in rows], dtype=np.float64)
+                ys = np.array([r.y for r in rows], dtype=np.float64)
+                if xs.ndim == 2 and xs.shape[1] == len(space):
+                    sources = sorted({r.experiment for r in rows})
+                    data = WarmStartData(xs=xs, ys=ys, source=",".join(sources))
+        except Exception:
+            log.debug("warm-start lookup failed for %s", exp.name, exc_info=True)
+        fresh = False
+        with self._lock:
+            if exp.name not in self._warm:
+                self._warm[exp.name] = data
+                fresh = True
+            data = self._warm[exp.name]
+        if fresh and data is not None:
+            if self.metrics is not None:
+                self.metrics.inc("katib_warm_start_total", experiment=exp.name)
+            if self.events is not None:
+                self.events.event(
+                    exp.name, "Experiment", exp.name, "WarmStartApplied",
+                    f"seeded priors from {len(data.ys)} completed observations "
+                    f"of matching experiments [{data.source}]",
+                )
+        return data
+
+    def index_completed_history(self, exp: Experiment) -> None:
+        """Write this experiment's completed observations into the
+        transfer-HPO index (db/store.py experiment_history) keyed by
+        warm-start signature, replacing any previous rows for the
+        experiment (idempotent across repeat completions/restarts).
+        Best-effort: an index failure must never fail completion."""
+        try:
+            from ..suggest.internal.search_space import SearchSpace
+            from ..suggest.internal.trial import completed_trials
+
+            space = SearchSpace.from_experiment(exp.spec)
+            points: List[Tuple[List[float], float]] = []
+            for t in completed_trials(
+                self.state.list_trials(exp.name), exp.spec.objective
+            ):
+                if t.objective is None:
+                    continue
+                x = space.encode(t.assignments)
+                points.append(([float(v) for v in x], float(t.objective)))
+            self.obs_store.replace_experiment_history(
+                exp.name, warm_start_signature(exp.spec), points
+            )
+        except Exception:
+            log.debug("history indexing failed for %s", exp.name, exc_info=True)
+
+    # -- settings plumbing ---------------------------------------------------
 
     def _apply_config_defaults(self, spec: ExperimentSpec) -> None:
         """KatibConfig defaultSettings fill unset algorithm settings
@@ -241,16 +677,32 @@ class SuggestionService:
         from ..api.spec import ResumePolicy
 
         if exp.spec.resume_policy in (ResumePolicy.NEVER, ResumePolicy.FROM_VOLUME):
-            self._suggesters.pop(exp.name, None)
-            self._early_stoppers.pop(exp.name, None)
+            with self._lock:
+                self._suggesters.pop(exp.name, None)
+                self._early_stoppers.pop(exp.name, None)
+        with self._lock:
+            self._buffer.pop(exp.name, None)
 
     def has_suggester(self, experiment_name: str) -> bool:
         """Whether the in-memory algorithm instance is alive (resume-policy
         lifecycle: LongRunning keeps it, Never/FromVolume tear it down)."""
-        return experiment_name in self._suggesters
+        with self._lock:
+            return experiment_name in self._suggesters
 
     def forget(self, experiment_name: str) -> None:
         """Drop all per-experiment state (experiment deletion)."""
-        self._suggesters.pop(experiment_name, None)
-        self._early_stoppers.pop(experiment_name, None)
-        self._search_ended.pop(experiment_name, None)
+        with self._lock:
+            self._suggesters.pop(experiment_name, None)
+            self._early_stoppers.pop(experiment_name, None)
+            self._search_ended.pop(experiment_name, None)
+            self._buffer.pop(experiment_name, None)
+            self._warm.pop(experiment_name, None)
+
+    def close(self) -> None:
+        """Stop the prefetch worker (if one ever started)."""
+        with self._lock:
+            self._closed = True
+            worker = self._worker
+        if worker is not None:
+            self._prefetch_queue.put(None)
+            worker.join(timeout=5.0)

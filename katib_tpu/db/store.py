@@ -54,6 +54,18 @@ log = logging.getLogger("katib_tpu.obslog")
 
 
 @dataclass
+class HistoryPoint:
+    """One completed observation in the transfer-HPO index (ISSUE 10):
+    the trial's unit-cube encoding and raw objective value, keyed in the
+    store by the owning experiment's search-space signature so future
+    experiments over the same space can warm-start from it."""
+
+    experiment: str
+    x: List[float]
+    y: float
+
+
+@dataclass
 class MetricLog:
     """One observation-log row: (timestamp, metric_name, value).
 
@@ -99,6 +111,35 @@ class ObservationStore:
     def delete_observation_log(self, trial_name: str) -> None:
         raise NotImplementedError
 
+    # -- transfer-HPO index (ISSUE 10) ---------------------------------------
+    # Completed experiments are indexed by search-space signature so a new
+    # experiment over a matching space can warm-start its suggester from
+    # history instead of a cold random phase. Default no-ops keep backends
+    # without an index (native engine, RPC remotes) valid.
+
+    def replace_experiment_history(
+        self,
+        experiment: str,
+        signature: str,
+        points: Sequence[Tuple[Sequence[float], float]],
+    ) -> None:
+        """Replace the experiment's indexed observations (idempotent across
+        repeat completions/restarts)."""
+
+    def matching_history(
+        self,
+        signature: str,
+        exclude_experiment: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[HistoryPoint]:
+        """Indexed observations of OTHER experiments with this signature,
+        deterministically ordered (stable across calls so warm-started
+        suggestions stay reproducible)."""
+        return []
+
+    def delete_experiment_history(self, experiment: str) -> None:
+        """Drop the experiment's indexed observations (experiment delete)."""
+
     def flush(self) -> None:
         """Durability barrier: returns once every previously-appended row is
         persisted in the backing store. No-op for synchronous stores."""
@@ -113,6 +154,9 @@ class InMemoryObservationStore(ObservationStore):
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._logs: Dict[str, List[MetricLog]] = {}
+        # experiment -> (signature, ordered points); insertion order is the
+        # stable "oldest-indexed first" order matching_history promises
+        self._history: Dict[str, Tuple[str, List[HistoryPoint]]] = {}
 
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
         with self._lock:
@@ -134,6 +178,28 @@ class InMemoryObservationStore(ObservationStore):
     def delete_observation_log(self, trial_name: str) -> None:
         with self._lock:
             self._logs.pop(trial_name, None)
+
+    def replace_experiment_history(self, experiment, signature, points) -> None:
+        rows = [
+            HistoryPoint(experiment=experiment, x=[float(v) for v in x], y=float(y))
+            for x, y in points
+        ]
+        with self._lock:
+            self._history[experiment] = (signature, rows)
+
+    def matching_history(self, signature, exclude_experiment=None, limit=None):
+        with self._lock:
+            out: List[HistoryPoint] = []
+            for exp in sorted(self._history):
+                sig, rows = self._history[exp]
+                if sig != signature or exp == exclude_experiment:
+                    continue
+                out.extend(rows)
+        return out[:limit] if limit is not None else out
+
+    def delete_experiment_history(self, experiment: str) -> None:
+        with self._lock:
+            self._history.pop(experiment, None)
 
 
 class SqliteObservationStore(ObservationStore):
@@ -164,6 +230,20 @@ class SqliteObservationStore(ObservationStore):
             self._conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_obs_trial_metric"
                 " ON observation_logs(trial_name, metric_name, time)"
+            )
+            # transfer-HPO index (ISSUE 10): completed observations keyed by
+            # search-space signature; x is the JSON unit-cube encoding
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS experiment_history ("
+                " experiment TEXT NOT NULL,"
+                " signature TEXT NOT NULL,"
+                " time REAL NOT NULL,"
+                " x TEXT NOT NULL,"
+                " y REAL NOT NULL)"
+            )
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_hist_signature"
+                " ON experiment_history(signature, time)"
             )
             self._conn.commit()
 
@@ -228,6 +308,52 @@ class SqliteObservationStore(ObservationStore):
     def delete_observation_log(self, trial_name: str) -> None:
         with self._lock:
             self._conn.execute("DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,))
+            self._conn.commit()
+
+    def replace_experiment_history(self, experiment, signature, points) -> None:
+        import json as _json
+
+        now = time.time()
+        rows = [
+            (experiment, signature, now, _json.dumps([float(v) for v in x]), float(y))
+            for x, y in points
+        ]
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM experiment_history WHERE experiment = ?", (experiment,)
+            )
+            if rows:
+                self._conn.executemany(
+                    "INSERT INTO experiment_history(experiment, signature, time, x, y)"
+                    " VALUES (?,?,?,?,?)",
+                    rows,
+                )
+            self._conn.commit()
+
+    def matching_history(self, signature, exclude_experiment=None, limit=None):
+        import json as _json
+
+        q = "SELECT experiment, x, y FROM experiment_history WHERE signature = ?"
+        args: List = [signature]
+        if exclude_experiment is not None:
+            q += " AND experiment != ?"
+            args.append(exclude_experiment)
+        q += " ORDER BY time ASC, rowid ASC"
+        if limit is not None:
+            q += " LIMIT ?"
+            args.append(int(limit))
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            HistoryPoint(experiment=r[0], x=[float(v) for v in _json.loads(r[1])], y=r[2])
+            for r in rows
+        ]
+
+    def delete_experiment_history(self, experiment: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM experiment_history WHERE experiment = ?", (experiment,)
+            )
             self._conn.commit()
 
     def close(self) -> None:
@@ -444,6 +570,20 @@ class BufferedObservationStore(ObservationStore):
                 # next folded() rescans — external writers stay visible
                 self._seeded.discard(trial_name)
             self.inner.delete_observation_log(trial_name)
+
+    def replace_experiment_history(self, experiment, signature, points) -> None:
+        # index writes are rare (one batch per completed experiment) and
+        # bypass the write-behind buffer: straight through to the backing
+        # store, like the schema they share
+        self.inner.replace_experiment_history(experiment, signature, points)
+
+    def matching_history(self, signature, exclude_experiment=None, limit=None):
+        return self.inner.matching_history(
+            signature, exclude_experiment=exclude_experiment, limit=limit
+        )
+
+    def delete_experiment_history(self, experiment: str) -> None:
+        self.inner.delete_experiment_history(experiment)
 
     def flush(self) -> None:
         """Block until every row appended before this call is durable."""

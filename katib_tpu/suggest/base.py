@@ -24,6 +24,20 @@ from .internal.trial import ObservedTrial, completed_trials
 
 
 @dataclass
+class WarmStartData:
+    """Cross-experiment transfer priors (ISSUE 10): unit-cube encodings and
+    raw objective values of completed trials from experiments whose search
+    space + objective signature matches this one (db/store.py
+    ``matching_history``). Consumed as pseudo-history by TPE/BO and as the
+    initial-mean anchor by CMA-ES; ``None`` (the default) is byte-identical
+    to the pre-warm-start behavior."""
+
+    xs: "object"  # np.ndarray [n, D]
+    ys: "object"  # np.ndarray [n]
+    source: str = ""  # provenance summary for events/logs
+
+
+@dataclass
 class SuggestionRequest:
     """Mirror of api.proto GetSuggestionsRequest:297-303."""
 
@@ -31,6 +45,9 @@ class SuggestionRequest:
     trials: List[Trial]
     current_request_number: int
     total_request_number: int = 0
+    # opt-in transfer-HPO priors (runtime.warm_start); algorithms that do
+    # not understand them ignore the field
+    warm_start: Optional[WarmStartData] = None
 
 
 @dataclass
@@ -70,6 +87,28 @@ class Suggester(abc.ABC):
     @staticmethod
     def history(request: SuggestionRequest) -> List[ObservedTrial]:
         return completed_trials(request.trials, request.experiment.objective)
+
+    @staticmethod
+    def warm_history_arrays(request: SuggestionRequest, space: SearchSpace):
+        """(history, xs, ys, n_warm): the completed history encoded to the
+        unit cube, with the request's warm-start rows (if any) prepended as
+        pseudo-observations. ``n_warm == 0`` reproduces the legacy arrays
+        byte-identically; with warm rows the startup gates (n_startup /
+        n_initial_points) count them, which is the transfer-HPO point —
+        a matching completed experiment skips the random phase."""
+        import numpy as np
+
+        history = [t for t in Suggester.history(request) if t.objective is not None]
+        xs = space.encode_many([t.assignments for t in history])
+        ys = np.array([t.objective for t in history], dtype=np.float64)
+        w = request.warm_start
+        if w is None or len(w.xs) == 0:
+            return history, xs, ys, 0
+        wxs = np.asarray(w.xs, dtype=np.float64).reshape(len(w.ys), len(space))
+        wys = np.asarray(w.ys, dtype=np.float64)
+        xs = np.vstack([wxs, xs]) if len(xs) else wxs.copy()
+        ys = np.concatenate([wys, ys])
+        return history, xs, ys, len(wys)
 
     @staticmethod
     def make_trial_name(experiment: ExperimentSpec) -> str:

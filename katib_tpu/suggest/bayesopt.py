@@ -37,6 +37,7 @@ import numpy as np
 from scipy.linalg import cho_factor, cho_solve
 from scipy.stats import norm
 
+from . import vectorized
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
 from ..api.spec import TrialAssignment
 from .internal.search_space import MIN_GOAL
@@ -140,27 +141,29 @@ class BayesianOptimization(Suggester):
         rng = np.random.default_rng(seed)
         minimize = space.goal == MIN_GOAL
 
-        history = [t for t in self.history(request) if t.objective is not None]
-        xs = space.encode_many([t.assignments for t in history])
+        history, xs, ys, n_warm = self.warm_history_arrays(request, space)
         # Internally always minimize (negate for maximize), like skopt.
-        ys = np.array([t.objective for t in history], dtype=np.float64)
         if not minimize:
             ys = -ys
-        acq_labels = [t.labels.get(ACQ_LABEL) for t in history]
+        acq_labels = [None] * n_warm + [t.labels.get(ACQ_LABEL) for t in history]
 
         n_real = len(ys)
 
         # Select kernel hyperparameters once per call, on the real history —
         # liar rows barely move the marginal-likelihood optimum, and re-running
         # the 18-point grid for every batch pick would put 18 O(n^3) fits per
-        # suggestion on the hot path.
+        # suggestion on the hot path. The vectorized plane collapses the grid
+        # to ONE vmapped Cholesky batch (suggest/vectorized.py bo_mle); the
+        # sequential scipy fit stays the oracle and the fallback.
         hypers: Optional[Tuple[float, float]] = None
         gp_real: Optional[_GP] = None
         if fixed_length is not None:
             hypers = (fixed_length, 1e-6)
         elif n_real >= n_initial:
-            gp_real = _GP.fit_mle(xs, ys)
-            hypers = (gp_real.length, gp_real.noise)
+            hypers = vectorized.bo_mle(xs, ys, _LENGTH_GRID, _NOISE_GRID)
+            if hypers is None:
+                gp_real = _GP.fit_mle(xs, ys)
+                hypers = (gp_real.length, gp_real.noise)
 
         # Hedge gains come from the pre-batch, real-history-only GP: the
         # constant-liar rows appended below (y = worst seen) would otherwise
@@ -173,8 +176,25 @@ class BayesianOptimization(Suggester):
                 gp_real = _GP(xs, ys, length=hypers[0], noise=hypers[1])
             gains = self.hedge_gains(gp_real, xs, acq_labels)
 
+        batch = request.current_request_number
+        if n_real >= n_initial and hypers is not None and batch > 0:
+            vec = self._acquire_batch(xs, ys, space, rng, acq, hypers, gains, batch)
+            if vec is not None:
+                us, chosen_labels = vec
+                return SuggestionReply(
+                    assignments=[
+                        TrialAssignment(
+                            name=self.make_trial_name(request.experiment),
+                            parameter_assignments=space.decode(u),
+                            labels={ACQ_LABEL: label} if label else {},
+                        )
+                        for u, label in zip(us, chosen_labels)
+                    ]
+                )
+
+        # Legacy NumPy/scipy path — the parity oracle.
         assignments: List[TrialAssignment] = []
-        for _ in range(request.current_request_number):
+        for _ in range(batch):
             labels: Dict[str, str] = {}
             if len(ys) < n_initial:
                 u = space.sample_uniform(rng, 1)[0]
@@ -193,6 +213,69 @@ class BayesianOptimization(Suggester):
                 )
             )
         return SuggestionReply(assignments=assignments)
+
+    def _acquire_batch(
+        self,
+        xs: np.ndarray,
+        ys: np.ndarray,
+        space,
+        rng,
+        acq: str,
+        hypers: Tuple[float, float],
+        gains: Optional[np.ndarray],
+        batch: int,
+    ) -> Optional[Tuple[np.ndarray, List[Optional[str]]]]:
+        """Whole-batch acquisition through the jitted scan
+        (suggest/vectorized.py bo_batch). Every rng draw is made here on the
+        host in the legacy per-pick order — uniform candidates, local
+        jitter, then (gp_hedge) the member choice — so the scan reproduces
+        the oracle's selections. Returns None outside the parity-exact fast
+        path: vectorization off, fewer than 6 observations (the legacy
+        local-exploitation set would mix liar rows in), or duplicate values
+        among the best objectives (the unstable argsort tie-order would not
+        be reproducible from the un-augmented history)."""
+        if not vectorized.use_vectorized():
+            return None
+        n_real = len(ys)
+        if n_real < 6:
+            return None
+        order = np.argsort(ys)
+        head = ys[order[:6]]
+        if len(np.unique(head)) < len(head):
+            return None  # tie-order among best points is not reproducible
+        d = len(space)
+        n_cand = max(512, 64 * d)
+        best_k = xs[order[:5]]
+        probs = None
+        if acq == "gp_hedge":
+            g = gains if gains is not None else np.zeros(len(PORTFOLIO))
+            logits = g - g.max()
+            probs = np.exp(logits) / np.exp(logits).sum()
+        cands = np.empty((batch, n_cand + len(best_k) * 20, d), dtype=np.float64)
+        member_idx = np.zeros(batch, dtype=np.int64)
+        for i in range(batch):
+            uniform = space.sample_uniform(rng, n_cand)
+            local = np.clip(
+                np.repeat(best_k, 20, axis=0)
+                + rng.normal(0, 0.02, (len(best_k) * 20, d)),
+                0.0,
+                1.0 - 1e-9,
+            )
+            cands[i] = np.vstack([uniform, local])
+            if acq == "gp_hedge":
+                member_idx[i] = int(rng.choice(len(PORTFOLIO), p=probs))
+        us = vectorized.bo_batch(
+            xs, ys, cands,
+            member_idx if acq == "gp_hedge" else None,
+            acq, hypers[0], hypers[1],
+        )
+        if us is None:
+            return None
+        if acq == "gp_hedge":
+            chosen: List[Optional[str]] = [PORTFOLIO[j] for j in member_idx]
+        else:
+            chosen = [acq] * batch
+        return us, chosen
 
     @staticmethod
     def hedge_gains(gp: "_GP", xs: np.ndarray, acq_labels: List[Optional[str]]) -> np.ndarray:

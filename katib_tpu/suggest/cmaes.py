@@ -40,6 +40,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import vectorized
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
 from ..api.spec import TrialAssignment
 from .internal.search_space import MIN_GOAL, SearchSpace
@@ -65,10 +66,19 @@ class _CmaState:
     p_sigma: np.ndarray
     p_c: np.ndarray
     generation: int = 0
+    # eigendecomposition of C, refreshed whenever C changes (ISSUE 10
+    # satellite): update() consumed one eigh for C^{-1/2} and sample()
+    # immediately recomputed the same factorization — caching (B, D) at the
+    # point C is assigned halves the eigh count to exactly one per
+    # generation, with byte-identical factors (same matrix, same LAPACK
+    # routine) for both consumers.
+    eig_B: Optional[np.ndarray] = None
+    eig_D: Optional[np.ndarray] = None         # sqrt(clamped eigenvalues)
+    eig_inv_sqrt: Optional[np.ndarray] = None  # C^{-1/2}
 
     @classmethod
     def fresh(cls, dim: int, popsize: int, sigma0: float) -> "_CmaState":
-        return cls(
+        state = cls(
             dim=dim,
             popsize=popsize,
             sigma=sigma0,
@@ -77,6 +87,18 @@ class _CmaState:
             p_sigma=np.zeros(dim),
             p_c=np.zeros(dim),
         )
+        state.refresh_eigen()
+        return state
+
+    def refresh_eigen(self) -> None:
+        """One np.linalg.eigh per covariance assignment; the cached factors
+        serve both the next update's C^{-1/2} and every sample() until C
+        changes again."""
+        eigval, eigvec = np.linalg.eigh(self.C)
+        eigval = np.maximum(eigval, 1e-20)
+        self.eig_B = eigvec
+        self.eig_D = np.sqrt(eigval)
+        self.eig_inv_sqrt = eigvec @ np.diag(eigval**-0.5) @ eigvec.T
 
     # strategy constants
     @property
@@ -111,10 +133,10 @@ class _CmaState:
         y_w = (w[:, None] * ys).sum(axis=0)
         self.mean = old_mean + self.sigma * y_w
 
-        # C^{-1/2} via symmetric eigendecomposition
-        eigval, eigvec = np.linalg.eigh(self.C)
-        eigval = np.maximum(eigval, 1e-20)
-        inv_sqrt = eigvec @ np.diag(eigval**-0.5) @ eigvec.T
+        # C^{-1/2} from the cached eigendecomposition — refresh_eigen ran
+        # when this C was assigned, so the factors are the same bytes the
+        # old inline eigh produced here
+        inv_sqrt = self.eig_inv_sqrt
 
         self.p_sigma = (1 - c_sigma) * self.p_sigma + math.sqrt(
             c_sigma * (2 - c_sigma) * mu_eff
@@ -137,11 +159,10 @@ class _CmaState:
         self.sigma *= math.exp((c_sigma / d_sigma) * (ps_norm / chi_n - 1))
         self.sigma = float(np.clip(self.sigma, 1e-8, 1e4))
         self.generation += 1
+        self.refresh_eigen()
 
     def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
-        eigval, eigvec = np.linalg.eigh(self.C)
-        eigval = np.maximum(eigval, 1e-20)
-        B, Dm = eigvec, np.sqrt(eigval)
+        B, Dm = self.eig_B, self.eig_D
         z = rng.standard_normal((n, self.dim))
         xs = self.mean[None, :] + self.sigma * (z * Dm[None, :]) @ B.T
         return np.clip(xs, 0.0, 1.0 - 1e-9)
@@ -178,6 +199,15 @@ class CMAES(Suggester):
 
         popsize = popsize0
         state = _CmaState.fresh(dim, popsize, sigma0)
+        # Transfer HPO (ISSUE 10, runtime.warm_start): anchor the fresh
+        # strategy mean at the best matching point from completed
+        # experiments instead of the mid-cube default. Only the initial
+        # state — replayed folds and restart means are untouched, so the
+        # replay stays deterministic.
+        warm = request.warm_start
+        if warm is not None and len(warm.ys):
+            best = int(np.argmin(warm.ys) if minimize else np.argmax(warm.ys))
+            state.mean = np.asarray(warm.xs, dtype=np.float64)[best].copy()
 
         # Replay completed generations in order.
         by_gen: Dict[int, List] = {}
@@ -227,6 +257,16 @@ class CMAES(Suggester):
             gen_best = []
 
         gen = 0
+        if strategy == "none":
+            # Vectorized fast path (suggest/vectorized.py): fold EVERY
+            # completed generation in one compiled lax.scan instead of G
+            # Python updates. Restart strategies stay on the legacy loop —
+            # their fold condition depends on the evolving popsize. On
+            # success the legacy loop below starts past the folded prefix
+            # and immediately finds nothing more to fold.
+            gen = self._vectorized_replay(
+                state, space, minimize, created_by_gen, terminal_by_gen, by_gen
+            )
         while True:
             created = created_by_gen.get(gen, 0)
             done = by_gen.get(gen, [])
@@ -282,6 +322,60 @@ class CMAES(Suggester):
                 "cmaes_restarts": str(restarts),
             },
         )
+
+    @staticmethod
+    def _vectorized_replay(
+        state: _CmaState,
+        space: SearchSpace,
+        minimize: bool,
+        created_by_gen: Dict[int, int],
+        terminal_by_gen: Dict[int, int],
+        by_gen: Dict[int, List],
+    ) -> int:
+        """Fold the complete-generation prefix through the compiled scan;
+        mutates ``state`` and returns the number of generations folded (0 =
+        nothing foldable or vectorization unavailable — the caller's legacy
+        loop then does the whole fold). The fold-ability condition is the
+        same as the legacy loop's and, for restart_strategy=none, is
+        independent of the strategy state, which is what makes the prefix
+        collectable up front."""
+        if not vectorized.use_vectorized():
+            return 0
+        popsize = state.popsize
+        folded: List = []
+        gen = 0
+        while True:
+            created = created_by_gen.get(gen, 0)
+            if created < popsize or terminal_by_gen.get(gen, 0) < created:
+                break
+            done = by_gen.get(gen, [])
+            if done:
+                xs = space.encode_many([t.assignments for t in done])
+                ys = np.array([t.objective for t in done], dtype=np.float64)
+                if not minimize:
+                    ys = -ys
+                folded.append((xs, ys))
+            else:
+                folded.append(
+                    (np.zeros((0, state.dim)), np.zeros(0, dtype=np.float64))
+                )
+            gen += 1
+        if not folded:
+            return 0
+        replay = vectorized.cma_replay(
+            folded, state.dim, popsize, state.sigma, state.mean
+        )
+        if replay is None:
+            return 0
+        mean, sigma, C, p_sigma, p_c = replay
+        state.mean = mean
+        state.sigma = float(sigma)
+        state.C = C
+        state.p_sigma = p_sigma
+        state.p_c = p_c
+        state.generation = len(folded)
+        state.refresh_eigen()
+        return len(folded)
 
     @classmethod
     def restart_seed(cls, experiment, restarts: int) -> int:

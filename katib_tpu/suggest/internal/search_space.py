@@ -92,6 +92,30 @@ class HyperParameter:
         n = max(self.num_choices, 1)
         return (idx + 0.5) / n
 
+    def to_unit_many(self, values: Sequence[str]) -> np.ndarray:
+        """Column-vectorized to_unit: one array op over all assignments of
+        this axis instead of a per-row Python dispatch (the encode_many hot
+        path — every suggester call re-encodes the full history). The
+        per-element scalar math is kept identical (math.log, the same
+        clamp order) so the result is bit-for-bit to_unit's."""
+        if self.is_numeric:
+            lo, hi = self.min, self.max
+            if self.is_log:
+                lo, hi = math.log(lo), math.log(hi)
+                v = np.array(
+                    [math.log(max(float(x), 1e-300)) for x in values],
+                    dtype=np.float64,
+                )
+            else:
+                v = np.array([float(x) for x in values], dtype=np.float64)
+            if hi <= lo:
+                return np.zeros(len(v), dtype=np.float64)
+            return np.minimum(np.maximum((v - lo) / (hi - lo), 0.0), 1.0)
+        n = max(self.num_choices, 1)
+        lookup = {c: i for i, c in enumerate(self.choices)}
+        idx = np.array([lookup.get(x, 0) for x in values], dtype=np.float64)
+        return (idx + 0.5) / n
+
     def from_unit(self, u: float) -> str:
         """Map u in [0,1) back to an assignment string."""
         u = min(max(float(u), 0.0), 1.0 - 1e-12)
@@ -171,6 +195,20 @@ class SearchSpace:
     def encode_many(self, assignment_dicts: Sequence[Dict[str, str]]) -> np.ndarray:
         if not assignment_dicts:
             return np.zeros((0, len(self.params)), dtype=np.float64)
+        from .. import vectorized
+
+        if vectorized.enabled():
+            # column-major: one vectorized transform per parameter axis
+            # rather than len(dicts) Python encode() calls — part of the
+            # vectorized suggestion plane (bit-identical outputs, asserted
+            # by tests/test_suggest_vectorized.py), gated with it so
+            # KATIB_TPU_VECTOR_SUGGEST=0 restores the legacy encode loop
+            # byte for byte
+            cols = [
+                p.to_unit_many([a[p.name] for a in assignment_dicts])
+                for p in self.params
+            ]
+            return np.stack(cols, axis=1)
         return np.stack([self.encode(a) for a in assignment_dicts])
 
     def decode(self, u: np.ndarray) -> List[ParameterAssignment]:

@@ -26,6 +26,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from . import vectorized
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
 from ..api.spec import TrialAssignment
 from .internal.search_space import MIN_GOAL, SearchSpace
@@ -100,18 +101,49 @@ class TPE(Suggester):
         seed = self.seed_from(request.experiment, salt=len(request.trials))
         rng = np.random.default_rng(seed)
 
-        history = [t for t in self.history(request) if t.objective is not None]
         minimize = space.goal == MIN_GOAL
+        _, xs, ys, _n_warm = self.warm_history_arrays(request, space)
+        n_obs = len(ys)  # observed + warm-start pseudo-observations
+        batch = request.current_request_number
+
+        if n_obs >= n_startup and batch > 0 and vectorized.use_vectorized():
+            # vectorized fast path (suggest/vectorized.py): the whole batch
+            # — candidate KDE scoring AND the constant-liar feedback — as
+            # one jitted scan; None = outside the parity-exact path, run
+            # the NumPy oracle below
+            us = vectorized.tpe_batch(
+                xs, ys, minimize, gamma, n_candidates, batch, rng,
+                self.multivariate,
+            )
+            if us is not None:
+                return SuggestionReply(
+                    assignments=[
+                        TrialAssignment(
+                            name=self.make_trial_name(request.experiment),
+                            parameter_assignments=space.decode(u),
+                        )
+                        for u in us
+                    ]
+                )
+
+        # Legacy NumPy path — the parity oracle. The liar buffers are
+        # preallocated once per call: the old per-pick np.vstack/np.append
+        # rebuilt O(n) arrays inside the batch loop (quadratic in the batch).
+        d = len(space)
+        xs_buf = np.empty((n_obs + batch, d), dtype=np.float64)
+        ys_buf = np.empty(n_obs + batch, dtype=np.float64)
+        xs_buf[:n_obs] = xs.reshape(n_obs, d)
+        ys_buf[:n_obs] = ys
+        n_aug = n_obs
 
         assignments: List[TrialAssignment] = []
-        xs = space.encode_many([t.assignments for t in history])
-        ys = np.array([t.objective for t in history], dtype=np.float64)
-
-        for _ in range(request.current_request_number):
-            if len(history) < n_startup:
+        for _ in range(batch):
+            if n_obs < n_startup:
                 u = space.sample_uniform(rng, 1)[0]
             else:
-                u = self._tpe_point(xs, ys, space, rng, gamma, n_candidates)
+                u = self._tpe_point(
+                    xs_buf[:n_aug], ys_buf[:n_aug], space, rng, gamma, n_candidates
+                )
             assignments.append(
                 TrialAssignment(
                     name=self.make_trial_name(request.experiment),
@@ -121,10 +153,11 @@ class TPE(Suggester):
             # Parallel-suggestion diversity: treat the freshly proposed point as
             # a pseudo-observation at the current worst objective (the
             # "constant liar" strategy) so a batch of suggestions spreads out.
-            if len(history) >= n_startup and len(ys):
-                lie = ys.max() if minimize else ys.min()
-                xs = np.vstack([xs, u[None, :]])
-                ys = np.append(ys, lie)
+            if n_obs >= n_startup and n_aug:
+                lie = ys_buf[:n_aug].max() if minimize else ys_buf[:n_aug].min()
+                xs_buf[n_aug] = u
+                ys_buf[n_aug] = lie
+                n_aug += 1
 
         return SuggestionReply(assignments=assignments)
 

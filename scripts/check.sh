@@ -32,12 +32,21 @@ echo "== fused population smoke (lax.scan PBT sweep vs job-queue driver) =="
 JAX_PLATFORMS=cpu python bench.py pbt_fused_throughput --smoke
 
 echo
+echo "== vectorized suggestion smoke (batched jitted kernels vs NumPy oracle) =="
+JAX_PLATFORMS=cpu python bench.py suggestion_throughput --smoke
+
+echo
+echo "== async suggestion pipeline smoke (prefetch buffer vs inline) =="
+JAX_PLATFORMS=cpu python bench.py suggestion_pipeline_latency --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \
     "tests/test_telemetry.py::TestSampler::test_lock_order_under_concurrent_register_sample_scrape" \
     tests/test_obslog_pipeline.py::test_read_your_writes_under_concurrent_writers \
     tests/test_compilesvc.py::test_lockgraph_stress_with_worker_pool_active \
+    "tests/test_suggest_vectorized.py::TestAsyncPipeline::test_concurrent_sync_no_duplicates_no_losses" \
     tests/test_static_analysis.py
 
 echo

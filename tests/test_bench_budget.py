@@ -641,6 +641,40 @@ def test_pbt_fused_throughput_smoke_wiring(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_suggestion_throughput_smoke_parity(bench):
+    """--smoke mode of the suggestion_throughput scenario (ISSUE 10): the
+    batched jitted TPE / CMA-ES / BO kernels and the legacy NumPy
+    suggesters run on identical seeded histories and the vectorized
+    selections must match the oracle within fp tolerance. No speed ratio
+    assertion in smoke — trimmed kernels are dominated by dispatch
+    overhead; the timed run reports measured speedups + target verdicts
+    (the >=5x target assumes an accelerator backend — see the scenario
+    docstring and docs/suggestion-plane.md)."""
+    out = bench._bench_suggestion_throughput(smoke=True)
+    assert out["smoke"] is True
+    assert out["parity_exact"] is True
+    assert set(out["algos"]) == {"tpe", "cmaes", "bayesianoptimization"}
+    for algo, rec in out["algos"].items():
+        assert rec["parity_err"] < 1e-6, (algo, rec)
+        assert rec["legacy_cands_per_s"] > 0 and rec["vectorized_cands_per_s"] > 0
+    assert out["target_speedup"] == 5.0
+
+
+def test_suggestion_pipeline_latency_smoke_integrity(bench):
+    """--smoke mode of the suggestion_pipeline_latency scenario (ISSUE
+    10): inline and async sweeps both complete with zero duplicate or lost
+    assignments. The >=3x span-ratio assertion belongs to the timed run
+    (trimmed sweeps are scheduler noise); smoke pins the wiring and the
+    integrity invariant."""
+    out = bench._bench_suggestion_pipeline_latency(smoke=True)
+    assert out["smoke"] is True
+    assert out["trials"] == 8
+    assert out["inline_mean_span_ms"] > 0
+    assert out["async_mean_span_ms"] > 0
+    assert out["target_ratio"] == 3.0
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -281,6 +281,49 @@ class TestFusedControllerPath:
         finally:
             c.close()
 
+    def test_batch_submit_atomic_against_concurrent_dispatch(
+        self, tmp_path, monkeypatch
+    ):
+        """Regression (ISSUE 10): a dispatch pass racing the fused batch
+        submission — e.g. the admission-prewarmed scan program turning warm
+        in the compile service between two member submits — used to see a
+        PARTIAL population and split the sweep into two packs, each
+        fragment then running a FULL independent sweep (doubled population
+        best/median rows, wrong truncation pools). The scheduler's
+        dispatch_barrier makes the submission atomic: a mid-submit dispatch
+        is deferred to the barrier exit. The race is forced
+        deterministically here by dispatching after the second submit."""
+        from katib_tpu.controller.scheduler import TrialScheduler
+
+        c = ExperimentController(root_dir=str(tmp_path), devices=list(range(4)))
+        try:
+            real_submit = TrialScheduler.submit
+            seen = {"n": 0}
+
+            def racing_submit(sched, exp, trial, **kw):
+                real_submit(sched, exp, trial, **kw)
+                seen["n"] += 1
+                if seen["n"] == 2:
+                    sched.dispatch()  # the racing pass: must not split the batch
+
+            monkeypatch.setattr(TrialScheduler, "submit", racing_submit)
+            spec = _pbt_spec("pf-race", generations=4, population=5)
+            c.create_experiment(spec)
+            exp = c.run("pf-race", timeout=180)
+            assert exp.status.is_succeeded, exp.status.message
+            packs = [
+                e for e in c.events.list("pf-race") if e.reason == "PackFormed"
+            ]
+            assert len(packs) == 1, [p.message for p in packs]
+            assert "5/5" in packs[0].message
+            # one sweep's worth of rows, not one per fragment
+            poplog = c.obs_store.get_observation_log("pf-race-population")
+            assert len(poplog) == 2 * 4
+            for t in c.state.list_trials("pf-race"):
+                assert len(c.obs_store.get_observation_log(t.name)) == 4
+        finally:
+            c.close()
+
     def test_sweep_compiles_exactly_once_in_service(self, tmp_path):
         """Satellite 1 acceptance: with the population/abstract probes
         shipped, the compile service prewarms the fused scan program at

@@ -1,0 +1,596 @@
+"""Vectorized JAX suggestion plane (ISSUE 10).
+
+Three contracts under test:
+
+1. **Parity** — the batched jitted TPE / CMA-ES / BO kernels
+   (katib_tpu/suggest/vectorized.py) must reproduce the legacy NumPy
+   oracle's selections for the same seed and history (property tests over
+   randomized spaces/histories), and ``KATIB_TPU_VECTOR_SUGGEST=0`` must
+   restore the legacy path (vectorized kernels never invoked,
+   deterministic byte-identical replays).
+2. **Async pipeline** — the SuggestionService prefetch buffer serves each
+   precomputed assignment exactly once: no duplicate and no lost
+   assignments under concurrent ``sync_assignments``
+   (lockgraph-instrumented), inline fallback on a cold buffer.
+3. **Warm start** — completed experiments index into
+   db/store.py ``experiment_history`` by search-space signature and a new
+   matching experiment receives them as priors (WarmStartApplied emitted
+   once, CMA-ES mean anchored, TPE/BO startup skipped).
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    Metric,
+    Observation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialCondition,
+    TrialTemplate,
+)
+from katib_tpu.suggest import vectorized
+from katib_tpu.suggest.base import SuggestionRequest, WarmStartData, create
+
+
+@pytest.fixture(autouse=True)
+def _vectorized_on():
+    """Every test starts from the enabled state and leaves it enabled."""
+    vectorized.set_enabled(True)
+    yield
+    vectorized.set_enabled(True)
+
+
+def make_spec(algo, settings=None, dim=3, goal=ObjectiveType.MAXIMIZE, name="vec-test"):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec(
+                f"x{i}", ParameterType.DOUBLE, FeasibleSpace(min="0.0", max="1.0")
+            )
+            for i in range(dim)
+        ],
+        objective=ObjectiveSpec(type=goal, objective_metric_name="metric"),
+        algorithm=AlgorithmSpec(
+            algo,
+            algorithm_settings=[
+                AlgorithmSetting(k, str(v)) for k, v in (settings or {}).items()
+            ],
+        ),
+        trial_template=TrialTemplate(function=lambda a, c: None),
+        max_trial_count=10000,
+        parallel_trial_count=8,
+    )
+
+
+def completed(name, assignments, value, labels=None, experiment="vec-test"):
+    t = Trial(
+        name=name,
+        experiment_name=experiment,
+        parameter_assignments=[
+            ParameterAssignment(k, str(v)) for k, v in assignments.items()
+        ],
+        labels=labels or {},
+    )
+    t.observation = Observation(
+        metrics=[Metric(name="metric", min=str(value), max=str(value), latest=str(value))]
+    )
+    t.condition = TrialCondition.SUCCEEDED
+    t.start_time = 1.0
+    return t
+
+
+def make_history(n, dim, seed=0, labels_fn=None):
+    r = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        a = {f"x{j}": round(float(r.random()), 8) for j in range(dim)}
+        v = round(float(-sum((x - 0.35) ** 2 for x in a.values()) + r.normal(0, 0.01)), 8)
+        out.append(completed(f"t{i:03d}", a, v, labels_fn(i) if labels_fn else None))
+    return out
+
+
+def decode_values(assignments):
+    return np.array(
+        [[float(v) for _, v in sorted(a.assignments_dict().items())] for a in assignments]
+    )
+
+
+def run_both(algo, settings, trials, batch, dim=3, goal=ObjectiveType.MAXIMIZE):
+    spec = make_spec(algo, settings, dim=dim, goal=goal)
+    request = SuggestionRequest(
+        experiment=spec, trials=trials, current_request_number=batch
+    )
+    suggester = create(algo)
+    vectorized.set_enabled(False)
+    legacy = suggester.get_suggestions(request).assignments
+    vectorized.set_enabled(True)
+    vec = suggester.get_suggestions(request).assignments
+    return decode_values(legacy), decode_values(vec), legacy, vec
+
+
+class TestEncodeParity:
+    def test_encode_many_bit_identical(self):
+        from katib_tpu.suggest.internal.search_space import SearchSpace
+        from katib_tpu.api import Distribution
+
+        spec = ExperimentSpec(
+            name="enc",
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE,
+                              FeasibleSpace(min="1e-5", max="1.0",
+                                            distribution=Distribution.LOG_UNIFORM)),
+                ParameterSpec("units", ParameterType.INT, FeasibleSpace(min="4", max="128")),
+                ParameterSpec("opt", ParameterType.CATEGORICAL,
+                              FeasibleSpace(list=["sgd", "adam", "rmsprop"])),
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="-2.0", max="3.0")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="m"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=TrialTemplate(function=lambda a, c: None),
+        )
+        space = SearchSpace.from_experiment(spec)
+        r = np.random.default_rng(3)
+        dicts = [
+            {
+                "lr": str(10 ** float(r.uniform(-5, 0))),
+                "units": str(int(r.integers(4, 129))),
+                "opt": ["sgd", "adam", "rmsprop", "bogus"][int(r.integers(0, 4))],
+                "x": str(float(r.uniform(-2, 3))),
+            }
+            for _ in range(40)
+        ]
+        vectorized.set_enabled(True)
+        fast = space.encode_many(dicts)
+        vectorized.set_enabled(False)
+        legacy = space.encode_many(dicts)
+        # bit-identical, not just close: the column path must keep the
+        # exact scalar ops of to_unit (KATIB_TPU_VECTOR_SUGGEST=0 claims
+        # byte-identical legacy suggestions)
+        assert fast.tobytes() == legacy.tobytes()
+
+
+class TestTpeParity:
+    @pytest.mark.parametrize("algo", ["tpe", "multivariate-tpe"])
+    @pytest.mark.parametrize("goal", [ObjectiveType.MAXIMIZE, ObjectiveType.MINIMIZE])
+    def test_selections_match_oracle(self, algo, goal):
+        for seed in (0, 7):
+            trials = make_history(28, dim=3, seed=seed)
+            legacy, vec, _, _ = run_both(
+                algo, {"random_state": 5, "n_startup_trials": 10}, trials, 5, goal=goal
+            )
+            assert legacy.shape == vec.shape == (5, 3)
+            np.testing.assert_allclose(vec, legacy, atol=1e-9)
+
+    def test_knob_off_restores_legacy_and_never_calls_kernels(self, monkeypatch):
+        calls = []
+        real = vectorized.tpe_batch
+        monkeypatch.setattr(
+            vectorized, "tpe_batch", lambda *a, **k: calls.append(1) or real(*a, **k)
+        )
+        trials = make_history(20, dim=3, seed=1)
+        spec = make_spec("tpe", {"random_state": 5})
+        request = SuggestionRequest(experiment=spec, trials=trials, current_request_number=4)
+        s = create("tpe")
+        vectorized.set_enabled(False)
+        first = decode_values(s.get_suggestions(request).assignments)
+        second = decode_values(s.get_suggestions(request).assignments)
+        assert not calls  # legacy path never touches the vectorized module
+        # same seed, same history -> byte-identical legacy replay
+        assert first.tobytes() == second.tobytes()
+        vectorized.set_enabled(True)
+        s.get_suggestions(request)
+        assert calls  # and the knob actually gates the kernel
+
+    def test_declines_outside_fast_path(self):
+        # a batch so large the liar rows would cross into the good set:
+        # the kernel must hand the call back to the legacy loop
+        xs = np.random.default_rng(0).random((4, 3))
+        ys = np.arange(4.0)
+        rng = np.random.default_rng(0)
+        out = vectorized.tpe_batch(xs, ys, True, 0.25, 8, 40, rng, False)
+        assert out is None
+
+    def test_env_flag_controls_default(self, monkeypatch):
+        monkeypatch.setattr(vectorized, "_ENABLED", None)
+        monkeypatch.setenv(vectorized.ENV_FLAG, "0")
+        assert not vectorized.enabled()
+        monkeypatch.setenv(vectorized.ENV_FLAG, "1")
+        assert vectorized.enabled()
+
+
+class TestCmaesParity:
+    SETTINGS = {"random_state": 5, "popsize": 6}
+
+    @staticmethod
+    def gen_history(gens, popsize=6, dim=4, seed=3):
+        r = np.random.default_rng(seed)
+        out = []
+        for g in range(gens):
+            for mi in range(popsize):
+                a = {f"x{j}": round(float(r.random()), 8) for j in range(dim)}
+                v = round(float(-sum((x - 0.4) ** 2 for x in a.values())), 8)
+                out.append(
+                    completed(f"g{g}m{mi}", a, v, {"cmaes-generation": str(g)})
+                )
+        return out
+
+    def test_replay_matches_oracle(self):
+        for gens in (1, 4):
+            trials = self.gen_history(gens)
+            legacy, vec, _, _ = run_both("cmaes", self.SETTINGS, trials, 6, dim=4)
+            np.testing.assert_allclose(vec, legacy, atol=1e-8)
+
+    def test_one_eigh_per_generation(self, monkeypatch):
+        """ISSUE 10 satellite: update() used to eigendecompose C and
+        sample() immediately re-decomposed the same matrix — the cache must
+        leave exactly one eigh per generation plus the fresh-state one,
+        with sample() contributing zero."""
+        calls = []
+        real = np.linalg.eigh
+        monkeypatch.setattr(np.linalg, "eigh", lambda a: calls.append(1) or real(a))
+        gens = 4
+        trials = self.gen_history(gens)
+        spec = make_spec("cmaes", self.SETTINGS, dim=4)
+        request = SuggestionRequest(experiment=spec, trials=trials, current_request_number=6)
+        vectorized.set_enabled(False)  # count the legacy path's eigh calls
+        create("cmaes").get_suggestions(request)
+        assert len(calls) == gens + 1  # fresh() + one per folded generation
+
+    def test_restart_strategies_stay_on_legacy(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("cma_replay must not run for restart strategies")
+
+        monkeypatch.setattr(vectorized, "cma_replay", boom)
+        trials = self.gen_history(3)
+        spec = make_spec("cmaes", {**self.SETTINGS, "restart_strategy": "ipop"}, dim=4)
+        request = SuggestionRequest(experiment=spec, trials=trials, current_request_number=6)
+        reply = create("cmaes").get_suggestions(request)
+        assert len(reply.assignments) == 6
+
+    def test_warm_start_anchors_mean(self):
+        spec = make_spec("cmaes", {"random_state": 5, "popsize": 6, "sigma": 1e-5}, dim=4)
+        best = np.array([0.9, 0.1, 0.7, 0.2])
+        warm = WarmStartData(
+            xs=np.vstack([np.full(4, 0.5), best]),
+            ys=np.array([0.1, 2.0]),  # maximize: second point is best
+        )
+        request = SuggestionRequest(
+            experiment=spec, trials=[], current_request_number=4, warm_start=warm
+        )
+        got = decode_values(create("cmaes").get_suggestions(request).assignments)
+        # sigma ~ 0: every sample sits on the warm-start mean
+        np.testing.assert_allclose(got, np.tile(best, (4, 1)), atol=1e-3)
+
+
+class TestBoParity:
+    @staticmethod
+    def labels_fn(i):
+        return {"bo-acq": ["ei", "pi", "lcb"][i % 3]}
+
+    @pytest.mark.parametrize("acq", ["ei", "lcb", "gp_hedge"])
+    def test_selections_match_oracle(self, acq):
+        trials = make_history(24, dim=3, seed=2, labels_fn=self.labels_fn)
+        legacy, vec, legacy_a, vec_a = run_both(
+            "bayesianoptimization",
+            {"random_state": 5, "acq_func": acq, "n_initial_points": 8},
+            trials,
+            4,
+        )
+        np.testing.assert_allclose(vec, legacy, atol=1e-8)
+        assert [a.labels.get("bo-acq") for a in vec_a] == [
+            a.labels.get("bo-acq") for a in legacy_a
+        ]
+
+    def test_mle_grid_matches_oracle(self):
+        from katib_tpu.suggest.bayesopt import _GP, _LENGTH_GRID, _NOISE_GRID
+
+        r = np.random.default_rng(4)
+        xs = r.random((30, 3))
+        ys = np.sin(xs.sum(axis=1) * 3) + r.normal(0, 0.05, 30)
+        combo = vectorized.bo_mle(xs, ys, _LENGTH_GRID, _NOISE_GRID)
+        gp = _GP.fit_mle(xs, ys)
+        assert combo == (gp.length, gp.noise)
+
+    def test_warm_start_skips_random_phase(self):
+        """With too little own history BO samples uniformly (no bo-acq
+        label); warm-start rows count toward n_initial_points, so the
+        seeded experiment acquires from the GP immediately."""
+        spec = make_spec(
+            "bayesianoptimization",
+            {"random_state": 5, "acq_func": "ei", "n_initial_points": 10},
+            dim=3,
+        )
+        trials = make_history(3, dim=3, seed=6)
+        r = np.random.default_rng(8)
+        warm = WarmStartData(xs=r.random((12, 3)), ys=r.random(12))
+        cold = create("bayesianoptimization").get_suggestions(
+            SuggestionRequest(spec, trials, 2)
+        )
+        warmed = create("bayesianoptimization").get_suggestions(
+            SuggestionRequest(spec, trials, 2, warm_start=warm)
+        )
+        assert all(a.labels.get("bo-acq") is None for a in cold.assignments)
+        assert all(a.labels.get("bo-acq") == "ei" for a in warmed.assignments)
+
+
+class TestRequestPlan:
+    def test_matches_reconcile_budget_math(self):
+        from katib_tpu.controller.suggestion import suggestion_request_plan
+
+        spec = make_spec("random")
+        spec.parallel_trial_count = 3
+        spec.max_trial_count = 10
+        exp = Experiment(spec=spec)
+
+        def trial_with(cond):
+            t = Trial(name=f"c-{cond.value}-{id(cond)}", experiment_name="vec-test")
+            t.condition = cond
+            return t
+
+        trials = [
+            trial_with(TrialCondition.SUCCEEDED),
+            trial_with(TrialCondition.SUCCEEDED),
+            trial_with(TrialCondition.FAILED),
+            trial_with(TrialCondition.RUNNING),
+            trial_with(TrialCondition.PENDING),
+        ]
+        # completed=3 (succeeded+failed), active=2 -> add = min(10-3, 3)-2 = 1
+        add, requests = suggestion_request_plan(exp, trials, lambda t: True)
+        assert (add, requests) == (1, 6)
+        # an early-stopped trial without an observation is excluded from
+        # the request total (experiment_controller.go:449-461)
+        es = trial_with(TrialCondition.EARLY_STOPPED)
+        add, requests = suggestion_request_plan(
+            exp, trials + [es], lambda t: t is not es
+        )
+        assert (add, requests) == (1, 6)  # len+1, minus the incomplete ES
+
+
+def _service_fixture(tmp_root, algo="tpe", async_on=True, settings=None, max_trials=100):
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.events import EventRecorder, MetricsRegistry
+    from katib_tpu.controller.suggestion import SuggestionService
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import InMemoryObservationStore
+
+    cfg = KatibConfig()
+    cfg.runtime.async_suggest = async_on
+    cfg.runtime.warm_start = False
+    state = ExperimentStateStore(None)
+    spec = make_spec(algo, settings or {"random_state": 5}, name="svc-exp")
+    spec.max_trial_count = max_trials
+    exp = Experiment(spec=spec)
+    state.create_experiment(exp)
+    svc = SuggestionService(
+        state,
+        InMemoryObservationStore(),
+        config=cfg,
+        metrics=MetricsRegistry(),
+        events=EventRecorder(),
+    )
+    return svc, exp, state
+
+
+class TestAsyncPipeline:
+    def test_prefetch_then_consult_serves_buffer(self, tmp_path):
+        svc, exp, state = _service_fixture(tmp_path)
+        try:
+            svc._schedule_prefetch(exp.name)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with svc._lock:
+                    if exp.name in svc._buffer:
+                        break
+                time.sleep(0.01)
+            with svc._lock:
+                assert exp.name in svc._buffer
+            got = svc.sync_assignments(exp, [], requests=4)
+            assert len(got) == 4
+            hits = [
+                v for (m, _), v in svc.metrics._counters.items()
+                if m == "katib_suggestion_buffer_ready_total"
+            ]
+            assert hits and hits[0] >= 4
+        finally:
+            svc.close()
+
+    def test_cold_buffer_falls_back_inline(self, tmp_path):
+        svc, exp, state = _service_fixture(tmp_path)
+        try:
+            got = svc.sync_assignments(exp, [], requests=3)
+            assert len(got) == 3
+            misses = [
+                v for (m, _), v in svc.metrics._counters.items()
+                if m == "katib_suggestion_buffer_miss_total"
+            ]
+            assert misses and misses[0] >= 1
+        finally:
+            svc.close()
+
+    def test_unsafe_algorithms_never_buffer(self, tmp_path):
+        svc, exp, state = _service_fixture(
+            tmp_path, algo="grid", settings={}, max_trials=8
+        )
+        # grid is not ASYNC_SAFE: the async gate must refuse
+        assert not svc._async_for(exp)
+        svc.close()
+
+    def test_concurrent_sync_no_duplicates_no_losses(self, tmp_path):
+        """ISSUE 10 acceptance: concurrent sync_assignments over a shared
+        suggestion state commit every assignment exactly once, under the
+        dynamic lock-order detector."""
+        from katib_tpu.analysis import lockgraph
+
+        with lockgraph.instrument() as lock_order:
+            svc, exp, state = _service_fixture(tmp_path, max_trials=200)
+            try:
+                requests = 48
+                errors = []
+
+                def worker():
+                    try:
+                        for _ in range(6):
+                            svc.sync_assignments(exp, [], requests=requests)
+                    except Exception as e:  # surfaced after the join
+                        errors.append(e)
+
+                threads = [threading.Thread(target=worker) for _ in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert not errors, errors
+                suggestion = state.get_suggestion(exp.name)
+                names = [a.name for a in suggestion.suggestions]
+                # exactly `requests` committed: none lost, none duplicated
+                assert len(names) == requests
+                assert len(set(names)) == requests
+            finally:
+                svc.close()
+            lock_order.assert_no_cycles()
+
+    def test_controller_e2e_async_sweep_integrity(self):
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.experiment import ExperimentController
+
+        def trial_fn(assignments, ctx):
+            ctx.report(metric=float(assignments["x0"]))
+
+        spec = make_spec("tpe", {"random_state": 11, "n_startup_trials": 4}, name="async-e2e")
+        spec.trial_template = TrialTemplate(function=trial_fn)
+        spec.max_trial_count = 12
+        spec.parallel_trial_count = 4
+        root = tempfile.mkdtemp(prefix="async-e2e-")
+        cfg = KatibConfig()
+        cfg.runtime.async_suggest = True
+        cfg.runtime.telemetry = False
+        c = ExperimentController(root_dir=root, devices=list(range(4)), config=cfg)
+        try:
+            c.create_experiment(spec)
+            exp = c.run("async-e2e", timeout=120)
+            assert exp.status.is_succeeded, exp.status.message
+            names = [t.name for t in c.state.list_trials("async-e2e")]
+            assert len(names) == len(set(names)) == 12
+            render = c.metrics.render()
+            assert "katib_suggestion_batch_seconds" in render
+        finally:
+            c.close()
+
+
+class TestWarmStartIndex:
+    def _spec(self, name, metric="metric"):
+        spec = make_spec("random", name=name)
+        spec.objective.objective_metric_name = metric
+        return spec
+
+    def test_store_roundtrip_and_matching(self, tmp_path):
+        from katib_tpu.db.store import InMemoryObservationStore, SqliteObservationStore
+
+        for store in (
+            InMemoryObservationStore(),
+            SqliteObservationStore(str(tmp_path / "obs.db")),
+        ):
+            store.replace_experiment_history("a", "sig1", [([0.1, 0.2], 1.0), ([0.3, 0.4], 2.0)])
+            store.replace_experiment_history("b", "sig1", [([0.5, 0.6], 3.0)])
+            store.replace_experiment_history("c", "sig2", [([0.7, 0.8], 4.0)])
+            rows = store.matching_history("sig1")
+            assert len(rows) == 3
+            rows = store.matching_history("sig1", exclude_experiment="a")
+            assert [r.experiment for r in rows] == ["b"]
+            assert rows[0].x == [0.5, 0.6] and rows[0].y == 3.0
+            assert store.matching_history("sig1", limit=1)
+            # replace is idempotent, delete drops
+            store.replace_experiment_history("a", "sig1", [([0.9, 0.9], 9.0)])
+            assert len(store.matching_history("sig1")) == 2
+            store.delete_experiment_history("a")
+            assert len(store.matching_history("sig1", exclude_experiment="zz")) == 1
+            store.close()
+
+    def test_signature_covers_space_and_objective(self):
+        from katib_tpu.controller.suggestion import warm_start_signature
+
+        a = warm_start_signature(self._spec("a"))
+        assert a == warm_start_signature(self._spec("b"))  # name-independent
+        assert a != warm_start_signature(self._spec("c", metric="other"))
+        wider = make_spec("random", dim=4, name="d")
+        assert a != warm_start_signature(wider)
+
+    def test_controller_e2e_warm_start(self):
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.experiment import ExperimentController
+
+        def trial_fn(assignments, ctx):
+            ctx.report(metric=-(float(assignments["x0"]) - 0.3) ** 2)
+
+        root = tempfile.mkdtemp(prefix="warm-e2e-")
+        cfg = KatibConfig()
+        cfg.runtime.warm_start = True
+        cfg.runtime.telemetry = False
+        c = ExperimentController(root_dir=root, devices=list(range(4)), config=cfg)
+        try:
+            for name, algo, settings in (
+                ("warm-a", "random", {"random_state": 1}),
+                ("warm-b", "tpe", {"random_state": 2, "n_startup_trials": 50}),
+            ):
+                spec = make_spec(algo, settings, name=name)
+                spec.trial_template = TrialTemplate(function=trial_fn)
+                spec.max_trial_count = 6
+                spec.parallel_trial_count = 3
+                c.create_experiment(spec)
+                exp = c.run(name, timeout=120)
+                assert exp.status.is_succeeded, exp.status.message
+            # warm-b saw warm-a's completed observations
+            reasons = [e.reason for e in c.events.list("warm-b")]
+            assert "WarmStartApplied" in reasons
+            assert "WarmStartApplied" not in [e.reason for e in c.events.list("warm-a")]
+            assert "katib_warm_start_total" in c.metrics.render()
+            # and the index is queryable directly
+            from katib_tpu.controller.suggestion import warm_start_signature
+
+            rows = c.obs_store.matching_history(
+                warm_start_signature(c.state.get_experiment("warm-a").spec)
+            )
+            assert len(rows) >= 6
+        finally:
+            c.close()
+
+    def test_warm_start_off_no_event(self):
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.experiment import ExperimentController
+
+        def trial_fn(assignments, ctx):
+            ctx.report(metric=1.0)
+
+        root = tempfile.mkdtemp(prefix="warm-off-")
+        cfg = KatibConfig()
+        cfg.runtime.warm_start = False
+        cfg.runtime.telemetry = False
+        c = ExperimentController(root_dir=root, devices=list(range(2)), config=cfg)
+        try:
+            for name in ("off-a", "off-b"):
+                spec = make_spec("random", {"random_state": 1}, name=name)
+                spec.trial_template = TrialTemplate(function=trial_fn)
+                spec.max_trial_count = 2
+                spec.parallel_trial_count = 2
+                c.create_experiment(spec)
+                c.run(name, timeout=60)
+            assert "WarmStartApplied" not in [
+                e.reason for e in c.events.list("off-b")
+            ]
+        finally:
+            c.close()
