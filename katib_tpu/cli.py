@@ -26,6 +26,9 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            cost estimate, compile time, trials served; --url
                            asks a live controller's /api/compile, else reads
                            the snapshot under <root>/compilesvc/)
+  rungs <experiment>       multi-fidelity ladder view (per-rung population,
+                           running/paused/promoted/pruned counts and best
+                           objective), offline from the state root
   metrics <trial>          raw observation log for one trial
   algorithms               registered suggestion / early-stopping algorithms
   check [paths]            recompile-hazard / lock-discipline / repo-invariant
@@ -462,6 +465,64 @@ def cmd_population(args) -> int:
     return 0
 
 
+def cmd_rungs(args) -> int:
+    """Multi-fidelity ladder view (ISSUE 11): per-rung budget, population,
+    running/paused/promoted/pruned/succeeded counts and best objective,
+    rebuilt offline from the persisted trial records (rung labels) and the
+    observation store — no live controller needed."""
+    import os
+
+    from .controller.multifidelity import ALGORITHM_NAME, ladder_report
+    from .db.state import ExperimentStateStore
+    from .db.store import open_store
+
+    state = ExperimentStateStore(os.path.join(args.root, "state"))
+    exp = state.load(args.experiment)
+    if exp is None:
+        print(f"experiment {args.experiment!r} not found under {args.root}", file=sys.stderr)
+        return 1
+    if exp.spec.algorithm.algorithm_name != ALGORITHM_NAME:
+        print(
+            f"experiment {args.experiment!r} uses algorithm "
+            f"{exp.spec.algorithm.algorithm_name!r}, not {ALGORITHM_NAME!r} "
+            "(no rung ladder)",
+            file=sys.stderr,
+        )
+        return 1
+    db = os.path.join(args.root, "observations.db")
+    store = open_store(db if os.path.exists(db) else None)
+    try:
+        report = ladder_report(
+            exp.spec, state.list_trials(args.experiment), store
+        )
+    finally:
+        store.close()
+    print(
+        f"experiment {report['experiment']}: resource={report['resource']} "
+        f"eta={report['eta']}"
+    )
+    rows = [
+        (
+            str(r["rung"]),
+            r["budget"],
+            str(r["population"]),
+            str(r["running"]),
+            str(r["paused"]),
+            str(r["promoted"]),
+            str(r["pruned"]),
+            str(r["succeeded"]),
+            "-" if r["best"] is None else f"{r['best']:.6g}",
+        )
+        for r in report["rungs"]
+    ]
+    _table(
+        ["RUNG", "BUDGET", "POPULATION", "RUNNING", "PAUSED", "PROMOTED",
+         "PRUNED", "SUCCEEDED", "BEST"],
+        rows,
+    )
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -738,6 +799,14 @@ def main(argv=None) -> int:
         "<root>/compilesvc/)",
     )
     cp.set_defaults(fn=cmd_compile)
+
+    rg = sub.add_parser(
+        "rungs",
+        help="multi-fidelity ladder: per-rung population, paused/promoted/"
+        "pruned counts and best objective (offline from the state root)",
+    )
+    rg.add_argument("experiment")
+    rg.set_defaults(fn=cmd_rungs)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")
     me.add_argument("trial")

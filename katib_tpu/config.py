@@ -120,6 +120,14 @@ class RuntimeConfig:
     # search-space + objective signature. Opt-in.
     warm_start: bool = False
     warm_start_max_points: int = 256  # cap on transferred observations
+    # Native multi-fidelity search (controller/multifidelity.py): ASHA
+    # rung ladders as a scheduler citizen — trials pause at rung
+    # boundaries with checkpoint + observations intact, survivors resume
+    # at the next fidelity. Only experiments declaring `algorithm: asha`
+    # use it; multifidelity=false / KATIB_TPU_MULTIFIDELITY=0 removes the
+    # engine entirely (asha specs are then rejected at admission) and
+    # leaves the legacy stateless hyperband path byte-identical.
+    multifidelity: bool = True
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -163,6 +171,7 @@ ENV_OVERRIDES: Dict[str, str] = {
     "suggest_readahead": "KATIB_TPU_SUGGEST_READAHEAD",
     "warm_start": "KATIB_TPU_WARM_START",
     "warm_start_max_points": "KATIB_TPU_WARM_START_MAX_POINTS",
+    "multifidelity": "KATIB_TPU_MULTIFIDELITY",
 }
 
 _FALSY = ("0", "false", "off")

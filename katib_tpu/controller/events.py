@@ -346,6 +346,12 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_suggestion_buffer_ready_total": "Assignments served from the async prefetch buffer.",
     "katib_suggestion_buffer_miss_total": "Buffer consults that fell back to the inline compute (cold or stale buffer).",
     "katib_warm_start_total": "Experiments whose suggester was seeded from matching completed-experiment history.",
+    # native multi-fidelity search (katib_tpu/controller/multifidelity.py,
+    # ISSUE 11) — the RungPaused / RungPromoted / RungPruned events pair
+    # with these series
+    "katib_rung_promotions_total": "Rung-paused trials promoted to the next fidelity (checkpoint-resumed or re-run from scratch).",
+    "katib_rung_pruned_total": "Rung-paused trials pruned when the ladder drained (outside the top 1/eta of their rung).",
+    "katib_multifidelity_device_seconds": "Device-seconds consumed by multi-fidelity (asha) trial stints, charged at gang release.",
 }
 
 
@@ -401,4 +407,8 @@ EVENT_CATALOG: Dict[str, str] = {
     "PopulationFused": "Opted-in PBT/ENAS sweep dispatched as one fused on-device population program.",
     # vectorized suggestion plane / transfer HPO (PR 10)
     "WarmStartApplied": "Suggester seeded from completed experiments with a matching search-space signature.",
+    # native multi-fidelity search (ISSUE 11, controller/multifidelity.py)
+    "RungPaused": "Trial completed its rung budget and paused (checkpoint + observations intact) awaiting a promotion decision.",
+    "RungPromoted": "Rung-paused trial resubmitted at the next fidelity, resuming its checkpoint (or from scratch if unusable).",
+    "RungPruned": "Rung-paused trial finalized early-stopped: outside the top 1/eta of its rung when the ladder drained.",
 }

@@ -167,6 +167,22 @@ class ExperimentController:
             self._collect_current_gauges,
             names=("katib_experiments_current", "katib_trials_current"),
         )
+        # Native multi-fidelity engine (controller/multifidelity.py, ISSUE
+        # 11): ASHA rung ladders owned by the scheduler — pause at rung
+        # boundaries, checkpoint-resumed promotions, reconcile-side pruning.
+        # Disabled (runtime.multifidelity=false / KATIB_TPU_MULTIFIDELITY=0)
+        # nothing is constructed, `algorithm: asha` specs are rejected at
+        # admission, and the legacy hyperband path is byte-identical.
+        self.multifidelity = None
+        if rt.multifidelity:
+            from .multifidelity import MultiFidelityEngine
+
+            self.multifidelity = MultiFidelityEngine(
+                self.state,
+                self.obs_store,
+                events=self.events,
+                metrics=self.metrics,
+            )
         self._completed_seen: set = set()
         self._closed = threading.Event()
         # AOT compile service (compilesvc/service.py, ISSUE 8): compilation
@@ -221,6 +237,7 @@ class ExperimentController:
                 if rt.async_suggest
                 else None
             ),
+            multifidelity=self.multifidelity,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -234,6 +251,18 @@ class ExperimentController:
             known_algorithms=registered_algorithms(),
             known_early_stopping=registered_early_stoppers(),
         )
+        from .multifidelity import ALGORITHM_NAME as MF_ALGORITHM
+
+        if spec.algorithm.algorithm_name == MF_ALGORITHM and self.multifidelity is None:
+            from ..api.validation import ValidationError
+
+            raise ValidationError(
+                [
+                    "algorithm 'asha' requires the multi-fidelity engine: "
+                    "set runtime.multifidelity=true "
+                    "(KATIB_TPU_MULTIFIDELITY=1)"
+                ]
+            )
         # semantic pre-flight (ISSUE 7): rejects a certainly-OOM sweep at
         # admission (raises ValidationError) and warms the analysis cache
         # for the dispatch-path consumers; near-capacity warning deferred
@@ -350,6 +379,17 @@ class ExperimentController:
         if exp is None:
             raise KeyError(f"experiment {name!r} not found")
         trials = self.state.list_trials(name)
+        mf = self.multifidelity
+        if mf is not None and not exp.status.is_completed and mf.applies(exp.spec):
+            # rung decisions ride the reconcile wake: promote newly-eligible
+            # paused trials (making them active again) BEFORE the status
+            # aggregation below can declare the experiment complete, and
+            # prune the ladder's leftovers once the sweep drains
+            try:
+                if mf.pump(exp, trials, self.scheduler):
+                    trials = self.state.list_trials(name)
+            except Exception:
+                log.warning("multifidelity pump failed", exc_info=True)
         update_experiment_status(exp, trials, self.suggestions.search_ended(name))
         if not exp.status.is_completed:
             try:
@@ -404,6 +444,15 @@ class ExperimentController:
         active = sts.trials_pending + sts.trials_running
 
         if active > parallel:
+            mf = self.multifidelity
+            if mf is not None and mf.applies(exp.spec):
+                # rung promotions resubmit paused trials outside the budget
+                # math, so a multi-fidelity experiment can transiently hold
+                # more active trials than parallelTrialCount. Killing the
+                # newest would burn an admitted-but-never-evaluated config;
+                # instead admission simply waits (the device allocator still
+                # bounds real concurrency) until promotions drain.
+                return
             self._delete_trials(exp, trials, active - parallel)
             return
         if active >= parallel:
@@ -566,6 +615,11 @@ class ExperimentController:
             self.state.put_suggestion(suggestion)
 
     def _on_completed(self, exp: Experiment) -> None:
+        if self.multifidelity is not None:
+            # goal-reached / budget-exhausted completion can leave trials
+            # rung-paused; prune them so none lingers awaiting a promotion
+            # that will never come
+            self.multifidelity.finalize(exp)
         # transfer-HPO index (ISSUE 10): completed observations become
         # warm-start priors for future experiments with a matching
         # search-space + objective signature
@@ -694,6 +748,8 @@ class ExperimentController:
         self.obs_store.delete_experiment_history(name)
         self.suggestions.forget(name)
         self.scheduler.forget_experiment(name)
+        if self.multifidelity is not None:
+            self.multifidelity.forget(name)
         self.tracer.forget(name)
         self._completed_seen.discard(name)
         self.metrics.inc("katib_experiment_deleted_total", experiment=name)

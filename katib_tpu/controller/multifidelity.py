@@ -1,0 +1,622 @@
+"""Multi-fidelity search engine — ASHA rungs as a scheduler citizen.
+
+Upstream Katib ships successive halving only as a stateless suggestion
+service (suggest/hyperband.py reproduces it exactly): every rung restarts
+survivors FROM SCRATCH with a bigger budget parameter, so the
+device-seconds spent on the lower rung are thrown away. This module makes
+the halving native by reusing machinery the repo already owns:
+
+- **Rungs are fidelity levels over the budget knob** (``resource_name``
+  algorithm setting — epochs/examples, classified as a *host* parameter by
+  the semantic analyzer), so rung changes never recompile: every rung of a
+  sweep shares one dispatch-group key (analysis/program.py ignores
+  host-only differences) and therefore one AOT-warmed executable in the
+  compile service.
+- **A rung boundary is a completion, not a restart**: a trial launched
+  with ``resource=r_k`` trains to r_k (resuming its own checkpoint from
+  the previous rung through the ordinary ``ctx.checkpoint_store()`` path),
+  reports its objective, and is *paused* — a non-victim variant of
+  checkpoint-preemption: terminal-looking (EarlyStopped/``RungPaused``) so
+  it frees its parallel slot and its devices, but with the observation log
+  and checkpoint intact.
+- **Promotion is the PBT exploit move across fidelities**: the SAME trial
+  is resubmitted with the budget knob raised to r_{k+1} and its checkpoint
+  directory re-attached, so the resumed stint continues the same PRNG
+  stream and observation log — the PR 2 resume-bit-identical guarantees
+  apply unchanged. Non-promoted trials finalize as early-stopped
+  (``RungPruned``) with their observations intact.
+- **Low-fidelity rungs pack**: same-rung trials share the budget value, so
+  pack formation (controller/packing.py keys open packs by the rung's
+  budget) can run a whole bottom rung as one vmapped program.
+
+The promotion rule is asynchronous successive halving (Li et al., ASHA): a
+paused trial at rung k is promotable when it ranks in the top
+``floor(|rung_k| / eta)`` of every objective recorded at rung k. Decisions
+are made at each boundary (scheduler worker thread) and re-checked on
+every reconcile (:meth:`MultiFidelityEngine.pump`), which also prunes the
+ladder once the sweep drains.
+
+Gating: the engine exists only when ``runtime.multifidelity`` is on AND an
+experiment declares ``algorithm: asha``. Hyperband specs never touch it —
+the legacy stateless path is preserved byte-identically.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import shutil
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..api.spec import ExperimentSpec, ObjectiveType, ParameterType
+from ..api.status import Experiment, Trial, TrialCondition
+from ..db.store import ObservationStore, objective_value
+from ..earlystop.curves import ObjectiveCurveReader
+
+log = logging.getLogger("katib_tpu.multifidelity")
+
+ALGORITHM_NAME = "asha"
+
+# Persisted trial labels: the offline `katib-tpu rungs` view and the
+# restart rebuild read them back from the state store.
+RUNG_LABEL = "katib-tpu/rung"            # current rung index of the trial
+PAUSED_LABEL = "katib-tpu/rung-paused"   # present while rung-paused (value: rung)
+
+DEFAULT_ETA = 3
+
+
+@dataclass
+class FidelityLadder:
+    """The rung ladder of one experiment: budgets r_0 < r_1 < ... < r_top
+    over the spec's ``resource_name`` parameter, geometric in ``eta`` and
+    clipped to ``max_resource``."""
+
+    resource_name: str
+    eta: int
+    rungs: List[float]
+    integer: bool  # INT resource: budgets truncate like hyperband's
+
+    @classmethod
+    def from_spec(cls, spec: ExperimentSpec) -> "FidelityLadder":
+        """Build the ladder from algorithm settings; raises ValueError on a
+        malformed spec (asha's validate_algorithm_settings surfaces it)."""
+        settings = spec.algorithm.settings_dict()
+        resource = settings.get("resource_name", "")
+        if not resource:
+            raise ValueError("asha requires the resource_name setting")
+        param = next((p for p in spec.parameters if p.name == resource), None)
+        if param is None:
+            raise ValueError(
+                f"resource_name {resource!r} must name an experiment parameter"
+            )
+        if param.parameter_type not in (ParameterType.INT, ParameterType.DOUBLE):
+            raise ValueError(
+                f"resource parameter {resource!r} must be int or double"
+            )
+        eta = int(float(settings.get("eta", DEFAULT_ETA)))
+        if eta <= 1:
+            raise ValueError("eta must be an integer greater than 1")
+        fs = param.feasible_space
+        lo_default = fs.min if fs.min not in (None, "") else "1"
+        hi_default = fs.max if fs.max not in (None, "") else "0"
+        min_r = float(settings.get("min_resource", lo_default))
+        max_r = float(settings.get("max_resource", hi_default))
+        if min_r <= 0:
+            raise ValueError("min_resource must be positive")
+        if max_r <= min_r:
+            raise ValueError(
+                f"max_resource ({max_r:g}) must exceed min_resource ({min_r:g})"
+            )
+        rungs = [min_r]
+        while rungs[-1] < max_r:
+            rungs.append(min(rungs[-1] * eta, max_r))
+        integer = param.parameter_type == ParameterType.INT
+        if integer:
+            # dedupe after truncation (e.g. min=1, eta=2, max=3 -> 1,2,3)
+            seen: List[float] = []
+            for r in rungs:
+                if not seen or int(r) != int(seen[-1]):
+                    seen.append(float(int(r)))
+            rungs = seen
+        return cls(resource_name=resource, eta=eta, rungs=rungs, integer=integer)
+
+    @property
+    def top(self) -> int:
+        return len(self.rungs) - 1
+
+    def format(self, r: float) -> str:
+        """Budget as the string assigned to the resource parameter (INT
+        resources truncate, matching hyperband's _format_budget)."""
+        return str(int(r)) if self.integer else repr(float(r))
+
+    def rung_of(self, value: str) -> int:
+        """Rung index of a budget assignment: the highest rung whose budget
+        does not exceed the value (exact for engine-issued budgets; a
+        tolerant floor for hand-written ones)."""
+        v = float(value)
+        idx = 0
+        for i, r in enumerate(self.rungs):
+            if v >= r - 1e-9:
+                idx = i
+        return idx
+
+
+class _ExperimentRungs:
+    """Per-experiment rung table. Not self-locking: the engine's lock
+    guards every mutation (caller holds it)."""
+
+    def __init__(self, ladder: FidelityLadder, maximize: bool):
+        self.ladder = ladder
+        self.maximize = maximize
+        # rung index -> {trial name: objective recorded at that boundary}
+        self.scores: List[Dict[str, float]] = [dict() for _ in ladder.rungs]
+        # rung index -> trials promoted OUT of that rung
+        self.promoted: List[set] = [set() for _ in ladder.rungs]
+        self.paused: Dict[str, int] = {}  # trial name -> rung it paused at
+        self.done = False
+
+
+class MultiFidelityEngine:
+    """Scheduler-citizen ASHA: owns rung records, pause/promote/prune.
+
+    Thread model: :meth:`on_rung_boundary` runs on scheduler worker
+    threads, :meth:`pump` on the reconcile thread. The engine lock guards
+    its tables only — it is never held across scheduler calls (submit /
+    _record_terminal), so the only cross-subsystem lock edge is
+    engine -> scheduler."""
+
+    def __init__(self, state, obs_store: ObservationStore, events=None, metrics=None):
+        self.state = state
+        self.obs_store = obs_store
+        self.events = events
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._exps: Dict[str, _ExperimentRungs] = {}
+
+    # -- applicability -------------------------------------------------------
+
+    @staticmethod
+    def applies(spec: ExperimentSpec) -> bool:
+        return spec.algorithm.algorithm_name == ALGORITHM_NAME
+
+    def _entry(self, exp: Experiment) -> _ExperimentRungs:
+        """Get-or-build the experiment's rung table, rebuilding from
+        persisted trial labels + the fold index after a controller restart.
+        Must be called WITHOUT the engine lock held (reads the store)."""
+        with self._lock:
+            st = self._exps.get(exp.name)
+        if st is not None:
+            return st
+        ladder = FidelityLadder.from_spec(exp.spec)
+        maximize = exp.spec.objective.type == ObjectiveType.MAXIMIZE
+        st = _ExperimentRungs(ladder, maximize)
+        reader = ObjectiveCurveReader(self.obs_store, exp.spec.objective)
+        for t in self.state.list_trials(exp.name):
+            rung_lbl = t.labels.get(RUNG_LABEL)
+            if rung_lbl is None:
+                continue
+            try:
+                k = int(rung_lbl)
+            except ValueError:
+                continue
+            k = min(max(k, 0), ladder.top)
+            score = reader.boundary_value(t.name)
+            if (
+                PAUSED_LABEL in t.labels
+                and t.condition == TrialCondition.EARLY_STOPPED
+                and score is not None
+            ):
+                st.scores[k][t.name] = score
+                st.paused[t.name] = k
+            else:
+                # a trial past rung 0 was promoted through every lower rung;
+                # its per-rung boundary scores are gone, so the rebuild
+                # backfills the current folded objective — enough to keep
+                # rung sizes and promotion counts consistent after a restart
+                for j in range(k):
+                    if score is not None:
+                        st.scores[j].setdefault(t.name, score)
+                    st.promoted[j].add(t.name)
+                if score is not None and (
+                    t.condition == TrialCondition.EARLY_STOPPED or k == ladder.top
+                ):
+                    st.scores[k].setdefault(t.name, score)
+        with self._lock:
+            return self._exps.setdefault(exp.name, st)
+
+    # -- rung boundary (scheduler worker thread) -----------------------------
+
+    def on_rung_boundary(self, exp: Experiment, trial: Trial, observation, scheduler) -> bool:
+        """Consulted by the scheduler when a trial COMPLETED its assigned
+        budget. Returns True when the trial was paused at a rung boundary
+        (the scheduler then skips normal finalization); False hands the
+        trial back to the ordinary Succeeded path (non-asha experiment,
+        top-of-ladder completion, or no usable objective)."""
+        spec = exp.spec
+        if not self.applies(spec):
+            return False
+        try:
+            st = self._entry(exp)
+        except Exception:
+            log.debug("rung table unavailable for %s", exp.name, exc_info=True)
+            return False
+        ladder = st.ladder
+        value = trial.assignments_dict().get(ladder.resource_name)
+        if value is None:
+            return False
+        try:
+            k = ladder.rung_of(value)
+        except ValueError:
+            return False
+        score = objective_value(observation, spec.objective)
+        if score is None or math.isnan(score):
+            return False  # MetricsUnavailable classification handles it
+        with self._lock:
+            if st.done:
+                return False
+            st.scores[k][trial.name] = score
+            if k >= ladder.top:
+                # final fidelity: record for the rung view, finalize normally
+                st.paused.pop(trial.name, None)
+            else:
+                st.paused[trial.name] = k
+        if k >= ladder.top:
+            trial.labels[RUNG_LABEL] = str(k)
+            return False
+        # Pause: the non-victim variant of checkpoint-preemption — the trial
+        # leaves the device pool terminal-looking (EarlyStopped) but keeps
+        # its observation log and checkpoint; a later promotion resubmits it.
+        trial.labels[PAUSED_LABEL] = str(k)
+        trial.labels[RUNG_LABEL] = str(k)
+        trial.set_condition(
+            TrialCondition.EARLY_STOPPED,
+            "RungPaused",
+            f"paused at rung {k} ({ladder.resource_name}="
+            f"{ladder.format(ladder.rungs[k])}) awaiting promotion decision",
+        )
+        scheduler._record_terminal(exp, trial)
+        self._maybe_promote(exp, scheduler)
+        return True
+
+    # -- promotion -----------------------------------------------------------
+
+    def _eligible_locked(self, st: _ExperimentRungs) -> List[Tuple[str, int]]:
+        """ASHA candidates, highest rung first: a paused trial at rung k is
+        promotable while it ranks in the top floor(|rung_k| / eta) of every
+        score recorded at rung k. Caller holds the engine lock."""
+        out: List[Tuple[str, int]] = []
+        for k in range(st.ladder.top - 1, -1, -1):
+            records = st.scores[k]
+            if not records:
+                continue
+            # total promotions out of rung k are capped at the quota: async
+            # decisions on a growing rung would otherwise promote every
+            # config that was EVER inside the top fraction
+            n_promotable = len(records) // st.ladder.eta
+            quota_left = n_promotable - len(st.promoted[k])
+            if quota_left <= 0:
+                continue
+            ranked = sorted(
+                records.items(),
+                key=(
+                    (lambda kv: (-kv[1], kv[0]))
+                    if st.maximize
+                    else (lambda kv: (kv[1], kv[0]))
+                ),
+            )
+            for name, _ in ranked[:n_promotable]:
+                if quota_left <= 0:
+                    break
+                if name in st.promoted[k]:
+                    continue
+                if st.paused.get(name) != k:
+                    continue  # killed during pause, or still running
+                out.append((name, k))
+                quota_left -= 1
+        return out
+
+    def _maybe_promote(self, exp: Experiment, scheduler) -> bool:
+        """Promote every currently-eligible paused trial. Candidates are
+        claimed under the lock (concurrent boundary threads cannot
+        double-promote); submissions run outside it, batched under the
+        scheduler's dispatch barrier so same-rung promotions can pack."""
+        with self._lock:
+            st = self._exps.get(exp.name)
+            if st is None or st.done:
+                return False
+            candidates = self._eligible_locked(st)
+            for name, k in candidates:
+                st.promoted[k].add(name)
+                st.paused.pop(name, None)
+        if not candidates:
+            return False
+        promoted_any = False
+        with scheduler.dispatch_barrier():
+            for name, k in candidates:
+                try:
+                    if self._promote_one(exp, name, k, st.ladder, scheduler):
+                        promoted_any = True
+                except Exception:
+                    log.warning(
+                        "promotion of trial %s failed", name, exc_info=True
+                    )
+        return promoted_any
+
+    def _trial_checkpoint_dir(self, exp: Experiment, trial: Trial, scheduler) -> Optional[str]:
+        """Where the trial's previous stint checkpointed: asha trials carry
+        no suggester-provided lineage dir, so ctx.checkpoint_store() rooted
+        at the per-trial workdir — stable across stints of the same trial
+        name, which is exactly what makes the promotion resume work."""
+        root = getattr(scheduler, "workdir_root", None)
+        if not root:
+            return None
+        return os.path.join(root, exp.name, trial.name)
+
+    def _checkpoint_restorable(self, ck_dir: Optional[str]) -> bool:
+        """True when the paused stint left a loadable checkpoint at the
+        store root. A missing or corrupt checkpoint demotes the promotion
+        to a re-run-from-scratch (observation log dropped so the fold never
+        mixes two executions)."""
+        if not ck_dir or not os.path.isdir(ck_dir):
+            return False
+        from ..runtime.checkpoints import CheckpointStore
+
+        # two attempts: orbax manager construction can transiently fail when
+        # probes interleave with other trials' checkpoint traffic in the same
+        # process; genuine corruption fails deterministically on both
+        for attempt in (0, 1):
+            try:
+                store = CheckpointStore(ck_dir)
+                step = store.latest_step()
+                if step is None:
+                    return False
+                return store.restore(step=step) is not None
+            except Exception:
+                if attempt == 0:
+                    import time
+
+                    time.sleep(0.05)
+                    continue
+                log.warning(
+                    "checkpoint under %s is unreadable; promoting from scratch",
+                    ck_dir, exc_info=True,
+                )
+        return False
+
+    def _promote_one(
+        self, exp: Experiment, name: str, k: int, ladder: FidelityLadder, scheduler
+    ) -> bool:
+        trial = self.state.get_trial(exp.name, name)
+        if trial is None:
+            return False
+        if trial.condition != TrialCondition.EARLY_STOPPED or PAUSED_LABEL not in trial.labels:
+            return False  # killed during pause, or already resumed elsewhere
+        next_budget = ladder.format(ladder.rungs[k + 1])
+        for a in trial.parameter_assignments:
+            if a.name == ladder.resource_name:
+                a.value = next_budget
+        trial.labels.pop(PAUSED_LABEL, None)
+        trial.labels[RUNG_LABEL] = str(k + 1)
+        ck_dir = self._trial_checkpoint_dir(exp, trial, scheduler)
+        fresh = not self._checkpoint_restorable(ck_dir)
+        if fresh:
+            # re-run-from-scratch fallback: clear the unusable checkpoint so
+            # the trial's restore() finds nothing instead of crashing, and
+            # drop the prior stint's rows — the same log-can't-mix-two-
+            # executions invariant restart requeues enforce
+            if ck_dir:
+                shutil.rmtree(ck_dir, ignore_errors=True)
+            self.obs_store.delete_observation_log(name)
+            ck_dir = None
+            # promoted trials never serve as duplicate-reuse sources even
+            # without a checkpoint_dir marker (their metrics span rungs)
+            trial.labels[scheduler.LINEAGE_LABEL] = "1"
+        if self.metrics is not None:
+            self.metrics.inc("katib_rung_promotions_total", experiment=exp.name)
+        if self.events is not None:
+            self.events.event(
+                exp.name, "Trial", name, "RungPromoted",
+                f"promoted from rung {k} to rung {k + 1} "
+                f"({ladder.resource_name}={next_budget})"
+                + (
+                    "; checkpoint missing or unusable, re-running from scratch"
+                    if fresh
+                    else ", resuming from checkpoint"
+                ),
+            )
+        scheduler.submit(exp, trial, checkpoint_dir=ck_dir)
+        return True
+
+    # -- reconcile pump / drain ----------------------------------------------
+
+    def pump(self, exp: Experiment, trials: Sequence[Trial], scheduler) -> bool:
+        """One reconcile-side pass: promote newly-eligible paused trials
+        (they become active again BEFORE status aggregation can declare the
+        experiment complete); once the sweep has drained — every trial
+        terminal, the admission budget exhausted, nothing left to promote —
+        prune the leftover paused trials and close the ladder. Returns True
+        when any trial changed state."""
+        if not self.applies(exp.spec):
+            return False
+        try:
+            st = self._entry(exp)
+        except Exception:
+            return False
+        with self._lock:
+            if st.done:
+                return False
+        if self._maybe_promote(exp, scheduler):
+            return True
+        if any(not t.is_terminal for t in trials):
+            return False
+        maxt = exp.spec.max_trial_count
+        if maxt is not None and len(trials) < maxt:
+            return False  # the suggester still has configurations to admit
+        return self._prune_leftovers(exp, st)
+
+    def finalize(self, exp: Experiment) -> None:
+        """Completion hook (goal reached / budget exhausted): prune any
+        trial still rung-paused so nothing lingers in the paused state."""
+        if not self.applies(exp.spec):
+            return
+        with self._lock:
+            st = self._exps.get(exp.name)
+        if st is not None:
+            self._prune_leftovers(exp, st)
+
+    def _prune_leftovers(self, exp: Experiment, st: _ExperimentRungs) -> bool:
+        with self._lock:
+            leftovers = sorted(st.paused.items())
+            st.paused.clear()
+            st.done = True
+        pruned = False
+        for name, k in leftovers:
+            trial = self.state.get_trial(exp.name, name)
+            if trial is None or trial.condition != TrialCondition.EARLY_STOPPED:
+                continue
+            trial.labels.pop(PAUSED_LABEL, None)
+            trial.set_condition(
+                TrialCondition.EARLY_STOPPED,
+                "RungPruned",
+                f"pruned at rung {k}: outside the top 1/{st.ladder.eta} "
+                "of its rung (observations retained)",
+            )
+            self.state.update_trial(trial)
+            pruned = True
+            if self.metrics is not None:
+                self.metrics.inc("katib_rung_pruned_total", experiment=exp.name)
+            if self.events is not None:
+                self.events.event(
+                    exp.name, "Trial", name, "RungPruned",
+                    f"pruned at rung {k}: outside the top 1/{st.ladder.eta} "
+                    "of its rung",
+                )
+        return pruned
+
+    # -- kill-during-pause ---------------------------------------------------
+
+    def kill_paused(self, trial_name: str, scheduler) -> bool:
+        """scheduler.kill() hook for trials that are neither queued nor
+        running: a rung-paused trial is killed in place and permanently
+        removed from its rung's promotion candidates (its recorded score
+        still informs the cut for its peers)."""
+        exp_name = None
+        with self._lock:
+            for name, st in self._exps.items():
+                if trial_name in st.paused:
+                    st.paused.pop(trial_name, None)
+                    exp_name = name
+                    break
+        if exp_name is None:
+            return False
+        exp = self.state.get_experiment(exp_name)
+        trial = self.state.get_trial(exp_name, trial_name)
+        if exp is None or trial is None:
+            return False
+        trial.labels.pop(PAUSED_LABEL, None)
+        trial.set_condition(
+            TrialCondition.KILLED, "TrialKilled", "killed while rung-paused"
+        )
+        self.state.update_trial(trial)
+        if self.events is not None:
+            self.events.event(
+                exp_name, "Trial", trial_name, "TrialKilled",
+                "killed while rung-paused",
+            )
+        from .scheduler import TrialEvent
+
+        scheduler.events.put(TrialEvent(exp_name, trial_name, trial.condition))
+        return True
+
+    def forget(self, experiment_name: str) -> None:
+        with self._lock:
+            self._exps.pop(experiment_name, None)
+
+
+def pack_rung_key(spec: ExperimentSpec, trial: Trial) -> Optional[str]:
+    """Budget value of a multi-fidelity trial, or None for every other
+    experiment. Pack formation (controller/packing.py) adds this to the
+    open-pack key so members of different rungs never share a vmapped
+    program even when semantic analysis has no opinion (no probe): the
+    fidelity knob is a host loop count and must be uniform across a pack."""
+    if spec.algorithm.algorithm_name != ALGORITHM_NAME:
+        return None
+    resource = spec.algorithm.settings_dict().get("resource_name")
+    if not resource:
+        return None
+    return trial.assignments_dict().get(resource)
+
+
+def ladder_report(
+    spec: ExperimentSpec, trials: Sequence[Trial], store: ObservationStore
+) -> Dict[str, Any]:
+    """Offline ladder snapshot for `katib-tpu rungs` (and tests): rung
+    populations, promotions, prunes and per-rung best objective, rebuilt
+    purely from persisted trial records + the observation store."""
+    ladder = FidelityLadder.from_spec(spec)
+    maximize = spec.objective.type == ObjectiveType.MAXIMIZE
+    reader = ObjectiveCurveReader(store, spec.objective)
+    rungs: List[Dict[str, Any]] = [
+        {
+            "rung": k,
+            "budget": ladder.format(r),
+            "population": 0,
+            "running": 0,
+            "paused": 0,
+            "promoted": 0,
+            "pruned": 0,
+            "succeeded": 0,
+            "best": None,
+        }
+        for k, r in enumerate(ladder.rungs)
+    ]
+
+    def _rung_index(t: Trial) -> Optional[int]:
+        lbl = t.labels.get(RUNG_LABEL)
+        if lbl is not None:
+            try:
+                return min(max(int(lbl), 0), ladder.top)
+            except ValueError:
+                pass
+        value = t.assignments_dict().get(ladder.resource_name)
+        if value is None:
+            return None
+        try:
+            return ladder.rung_of(value)
+        except ValueError:
+            return None
+
+    for t in trials:
+        k = _rung_index(t)
+        if k is None:
+            continue
+        # a trial at rung k passed through (and was promoted out of) every
+        # lower rung, so it counts toward each rung it trained at
+        for j in range(k):
+            rungs[j]["population"] += 1
+            rungs[j]["promoted"] += 1
+        row = rungs[k]
+        row["population"] += 1
+        if not t.is_terminal:
+            row["running"] += 1
+        elif t.condition == TrialCondition.SUCCEEDED:
+            row["succeeded"] += 1
+        elif t.condition == TrialCondition.EARLY_STOPPED:
+            if PAUSED_LABEL in t.labels:
+                row["paused"] += 1
+            else:
+                row["pruned"] += 1
+        score = reader.boundary_value(t.name)
+        if score is not None:
+            best = row["best"]
+            if best is None or (score > best if maximize else score < best):
+                row["best"] = score
+    return {
+        "experiment": spec.name,
+        "resource": ladder.resource_name,
+        "eta": ladder.eta,
+        "rungs": rungs,
+    }

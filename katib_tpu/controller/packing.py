@@ -146,6 +146,7 @@ def plan_packs(
     without compiling. ``warm=None`` (service disabled) leaves the unit
     order byte-identical to the legacy walk."""
     from ..analysis import program as semantic
+    from .multifidelity import pack_rung_key
 
     units: List[Tuple[Experiment, List[Trial]]] = []
     open_packs: Dict[Tuple, Tuple[int, int]] = {}  # key -> (unit idx, K)
@@ -159,7 +160,11 @@ def plan_packs(
             group = semantic.pack_group_key(exp.spec, trial)
         except Exception:
             group = None  # analysis is advisory; formation must not break
-        key = (exp.name, digest, group)
+        # multi-fidelity rungs never mix in a pack: the budget knob is a
+        # host loop count that must be uniform across the vmapped program,
+        # even when semantic analysis has no opinion (no probe). None for
+        # every non-asha experiment, so legacy keys are unchanged.
+        key = (exp.name, digest, group, pack_rung_key(exp.spec, trial))
         if unpackable_reason(exp, trial) is not None:
             units.append((exp, [trial]))
             continue

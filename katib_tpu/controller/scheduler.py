@@ -109,6 +109,7 @@ class TrialScheduler:
         population_chunk_generations: int = 16,
         population_stream: bool = False,
         suggestion_prefetch: Optional[Callable[[str], None]] = None,
+        multifidelity=None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -181,6 +182,11 @@ class TrialScheduler:
         self.fused_population = fused_population
         self.population_chunk_generations = population_chunk_generations
         self.population_stream = population_stream
+        # -- multi-fidelity engine (controller/multifidelity.py) -------------
+        # None = disabled: every consult below is one `is None` check and
+        # trial finalization is byte-identical to the legacy path; with an
+        # engine attached only `algorithm: asha` experiments use it
+        self.multifidelity = multifidelity
         self._gate_since: Dict[Any, float] = {}  # group key -> hold start
         self._gate_held: Dict[str, float] = {}   # trial -> hold start (spans)
         self._gate_timer_live = False            # one wake timer per hold
@@ -211,6 +217,11 @@ class TrialScheduler:
         one-check contract as _tr()/_tm()."""
         s = self.compile_service
         return s if (s is not None and s.active) else None
+
+    def _mf(self):
+        """The multi-fidelity engine, or None when runtime.multifidelity is
+        off — same one-check contract as _tr()/_tm()/_cs()."""
+        return self.multifidelity
 
     def _on_compile_transition(self, key) -> None:
         """CompileService listener (worker thread, no service lock held): a
@@ -453,6 +464,12 @@ class TrialScheduler:
         h = self._handles.get(trial_name)
         if h is not None:
             h.kill()
+            return
+        mf = self._mf()
+        if mf is not None:
+            # neither queued nor running: a rung-paused multi-fidelity trial
+            # is killed in place and removed from its rung's candidates
+            mf.kill_paused(trial_name, self)
 
     def kill_all(self) -> None:
         """Controller shutdown: kill everything, marking trials with the
@@ -1015,11 +1032,24 @@ class TrialScheduler:
                         "finalize", exp.name, run_span.trace_id, run_span.span_id
                     )
                 result, observation = self._classify(exp, trial, result)
-                restarted = self._maybe_restart(exp, trial, result)
-                if not restarted:
-                    self._finalize(exp, trial, result, observation)
+                paused = False
+                mf = self._mf()
+                if mf is not None and result.outcome == TrialOutcome.COMPLETED:
+                    # rung-boundary consult (controller/multifidelity.py): a
+                    # multi-fidelity trial that completed its assigned budget
+                    # is PAUSED — checkpoint + observations intact — instead
+                    # of finalized; a promotion resubmits it at the next
+                    # fidelity. Non-asha experiments return False untouched.
+                    try:
+                        paused = mf.on_rung_boundary(exp, trial, observation, self)
+                    except Exception:
+                        log.warning("rung boundary consult failed", exc_info=True)
+                if not paused:
+                    restarted = self._maybe_restart(exp, trial, result)
+                    if not restarted:
+                        self._finalize(exp, trial, result, observation)
                 if fin_span is not None:
-                    tr.end_span(fin_span, restarted=restarted)
+                    tr.end_span(fin_span, restarted=restarted, rung_paused=paused)
         except Exception:
             trial.set_condition(TrialCondition.FAILED, "TrialFailed", traceback.format_exc(limit=5))
             self.state.update_trial(trial)
@@ -1170,6 +1200,31 @@ class TrialScheduler:
                             )
                         continue
                 result, observation = self._classify(exp, trial, result)
+                mf = self._mf()
+                if mf is not None and result.outcome == TrialOutcome.COMPLETED:
+                    # packed bottom rungs hit the same boundary consult as
+                    # solo trials: each member pauses (or promotes)
+                    # independently when the shared program completes
+                    try:
+                        rung_paused = mf.on_rung_boundary(
+                            exp, trial, observation, self
+                        )
+                    except Exception:
+                        rung_paused = False
+                        log.warning("rung boundary consult failed", exc_info=True)
+                    if rung_paused:
+                        with self._lock:
+                            self._checkpoint_dirs.pop(trial.name, None)
+                            self._restarts.pop(trial.name, None)
+                            self._last_checkpoint.pop(trial.name, None)
+                        if gang is not None:
+                            tr.end_span(
+                                gang.members.get(trial.name), outcome="rung-paused"
+                            )
+                            tr.end_span(
+                                member_runs.get(trial.name), rung_paused=True
+                            )
+                        continue
                 restarted = self._maybe_restart(exp, trial, result)
                 if not restarted:
                     self._finalize(exp, trial, result, observation)
@@ -1452,6 +1507,20 @@ class TrialScheduler:
             if self._head_key is not None:
                 self._head_credits += len(devices)
         self._policy.charge(exp.name, len(devices) * elapsed, weight_of(exp))
+        mf = self._mf()
+        if (
+            mf is not None
+            and self.metrics_registry is not None
+            and mf.applies(exp.spec)
+        ):
+            # per-stint device-seconds attribution: every rung stint of a
+            # multi-fidelity sweep charges its gang here, so the bench's
+            # ASHA-vs-flat comparison reads straight off /metrics
+            self.metrics_registry.inc(
+                "katib_multifidelity_device_seconds",
+                value=round(len(devices) * elapsed, 6),
+                experiment=exp.name,
+            )
         self.allocator.release(devices)
 
     def _note_checkpoint(self, trial_name: str) -> None:
@@ -1835,10 +1904,15 @@ class TrialScheduler:
         # trials; failed/killed/metrics-unavailable workdirs are always kept
         # for postmortem (a deviation the reference can't offer — its pods
         # are gone either way).
+        from .multifidelity import PAUSED_LABEL
+
         if (
             not exp.spec.trial_template.retain
             and self.workdir_root
             and trial.condition in (TrialCondition.SUCCEEDED, TrialCondition.EARLY_STOPPED)
+            # a rung-paused trial's workdir holds the checkpoint its
+            # promotion will resume from — never clean it while paused
+            and PAUSED_LABEL not in trial.labels
         ):
             import os
             import shutil

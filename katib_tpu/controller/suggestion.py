@@ -185,6 +185,11 @@ class SuggestionService:
         self._early_stoppers: Dict[str, EarlyStopper] = {}
         self._search_ended: Dict[str, bool] = {}
         self._buffer: Dict[str, _BufferEntry] = {}
+        # TrialsNotCompleted backoff (ISSUE 11 satellite): the signature of
+        # the last consult a rung-cohort algorithm (hyperband) answered
+        # with "wait" — identical state skips the re-consult until a trial
+        # completion (the scheduler wake that drives reconcile) changes it
+        self._consult_backoff: Dict[str, Tuple] = {}
         self._warm: Dict[str, Optional[WarmStartData]] = {}
         self._prefetch_pending: set = set()
         self._prefetch_queue: "queue.Queue[Optional[str]]" = queue.Queue()
@@ -363,7 +368,7 @@ class SuggestionService:
             served.extend(taken)
 
         shortfall = current_request - len(served)
-        if shortfall > 0 and not ended:
+        if shortfall > 0 and not ended and not self._consult_held(exp, trials, suggestion):
             request = SuggestionRequest(
                 experiment=filled,
                 trials=list(trials),
@@ -375,7 +380,17 @@ class SuggestionService:
             try:
                 reply = self.suggester_for(exp).get_suggestions(request)
             except TrialsNotCompleted:
-                reply = SuggestionReply()  # wait: running trials must finish first
+                # wait: running trials must finish first. Remember the state
+                # this consult saw — until a trial completes (the scheduler
+                # wake that re-runs reconcile) or the request changes, every
+                # retry would recompute the same "not yet" through the full
+                # child-bracket consult (spec deep copy, trial sort,
+                # ranking) on each 0.5s reconcile poll for the whole rung.
+                with self._lock:
+                    self._consult_backoff[exp.name] = self._consult_signature(
+                        trials, suggestion
+                    )
+                reply = SuggestionReply()
             except SuggestionFailed:
                 raise
             except Exception as e:
@@ -383,6 +398,9 @@ class SuggestionService:
                 suggestion.message = f"{type(e).__name__}: {e}"
                 self.state.put_suggestion(suggestion)
                 raise SuggestionFailed(suggestion.message) from e
+            else:
+                with self._lock:
+                    self._consult_backoff.pop(exp.name, None)
             self._observe_batch(exp, time.perf_counter() - t0, "inline")
             served.extend(reply.assignments)
             feedback.update(reply.algorithm_settings)
@@ -403,6 +421,29 @@ class SuggestionService:
         if ended:
             self.mark_search_ended(exp.name)
         self.state.put_suggestion(suggestion)
+
+    @staticmethod
+    def _consult_signature(trials: Sequence[Trial], suggestion: SuggestionState) -> Tuple:
+        """What a rung-cohort consult's answer depends on: the demand
+        counters plus every trial's (name, condition). If none of it
+        changed since a TrialsNotCompleted, re-consulting would recompute
+        the identical 'wait'."""
+        return (
+            suggestion.requests,
+            suggestion.suggestion_count,
+            tuple(sorted((t.name, t.condition.value) for t in trials)),
+        )
+
+    def _consult_held(
+        self, exp: Experiment, trials: Sequence[Trial], suggestion: SuggestionState
+    ) -> bool:
+        """True while an identical consult already answered
+        TrialsNotCompleted — the retry is backed off onto the scheduler's
+        existing wake (a trial completion changes the signature and
+        re-opens the consult)."""
+        with self._lock:
+            held = self._consult_backoff.get(exp.name)
+        return held is not None and held == self._consult_signature(trials, suggestion)
 
     def _filled_spec(self, exp: Experiment, settings: Dict[str, str]) -> ExperimentSpec:
         filled = ExperimentSpec.from_json(exp.spec.to_json())
@@ -682,6 +723,7 @@ class SuggestionService:
                 self._early_stoppers.pop(exp.name, None)
         with self._lock:
             self._buffer.pop(exp.name, None)
+            self._consult_backoff.pop(exp.name, None)
 
     def has_suggester(self, experiment_name: str) -> bool:
         """Whether the in-memory algorithm instance is alive (resume-policy
@@ -697,6 +739,7 @@ class SuggestionService:
             self._search_ended.pop(experiment_name, None)
             self._buffer.pop(experiment_name, None)
             self._warm.pop(experiment_name, None)
+            self._consult_backoff.pop(experiment_name, None)
 
     def close(self) -> None:
         """Stop the prefetch worker (if one ever started)."""

@@ -1,0 +1,58 @@
+"""Shared learning-curve readers over the observation store.
+
+One query layer consumed by both early stopping (medianstop's
+first-``start_step`` average) and the multi-fidelity engine's rung
+decisions (controller/multifidelity.py), so the two never duplicate store
+access logic:
+
+- :meth:`ObjectiveCurveReader.head_mean` reads the first k objective
+  reports with the ``limit=`` pushdown (O(k) via the composite
+  (trial, metric, time) index — the medianstop read path, byte-identical
+  to the logic that used to live inline there);
+- :meth:`ObjectiveCurveReader.boundary_value` answers "the objective at
+  this trial's current boundary" from the store's incremental fold index
+  (``store.folded()``, O(metrics) instead of a row scan), applying the
+  objective's metric strategy exactly like trial classification does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.spec import ObjectiveSpec
+from ..db.store import ObservationStore, objective_value
+
+
+class ObjectiveCurveReader:
+    """Objective-metric curve reads for one experiment's objective."""
+
+    def __init__(self, store: ObservationStore, objective: ObjectiveSpec):
+        self.store = store
+        self.objective = objective
+
+    def head_mean(self, trial_name: str, start_step: int) -> Optional[float]:
+        """Arithmetic mean of the trial's first ``start_step`` objective
+        reports; non-numeric values are skipped, None when no numeric value
+        exists (the caller then ignores the trial — medianstop semantics)."""
+        first = self.store.get_observation_log(
+            trial_name,
+            metric_name=self.objective.objective_metric_name,
+            limit=start_step,
+        )
+        values = []
+        for log in first:
+            try:
+                values.append(float(log.value))
+            except ValueError:
+                continue
+        if not values:
+            return None
+        return sum(values) / len(values)
+
+    def boundary_value(self, trial_name: str) -> Optional[float]:
+        """Strategy-selected objective value from the fold index, or None
+        when the trial has no usable objective observation."""
+        obs = self.store.folded(
+            trial_name, [self.objective.objective_metric_name]
+        )
+        return objective_value(obs, self.objective)

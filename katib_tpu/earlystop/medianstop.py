@@ -10,6 +10,10 @@ the per-trial averages (the reference computes a *mean* despite the
 
 Rule *enforcement* lives in katib_tpu.runtime.metrics.EarlyStoppingMonitor,
 mirroring the reference's sidecar (SURVEY.md §2.5).
+
+Curve reads go through the shared :class:`~katib_tpu.earlystop.curves.
+ObjectiveCurveReader` — the same query layer the multi-fidelity engine's
+rung decisions use — so the store-access logic lives in exactly one place.
 """
 
 from __future__ import annotations
@@ -19,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 from ..api.spec import ComparisonType, EarlyStoppingRule, ExperimentSpec, ObjectiveType
 from ..api.status import Trial, TrialCondition
 from ..db.store import ObservationStore
+from .curves import ObjectiveCurveReader
 
 
 class EarlyStopper:
@@ -73,24 +78,17 @@ class MedianStop(EarlyStopper):
             else ComparisonType.GREATER
         )
 
+        # limit pushes the first-start_step read down to the store: with
+        # the composite (trial, metric, time) index this is O(start_step)
+        # instead of a scan of the trial's whole objective history
+        reader = ObjectiveCurveReader(store, experiment.objective)
         for trial in trials:
             if trial.name in self._avg_history or trial.condition != TrialCondition.SUCCEEDED:
                 continue
-            # limit pushes the first-start_step read down to the store: with
-            # the composite (trial, metric, time) index this is O(start_step)
-            # instead of a scan of the trial's whole objective history
-            first = store.get_observation_log(
-                trial.name, metric_name=objective_metric, limit=start_step
-            )
-            values = []
-            for log in first:
-                try:
-                    values.append(float(log.value))
-                except ValueError:
-                    continue
-            if not values:
+            avg = reader.head_mean(trial.name, start_step)
+            if avg is None:
                 continue
-            self._avg_history[trial.name] = sum(values) / len(values)
+            self._avg_history[trial.name] = avg
 
         if len(self._avg_history) >= min_trials:
             aggregate = sum(self._avg_history.values()) / len(self._avg_history)

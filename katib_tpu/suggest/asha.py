@@ -1,0 +1,81 @@
+"""ASHA — asynchronous successive halving, suggester half.
+
+Unlike suggest/hyperband.py (the reference's stateless bracket protocol,
+where child rungs are NEW trials restarted from scratch with a bigger
+budget parameter), ASHA's halving lives in the scheduler: the engine in
+katib_tpu.controller.multifidelity pauses trials at rung boundaries,
+promotes survivors by resuming their checkpoints at the next fidelity, and
+prunes the rest. This suggester therefore has exactly one job — every new
+configuration enters the ladder at the BOTTOM rung: uniform random samples
+over the search space with the budget parameter (``resource_name``) pinned
+to the lowest fidelity. ``maxTrialCount`` is the number of admitted
+configurations; the experiment completes when the ladder drains.
+
+Settings (algorithm_settings):
+- ``resource_name`` (required): the budget parameter — a host-side loop
+  knob like epochs/examples, so rung changes never recompile;
+- ``eta`` (default 3): halving rate;
+- ``min_resource`` / ``max_resource`` (default: the resource parameter's
+  feasible min/max): bottom and top rung budgets;
+- ``random_state`` (optional): sampling seed.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .base import Suggester, SuggestionReply, SuggestionRequest, register
+from ..api.spec import ParameterAssignment, TrialAssignment
+
+
+@register
+class Asha(Suggester):
+    name = "asha"
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        # ladder construction performs the settings validation (shared with
+        # the engine so the two can never disagree about the rungs); lazy
+        # import keeps suggest registration free of controller imports
+        from ..controller.multifidelity import FidelityLadder
+
+        ladder = FidelityLadder.from_spec(experiment)
+        if len(ladder.rungs) < 2:
+            raise ValueError(
+                "asha needs at least two rungs: raise max_resource (or the "
+                "resource parameter's max) above min_resource * eta"
+            )
+        if experiment.max_trial_count is None:
+            raise ValueError(
+                "asha requires maxTrialCount (the number of admitted "
+                "configurations); the experiment completes when the rung "
+                "ladder drains"
+            )
+
+    def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
+        from ..controller.multifidelity import FidelityLadder
+
+        spec = request.experiment
+        ladder = FidelityLadder.from_spec(spec)
+        space = self.search_space(spec)
+        rng = np.random.default_rng(
+            self.seed_from(spec, salt=len(request.trials))
+        )
+        n = max(request.current_request_number, 0)
+        budget = ladder.format(ladder.rungs[0])
+        assignments: List[TrialAssignment] = []
+        for u in space.sample_uniform(rng, n):
+            pa = space.decode(u)
+            pa = [
+                ParameterAssignment(a.name, budget)
+                if a.name == ladder.resource_name
+                else a
+                for a in pa
+            ]
+            assignments.append(
+                TrialAssignment(
+                    name=self.make_trial_name(spec), parameter_assignments=pa
+                )
+            )
+        return SuggestionReply(assignments=assignments)

@@ -40,6 +40,10 @@ echo "== async suggestion pipeline smoke (prefetch buffer vs inline) =="
 JAX_PLATFORMS=cpu python bench.py suggestion_pipeline_latency --smoke
 
 echo
+echo "== multi-fidelity smoke (ASHA rungs vs flat TPE device-epochs) =="
+JAX_PLATFORMS=cpu python bench.py asha_device_seconds --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

@@ -675,6 +675,24 @@ def test_suggestion_pipeline_latency_smoke_integrity(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_asha_device_seconds_smoke_integrity(bench):
+    """--smoke mode of the asha_device_seconds scenario (ISSUE 11): both
+    sweeps complete, promotions fire, and zero observations are lost
+    across promotions (fold-index totals byte-identical to row scans,
+    every epoch curve continuous). The >=5x device-epoch assertion belongs
+    to the full-size run (the smoke ladder is too short for it); smoke
+    pins the wiring and the integrity invariants."""
+    out = bench._bench_asha_device_seconds(smoke=True)
+    assert out["smoke"] is True
+    assert out["configs"] == 9
+    assert out["lost_observations"] == 0
+    assert out["promotions"] > 0
+    assert out["asha_device_epochs"] < out["flat_device_epochs"]
+    assert out["target_reached"] is True
+    assert out["target_ratio"] == 5.0
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""
