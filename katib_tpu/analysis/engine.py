@@ -250,6 +250,7 @@ RULE_SUMMARIES: Dict[str, str] = {
     "KTI301": "TrialPreempted/TrialKilled raised without a preceding flush",
     "KTI302": "metric family or event reason missing from the catalog",
     "KTI303": "RuntimeConfig knob missing from ENV_OVERRIDES",
+    "KTI304": "unbounded jax.devices()/jax.local_devices() probe outside utils/backend.py",
     **KTX_SUMMARIES,
 }
 

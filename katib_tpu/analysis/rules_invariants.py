@@ -20,6 +20,13 @@ exactly the kind an innocent-looking local edit silently breaks:
   settable without shipping a config file (the reference's env-trumps-
   config layering, consts/const.go:93-103); the table is what load_config
   applies, so membership IS the override.
+- **KTI304 unbounded-device-probe** — a direct ``jax.devices()`` /
+  ``jax.local_devices()`` call outside ``utils/backend.py``. The first
+  such call of a process initializes the backend, and on a wedged
+  tunneled runtime it blocks for minutes (the BENCH_r01–r05 loss class);
+  ``utils.backend.bounded_devices`` / ``bounded_local_devices`` wrap the
+  init in a bounded, verdict-cached probe — every unguarded call site
+  re-opens the wedge the device plane (ISSUE 12) exists to close.
 """
 
 from __future__ import annotations
@@ -40,6 +47,7 @@ def check(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
     out += _uncataloged(tree, ctx)
     if ctx.path.endswith("config.py"):
         out += _knob_without_env(tree, ctx)
+    out += _unbounded_device_probe(tree, ctx)
     return sorted(set(out), key=Finding.sort_key)
 
 
@@ -133,6 +141,34 @@ def _uncataloged(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
                         "look it up",
                     )
                 )
+    return out
+
+
+# -- KTI304 ------------------------------------------------------------------
+
+# the one module allowed to touch the raw probes: it IS the bounded wrapper
+DEVICE_PROBE_HOME = "utils/backend.py"
+DEVICE_PROBE_CALLS = ("jax.devices", "jax.local_devices")
+
+
+def _unbounded_device_probe(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    if ctx.path.replace("\\", "/").endswith(DEVICE_PROBE_HOME):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in DEVICE_PROBE_CALLS:
+            out.append(
+                Finding(
+                    ctx.path, node.lineno, "KTI304",
+                    f"direct {name}() call — the first probe of a process "
+                    "can wedge for minutes on a dead backend; use "
+                    "utils.backend.bounded_devices()/bounded_local_devices() "
+                    "(bounded timeout, cached verdict) instead",
+                )
+            )
     return out
 
 

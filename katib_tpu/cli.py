@@ -409,6 +409,63 @@ def cmd_compile(args) -> int:
     return 0
 
 
+def cmd_devices(args) -> int:
+    """Supervised device plane state (ISSUE 12): backend + probe verdict,
+    the free pool, loss/failover counters, and every lease with its holder,
+    state and heartbeat age — read offline from the JSON snapshot the plane
+    persists under <root>/deviceplane/ (same pattern as `katib-tpu
+    compile`)."""
+    import os
+    import time as _time
+
+    from .controller.deviceplane import DevicePlane
+
+    path = os.path.join(args.root, "deviceplane", DevicePlane.STATE_FILE)
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        print(
+            f"no persisted device-plane state under {args.root}/deviceplane "
+            "(did the controller run with runtime.device_plane on and a "
+            "--root?)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"backend: {snap.get('backend', '?')} | "
+        f"probe: {snap.get('probeVerdict', '?')} | "
+        f"free: {snap.get('freeCount', 0)} | "
+        f"lost: {snap.get('lostTotal', 0)} | "
+        f"failovers: {snap.get('failovers', 0)}"
+    )
+    now = _time.time()
+    rows = []
+    for lease in snap.get("leases", []):
+        hb = lease.get("lastHeartbeat") or 0
+        expires = lease.get("expiresAt")
+        rows.append(
+            (
+                str(lease.get("leaseId", "?")),
+                lease.get("holder") or "-",
+                lease.get("state", "?"),
+                str(len(lease.get("devices", []))),
+                str(len(lease.get("lost", []))),
+                str(lease.get("heartbeats", 0)),
+                f"{max(now - hb, 0):.0f}s ago" if hb else "-",
+                f"{expires - now:+.0f}s" if expires else "-",
+            )
+        )
+    _table(
+        ["LEASE", "HOLDER", "STATE", "DEVICES", "LOST", "BEATS",
+         "HEARTBEAT", "EXPIRES"],
+        rows,
+    )
+    if not rows:
+        print("(no leases recorded — nothing has been dispatched yet)")
+    return 0
+
+
 def cmd_population(args) -> int:
     """Fused population sweep view (ISSUE 9): per-generation best/median
     from the ``<experiment>-population`` pseudo-trial rows the fused
@@ -812,6 +869,13 @@ def main(argv=None) -> int:
     me.add_argument("trial")
     me.add_argument("--metric", default=None)
     me.set_defaults(fn=cmd_metrics)
+
+    dv = sub.add_parser(
+        "devices",
+        help="device plane lease/health state (offline, from the "
+             "<root>/deviceplane snapshot)",
+    )
+    dv.set_defaults(fn=cmd_devices)
 
     po = sub.add_parser(
         "population",

@@ -120,6 +120,27 @@ class RuntimeConfig:
     # search-space + objective signature. Opt-in.
     warm_start: bool = False
     warm_start_max_points: int = 256  # cap on transferred observations
+    # Supervised device plane (controller/deviceplane.py, ISSUE 12):
+    # device sets as leased, revocable resources — zombie-lease reclaim,
+    # device-loss-as-preemption, backend failover, chaos injection hooks.
+    # device_plane=false / KATIB_TPU_DEVICE_PLANE=0 restores the legacy
+    # free-list allocator byte-identically.
+    device_plane: bool = True
+    # bounded backend health probe timeout (the BENCH_r01-r05 wedge class)
+    device_probe_timeout_seconds: float = 15.0
+    # periodic backend re-probe on the supervisor tick; 0 = off (probe
+    # only at acquisition)
+    device_reprobe_interval_seconds: float = 0.0
+    # zombie lease TTL: devices held by an abandoned trial are reclaimed
+    # into the pool this many seconds after the kill-grace abandon
+    device_lease_seconds: float = 60.0
+    # lease heartbeat timeout: an ACTIVE lease with no ctx.report heartbeat
+    # for this long is revoked (holder presumed dead). 0 = off — the
+    # telemetry stall watchdog already covers slow-but-alive trials.
+    device_heartbeat_timeout_seconds: float = 0.0
+    # CPU fallback pool when the whole backend dies (a sweep degrades
+    # instead of dying); false pins the sweep to the original backend
+    device_failover: bool = True
     # Native multi-fidelity search (controller/multifidelity.py): ASHA
     # rung ladders as a scheduler citizen — trials pause at rung
     # boundaries with checkpoint + observations intact, survivors resume
@@ -172,6 +193,12 @@ ENV_OVERRIDES: Dict[str, str] = {
     "warm_start": "KATIB_TPU_WARM_START",
     "warm_start_max_points": "KATIB_TPU_WARM_START_MAX_POINTS",
     "multifidelity": "KATIB_TPU_MULTIFIDELITY",
+    "device_plane": "KATIB_TPU_DEVICE_PLANE",
+    "device_probe_timeout_seconds": "KATIB_TPU_DEVICE_PROBE_TIMEOUT_SECONDS",
+    "device_reprobe_interval_seconds": "KATIB_TPU_DEVICE_REPROBE_INTERVAL_SECONDS",
+    "device_lease_seconds": "KATIB_TPU_DEVICE_LEASE_SECONDS",
+    "device_heartbeat_timeout_seconds": "KATIB_TPU_DEVICE_HEARTBEAT_TIMEOUT_SECONDS",
+    "device_failover": "KATIB_TPU_DEVICE_FAILOVER",
 }
 
 _FALSY = ("0", "false", "off")

@@ -352,6 +352,15 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_rung_promotions_total": "Rung-paused trials promoted to the next fidelity (checkpoint-resumed or re-run from scratch).",
     "katib_rung_pruned_total": "Rung-paused trials pruned when the ladder drained (outside the top 1/eta of their rung).",
     "katib_multifidelity_device_seconds": "Device-seconds consumed by multi-fidelity (asha) trial stints, charged at gang release.",
+    # supervised device plane (ISSUE 12, controller/deviceplane.py) — the
+    # DeviceLost / DeviceLeaseRevoked / BackendFailedOver warning events
+    # pair with these series
+    "katib_device_lease_granted_total": "Device leases granted by the supervised device plane (one per gang allocation).",
+    "katib_device_lease_revoked_total": "Leases the plane revoked: expired zombie holds reclaimed or heartbeat-missed holders voided.",
+    "katib_device_lease_active": "Leases currently in ACTIVE state (holders running on their devices).",
+    "katib_device_lease_zombie": "Leases in ZOMBIE state (abandoned holders awaiting reclaim at lease expiry).",
+    "katib_device_lost_total": "Devices removed from custody: probe failures, executor backend errors, chaos revocations.",
+    "katib_backend_failover_total": "Whole-backend failovers (every live device lost; the fallback pool was swapped in).",
 }
 
 
@@ -411,4 +420,8 @@ EVENT_CATALOG: Dict[str, str] = {
     "RungPaused": "Trial completed its rung budget and paused (checkpoint + observations intact) awaiting a promotion decision.",
     "RungPromoted": "Rung-paused trial resubmitted at the next fidelity, resuming its checkpoint (or from scratch if unusable).",
     "RungPruned": "Rung-paused trial finalized early-stopped: outside the top 1/eta of its rung when the ladder drained.",
+    # supervised device plane (ISSUE 12, controller/deviceplane.py)
+    "DeviceLost": "A device left custody (probe failure, heartbeat miss, backend error, or chaos injection); the holding gang preempts.",
+    "DeviceLeaseRevoked": "The plane voided a lease: an expired zombie hold was reclaimed into the pool, or a heartbeat-missed holder was cut off.",
+    "BackendFailedOver": "Every live device of the backend was lost; the fallback pool was swapped in so the sweep degrades instead of dying.",
 }

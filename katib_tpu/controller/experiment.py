@@ -206,6 +206,29 @@ class ExperimentController:
                 ),
             )
             self.compile_service.start()
+        # Supervised device plane (controller/deviceplane.py, ISSUE 12):
+        # device sets as leased, revocable resources with zombie-lease
+        # reclaim, device-loss-as-preemption, backend failover and chaos
+        # hooks. Disabled (runtime.device_plane=false /
+        # KATIB_TPU_DEVICE_PLANE=0) nothing is constructed and the
+        # scheduler's legacy free-list allocator is byte-identical.
+        self.device_plane = None
+        if rt.device_plane:
+            from .deviceplane import DevicePlane
+
+            self.device_plane = DevicePlane(
+                events=self.events,
+                metrics=self.metrics,
+                probe_timeout_seconds=rt.device_probe_timeout_seconds,
+                reprobe_interval_seconds=rt.device_reprobe_interval_seconds,
+                zombie_lease_seconds=rt.device_lease_seconds,
+                heartbeat_timeout_seconds=rt.device_heartbeat_timeout_seconds,
+                failover=rt.device_failover,
+                persist_dir=(
+                    os.path.join(root_dir, "deviceplane") if root_dir else None
+                ),
+            )
+            self.device_plane.start()
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
         self.scheduler = TrialScheduler(
             self.state,
@@ -238,6 +261,7 @@ class ExperimentController:
                 else None
             ),
             multifidelity=self.multifidelity,
+            device_plane=self.device_plane,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -762,5 +786,7 @@ class ExperimentController:
         self.scheduler.join(timeout=10)
         if self.compile_service is not None:
             self.compile_service.stop()
+        if self.device_plane is not None:
+            self.device_plane.stop()
         self.telemetry.stop()
         self.obs_store.close()

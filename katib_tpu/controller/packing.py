@@ -508,9 +508,14 @@ class FusedPopulationExecutor:
             )
             done += length
             # checkpoint BEFORE demux: a preempt mid-demux re-persists the
-            # progress counter; resume replays only unreported generations
+            # progress counter; resume replays only unreported generations.
+            # The notify tells the scheduler every member has a checkpoint,
+            # so a preemption (incl. device loss) requeues them with their
+            # observation logs KEPT — the resumed sweep extends, never
+            # re-reports, and the lineage stays bit-identical.
             if ckdir:
                 pop.save_sweep_checkpoint(ckdir, carry, done, ys_np, 0)
+                ctx.notify_checkpoint(done)
             self._demux(
                 exp, program, ctx, ys_np, start=0,
                 ckdir=ckdir, carry=carry, done=done,

@@ -60,14 +60,34 @@ class TrialEvent:
 
 
 class DeviceAllocator:
-    """All-or-nothing chip allocator over a fixed device list."""
+    """All-or-nothing chip allocator.
 
-    def __init__(self, devices: Sequence[Any]):
+    Legacy shape (``plane=None``): a fixed free list — acquisition and
+    release shuffle devices between the list and the holders, and the pool
+    can never change size. With a supervised device plane attached
+    (controller/deviceplane.py), every gang allocation is a revocable
+    LEASE: the plane tracks holders and heartbeats, reclaims zombie leases
+    on expiry, removes lost devices from custody, and swaps in a failover
+    pool when the backend dies — so ``total``/``free_count`` are live
+    views, not constants. The legacy path is byte-identical when no plane
+    is attached (KATIB_TPU_DEVICE_PLANE=0)."""
+
+    def __init__(self, devices: Sequence[Any], plane=None):
         self._lock = threading.Lock()
-        self._free: List[Any] = list(devices)
-        self.total = len(self._free)
+        self._plane = plane
+        if plane is not None:
+            plane.adopt_pool(devices)
+            self._free = []
+            self._total = len(list(devices))
+        else:
+            self._free: List[Any] = list(devices)
+            self._total = len(self._free)
 
-    def acquire(self, n: int) -> Optional[List[Any]]:
+    def acquire(
+        self, n: int, holder: str = "", experiment: str = ""
+    ) -> Optional[List[Any]]:
+        if self._plane is not None:
+            return self._plane.acquire(n, holder=holder, experiment=experiment)
         with self._lock:
             if n > len(self._free):
                 return None
@@ -75,13 +95,24 @@ class DeviceAllocator:
             return taken
 
     def release(self, devices: Sequence[Any]) -> None:
+        if self._plane is not None:
+            self._plane.release(devices)
+            return
         with self._lock:
             self._free.extend(devices)
 
     @property
     def free_count(self) -> int:
+        if self._plane is not None:
+            return self._plane.free_count
         with self._lock:
             return len(self._free)
+
+    @property
+    def total(self) -> int:
+        if self._plane is not None:
+            return self._plane.total
+        return self._total
 
 
 class TrialScheduler:
@@ -110,6 +141,7 @@ class TrialScheduler:
         population_stream: bool = False,
         suggestion_prefetch: Optional[Callable[[str], None]] = None,
         multifidelity=None,
+        device_plane=None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -129,7 +161,19 @@ class TrialScheduler:
             devices = list(range(8))  # abstract slots when JAX not involved
         if devices_per_host:
             devices = list(devices)[:devices_per_host]
-        self.allocator = DeviceAllocator(devices)
+        # -- supervised device plane (controller/deviceplane.py) -------------
+        # None = disabled: the allocator below runs the legacy free-list
+        # path byte-identically and every consult is one `is None` check
+        self.device_plane = device_plane
+        self.allocator = DeviceAllocator(devices, plane=device_plane)
+        if device_plane is not None:
+            # device loss (probe failure, heartbeat miss, chaos revocation)
+            # converts the holding gang into a checkpoint-preemption; pool
+            # changes (zombie reclaim, failover) re-run the dispatch pass
+            device_plane.set_loss_handler(self._on_devices_lost)
+            device_plane.set_kill_handler(self._chaos_kill_holder)
+            device_plane.set_pool_changed_handler(self._on_pool_changed)
+        self._unit_devices: Dict[str, List[Any]] = {}  # unit key -> gang devices
         self.state = state
         self.obs_store = obs_store
         self.events: "queue.Queue[TrialEvent]" = queue.Queue()
@@ -222,6 +266,69 @@ class TrialScheduler:
         """The multi-fidelity engine, or None when runtime.multifidelity is
         off — same one-check contract as _tr()/_tm()/_cs()."""
         return self.multifidelity
+
+    def _dp(self):
+        """The supervised device plane, or None when runtime.device_plane
+        is off — same one-check contract as _tr()/_tm()/_cs()/_mf()."""
+        return self.device_plane
+
+    def _on_devices_lost(self, devices: Sequence[Any], reason: str) -> None:
+        """Device-plane loss handler (no plane lock held): every running
+        unit holding a lost device converts into a checkpoint-preemption —
+        the cooperative signal first (victims checkpoint-and-yield at their
+        next report through the PR 2/9 freeze machinery), the grace-window
+        kill as escalation. Requeued members resume from their last
+        checkpoint on the surviving devices bit-identically, or re-run
+        clean without one — exactly the fair-share preemption contract."""
+        lost = set(devices)
+        victims = []
+        with self._lock:
+            for key, unit in self._running.items():
+                held = self._unit_devices.get(key, ())
+                if any(d in lost for d in held):
+                    unit.preempt_signaled = True
+                    self._preempting.update(unit.trial_names)
+                    victims.append(unit)
+        for unit in victims:
+            log.warning(
+                "device loss (%s): preempting %s to requeue on surviving "
+                "devices", reason, ",".join(unit.trial_names),
+            )
+            for h in unit.handles:
+                h.preempt()
+            if self.preemption_grace_seconds:
+                timer = threading.Timer(
+                    self.preemption_grace_seconds,
+                    lambda hs=list(unit.handles): [h.kill() for h in hs],
+                )
+                timer.daemon = True
+                timer.start()
+        if not self._shutdown.is_set():
+            self._dispatch()
+
+    def _chaos_kill_holder(self, holder: str) -> None:
+        """Chaos process-kill injection (utils/chaos.py): hard-kill the
+        holding unit, but through the preemption bookkeeping — a chaos
+        kill models an external death, and the trial must requeue and
+        recover exactly like a device-loss victim, not count as a
+        deliberate kill()."""
+        with self._lock:
+            unit = self._running.get(holder)
+            if unit is None:
+                return
+            unit.preempt_signaled = True
+            self._preempting.update(unit.trial_names)
+            handles = list(unit.handles)
+        log.warning("chaos kill injected on %s", holder)
+        for h in handles:
+            h.kill()
+
+    def _on_pool_changed(self) -> None:
+        """Plane hook: devices re-entered the pool outside the normal
+        release path (zombie-lease reclaim, revocation, failover) — run a
+        dispatch pass so waiting gangs pick them up."""
+        if not self._shutdown.is_set():
+            self._dispatch()
 
     def _on_compile_transition(self, key) -> None:
         """CompileService listener (worker thread, no service lock held): a
@@ -638,7 +745,9 @@ class TrialScheduler:
                     if free - reserved < n:
                         leftover.append(e)
                         continue
-                devices = self.allocator.acquire(n)
+                devices = self.allocator.acquire(
+                    n, holder=e.key, experiment=e.exp.name
+                )
                 if devices is None:
                     leftover.append(e)
                     continue
@@ -772,6 +881,7 @@ class TrialScheduler:
                 name=f"trial-pack-{members[0].name}",
                 daemon=True,
             )
+        self._unit_devices[entry.key] = list(devices)
         self._running[entry.key] = RunningUnit(
             key=entry.key,
             experiment=exp.name,
@@ -1007,6 +1117,7 @@ class TrialScheduler:
                     TrialOutcome.FAILED,
                     f"trial exceeded timeout of {self.trial_timeout}s",
                 )
+            result = self._convert_backend_loss(trial, result, devices)
             # Preemption first: a preempted trial is neither classified nor
             # finalized — it requeues as resumable and its next run's fold
             # continues the same observation log (checkpoint resume) or a
@@ -1068,6 +1179,7 @@ class TrialScheduler:
             pop_log_context(log_token)
             with self._lock:
                 self._running.pop(trial.name, None)
+                self._unit_devices.pop(trial.name, None)
                 if not requeued:
                     self._preempting.discard(trial.name)
             if abandoned is not None and abandoned.is_alive():
@@ -1085,6 +1197,26 @@ class TrialScheduler:
                     self._last_checkpoint.pop(trial.name, None)
             self.events.put(TrialEvent(exp.name, trial.name, trial.condition))
             self._dispatch()
+
+    def _report_heartbeat_hook(
+        self, names: Sequence[str], holder: str
+    ) -> Optional[Callable[[], None]]:
+        """Combined per-report liveness hook: telemetry watchdog heartbeats
+        for every member plus the device plane's lease heartbeat for the
+        unit (which is also where scheduled chaos faults fire). None when
+        both subsystems are off, so ctx.report pays one check."""
+        tm, dp = self._tm(), self._dp()
+        if tm is None and dp is None:
+            return None
+
+        def hook(_tm=tm, _dp=dp, _names=tuple(names), _holder=holder):
+            if _tm is not None:
+                for n in _names:
+                    _tm.heartbeat(n)
+            if _dp is not None:
+                _dp.heartbeat(_holder)
+
+        return hook
 
     def _telemetry_finalize(self, tm, trial_name: str, root) -> None:
         """Close one trial's telemetry stint: unregister (persists its
@@ -1165,13 +1297,14 @@ class TrialScheduler:
                 timer.start()
 
             ctx = self._build_pack_context(exp, trials, devices, handles)
-            if tm is not None:
-                # one demuxed report() heartbeats every member — the watchdog
-                # sees the pack's shared step loop, not K separate clocks
-                names = [t.name for t in trials]
-                ctx.on_report = lambda _tm=tm, _names=names: [
-                    _tm.heartbeat(n) for n in _names
-                ]
+            # one demuxed report() heartbeats every member — the watchdog
+            # sees the pack's shared step loop, not K separate clocks — and
+            # ticks the gang's device lease in the plane
+            hook = self._report_heartbeat_hook(
+                [t.name for t in trials], trials[0].name
+            )
+            if hook is not None:
+                ctx.on_report = hook
             if gang is not None:
                 # shared compiled program: compile/steps/flush spans land in
                 # the gang trace under the pack root
@@ -1179,6 +1312,9 @@ class TrialScheduler:
             executor = self._pack_executor(exp, trials)
             results, abandoned = self._execute_pack_bounded(
                 executor, exp, trials, ctx, handles, timed_out
+            )
+            results = self._convert_pack_backend_loss(
+                pack_id, trials, results, devices
             )
             for trial, result in zip(trials, results):
                 if timed_out.is_set() and result.outcome == TrialOutcome.KILLED:
@@ -1263,6 +1399,7 @@ class TrialScheduler:
             pop_log_context(log_token)
             with self._lock:
                 self._running.pop(trials[0].name, None)
+                self._unit_devices.pop(trials[0].name, None)
                 for t in trials:
                     if t.name not in requeued:
                         self._preempting.discard(t.name)
@@ -1410,6 +1547,11 @@ class TrialScheduler:
             devices=list(devices),
             topology=spec.trial_template.resources.topology,
             preempt_events=[h.preempt_event for h in handles],
+            # a fused chunk checkpoint covers EVERY member: stamp them all,
+            # so preempted members requeue as resumable (logs kept)
+            on_checkpoint=lambda step, _names=[t.name for t in trials]: [
+                self._note_checkpoint(n) for n in _names
+            ],
         )
 
     KILL_GRACE_SECONDS = 30.0
@@ -1471,7 +1613,17 @@ class TrialScheduler:
         """Hold the gang allocation of an abandoned (zombie) trial until its
         worker thread actually exits, then release and re-dispatch. The
         zombie keeps burning the chips, so the experiment stays charged (and
-        quota-attributed) until the actual release."""
+        quota-attributed) until the actual release.
+
+        With the device plane attached the hold is a ZOMBIE LEASE, not a
+        bare counter: past runtime.device_lease_seconds the plane reclaims
+        the chips into the pool (DeviceLeaseRevoked) even if the zombie
+        thread never exits — the pre-plane ``_quarantined`` counter counted
+        these devices forever without ever returning them (the ISSUE 12
+        leak). The late-exiting zombie's release is then a no-op."""
+        dp = self._dp()
+        if dp is not None:
+            dp.mark_zombie(devices, holder=trial_name)
         with self._lock:
             self._quarantined += len(devices)
         log.warning(
@@ -1529,6 +1681,80 @@ class TrialScheduler:
         its observation log) only if it checkpointed at all."""
         with self._lock:
             self._last_checkpoint[trial_name] = time.time()
+
+    def _convert_backend_loss(
+        self, trial: Trial, result: ExecutionResult, devices: Sequence[Any]
+    ) -> ExecutionResult:
+        """Device-loss-as-preemption (controller/deviceplane.py): a FAILED
+        result whose traceback carries a backend-death signature
+        (XlaRuntimeError and friends) means the DEVICES died, not the
+        trial's code. The gang's devices are marked lost in the plane (they
+        never return to the pool — and their disappearance can trigger
+        failover), and the result converts to PREEMPTED so the standard
+        requeue machinery resumes the trial on surviving devices from its
+        last checkpoint (or re-runs it clean). No plane, or no signature
+        match: the result passes through untouched."""
+        from . import deviceplane
+
+        dp = self._dp()
+        if (
+            dp is None
+            or result.outcome != TrialOutcome.FAILED
+            or not deviceplane.is_backend_loss(result.message)
+            or not dp.report_executor_failure(trial.name, devices)
+        ):
+            return result
+        with self._lock:
+            self._preempting.add(trial.name)
+        log.warning(
+            "trial %s failed with a backend-death signature; converting to "
+            "a device-loss preemption", trial.name,
+        )
+        return ExecutionResult(
+            TrialOutcome.PREEMPTED,
+            "backend error under the program (device loss); converted to a "
+            "checkpoint-preemption: " + (result.message or "").strip()[-200:],
+        )
+
+    def _convert_pack_backend_loss(
+        self,
+        pack_id: str,
+        trials: List[Trial],
+        results: List[ExecutionResult],
+        devices: Sequence[Any],
+    ) -> List[ExecutionResult]:
+        """Pack counterpart of _convert_backend_loss: one shared program,
+        so one backend-death signature marks the whole gang's devices lost
+        and every member failed by it converts to a preemption (members
+        with their own outcome — killed, early-stopped — keep it)."""
+        from . import deviceplane
+
+        dp = self._dp()
+        if dp is None:
+            return results
+        struck = [
+            i
+            for i, r in enumerate(results)
+            if r.outcome == TrialOutcome.FAILED
+            and deviceplane.is_backend_loss(r.message)
+        ]
+        if not struck or not dp.report_executor_failure(pack_id, devices):
+            return results
+        with self._lock:
+            self._preempting.update(trials[i].name for i in struck)
+        log.warning(
+            "pack %s failed with a backend-death signature; converting %d "
+            "member(s) to device-loss preemptions", pack_id, len(struck),
+        )
+        out = list(results)
+        for i in struck:
+            out[i] = ExecutionResult(
+                TrialOutcome.PREEMPTED,
+                "backend error under the shared program (device loss); "
+                "converted to a checkpoint-preemption: "
+                + (results[i].message or "").strip()[-200:],
+            )
+        return out
 
     def _preempt_applies(self, trial: Trial, result: ExecutionResult) -> bool:
         """Did this trial end because the fair-share policy preempted it?
@@ -1599,11 +1825,16 @@ class TrialScheduler:
         from . import fairshare as fs
 
         now = time.time()
+        dp = self._dp()
         with self._lock:
             waiting = list(self._waiting)
             running = list(self._running.values())
             enq = dict(self._enqueued_at)
-            quarantined = self._quarantined
+            # the plane's count is live (zombie leases leave it when
+            # reclaimed); the legacy counter only drops on thread exit
+            quarantined = (
+                dp.zombie_device_count() if dp is not None else self._quarantined
+            )
             usage = dict(self._usage)
         deficits = self._policy.deficits(sorted({exp.name for exp, _ in waiting}))
         pending = []
@@ -1626,13 +1857,17 @@ class TrialScheduler:
                 }
             )
         pending.sort(key=lambda p: (-p["effectivePriority"], -p["waitSeconds"]))
+        devices_view: Dict[str, Any] = {
+            "total": self.allocator.total,
+            "free": self.allocator.free_count,
+            "quarantined": quarantined,
+            "usageByExperiment": usage,
+        }
+        if dp is not None:
+            devices_view["backend"] = dp.backend
+            devices_view["lostTotal"] = dp.snapshot()["lostTotal"]
         return {
-            "devices": {
-                "total": self.allocator.total,
-                "free": self.allocator.free_count,
-                "quarantined": quarantined,
-                "usageByExperiment": usage,
-            },
+            "devices": devices_view,
             "pending": pending,
             "running": [
                 {
@@ -1650,6 +1885,9 @@ class TrialScheduler:
 
     @property
     def quarantined_count(self) -> int:
+        dp = self._dp()
+        if dp is not None:
+            return dp.zombie_device_count()
         with self._lock:
             return self._quarantined
 
@@ -1725,12 +1963,10 @@ class TrialScheduler:
             topology=spec.trial_template.resources.topology,
             on_checkpoint=lambda step, _t=trial.name: self._note_checkpoint(_t),
             # telemetry hooks (None when off — ctx.report pays one check):
-            # every report is a watchdog heartbeat; subprocess executors
-            # re-point /proc sampling at the child pids they spawn
-            on_report=(
-                (lambda _t=trial.name, _tm=tm: _tm.heartbeat(_t))
-                if tm is not None else None
-            ),
+            # every report is a watchdog heartbeat AND a device-lease
+            # heartbeat; subprocess executors re-point /proc sampling at
+            # the child pids they spawn
+            on_report=self._report_heartbeat_hook([trial.name], trial.name),
             on_subprocess=(
                 (lambda pids, _t=trial.name, _tm=tm: _tm.set_pids(_t, pids))
                 if tm is not None else None

@@ -45,7 +45,14 @@ def _dot_precision(dtype):
 
 def _on_tpu() -> bool:
     try:
-        d = jax.devices()[0]
+        from ..utils.backend import bounded_devices
+
+        # bounded probe (KTI304): kernel-vs-interpret dispatch on a wedged
+        # backend degrades to the dense path instead of hanging
+        devices = bounded_devices()
+        if not devices:
+            return False
+        d = devices[0]
         return "tpu" in d.platform.lower() or "TPU" in getattr(d, "device_kind", "")
     except Exception:
         return False

@@ -87,11 +87,15 @@ def make_mesh(
     rides the fastest ICI links, and ``pipe``/``data`` outermost (DCN-friendly)
     — the standard TPU layout.
     """
-    import jax
     from jax.sharding import Mesh
 
     if devices is None:
-        devices = jax.devices()
+        from ..utils.backend import require_devices
+
+        # bounded probe with cached verdict (utils/backend.py): mesh
+        # construction on a wedged backend raises fast instead of blocking
+        # the caller for minutes (KTI304)
+        devices = require_devices()
     n = len(devices)
     sizes = {"pipe": pipe, "data": data, "fsdp": fsdp, "expert": expert, "seq": seq, "model": model}
     fixed = 1

@@ -27,6 +27,16 @@ def lm_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
 
 
+def _default_device():
+    """First device of the initialized backend, through the bounded probe
+    (utils/backend.py — KTI304): inside a train-step builder the backend is
+    normally already up, so this is one cached-verdict check and a direct
+    call; on a wedged backend it raises fast instead of hanging the trial."""
+    from ..utils.backend import require_devices
+
+    return require_devices()[0]
+
+
 def make_lm_train_step(
     config: TransformerConfig,
     mesh,
@@ -60,7 +70,7 @@ def make_lm_train_step(
         # params are created globally sharded below, not materialized here)
         params = jitted_init(
             model, jax.random.PRNGKey(seed), sample_tokens,
-            device=target_device if single_device else jax.devices()[0],
+            device=target_device if single_device else _default_device(),
         )
 
     tx = optax.adamw(learning_rate, weight_decay=0.01)
@@ -114,7 +124,7 @@ def make_lm_train_step(
         target_device
         if single_device
         and target_device is not None
-        and target_device != jax.devices()[0]
+        and target_device != _default_device()
         else None
     )
 

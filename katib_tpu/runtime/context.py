@@ -175,9 +175,11 @@ class TrialContext:
 
         devices = self.jax_devices()
         if not devices:
-            import jax
+            from ..utils.backend import require_devices
 
-            devices = jax.devices()
+            # bounded probe, not a raw jax.devices(): a trial building a
+            # mesh on a wedged backend must fail fast, not hang (KTI304)
+            devices = require_devices()
         arr = np.array(devices)
         if shape is None and self.topology and len(axis_names) > 1:
             from ..api.spec import parse_topology

@@ -63,10 +63,12 @@ class WorkerContext:
 
     def jax_devices(self) -> List[Any]:
         """ALL devices of the gang's distributed system (global view — the
-        single-process TrialContext returns the gang-allocated subset)."""
-        import jax
+        single-process TrialContext returns the gang-allocated subset).
+        Bounded probe (utils/backend.py): a worker on a wedged backend
+        fails fast instead of hanging the whole gang (KTI304)."""
+        from ..utils.backend import require_devices
 
-        return list(jax.devices())
+        return list(require_devices())
 
     def mesh(self, axis_names=("data",), shape=None):
         import numpy as np

@@ -121,6 +121,19 @@ class PackedTrialContext:
     # telemetry heartbeat (telemetry.py): the scheduler binds a callback that
     # heartbeats every member — one shared step loop, one watchdog clock
     on_report: Optional[Any] = None
+    # scheduler checkpoint hook, mirroring TrialContext.on_checkpoint: the
+    # fused population runtime calls it at every chunk-boundary carry save
+    # so the scheduler records a checkpoint for EVERY member — a preempted
+    # (device-lost) member then requeues with its observation log KEPT and
+    # the resumed sweep replays only the unreported tail. Without the stamp
+    # the requeue path would classify members as checkpoint-less and drop
+    # their rows (they'd never be re-reported: the sweep checkpoint's
+    # ``reported`` counter is already past them).
+    on_checkpoint: Optional[Any] = None
+
+    def notify_checkpoint(self, step: int = 0) -> None:
+        if self.on_checkpoint is not None:
+            self.on_checkpoint(step)
 
     def __post_init__(self) -> None:
         k = len(self.trial_names)

@@ -82,8 +82,20 @@ def bounded_local_devices(
         except Exception:
             return None  # initialized backend lost mid-process; don't re-wedge
 
+    from . import chaos
+
     last_error = "?"
     for attempt in range(max(int(retries), 1)):
+        plan = chaos.active()
+        if plan is not None and plan.take_probe_wedge():
+            # injected wedge (utils/chaos.py): behave exactly like a probe
+            # that hung past its bounded timeout — same error string, same
+            # cached-verdict consequences — without burning the wall clock
+            last_error = (
+                f"probe hung past {timeout_seconds:.0f}s "
+                f"(attempt {attempt + 1}; chaos-injected wedge)"
+            )
+            continue
         box: dict = {}
 
         def _probe():
@@ -111,3 +123,53 @@ def bounded_local_devices(
         _BACKEND_OK = False
     _emit_failed(events, last_error)
     return None
+
+
+def bounded_devices(
+    timeout_seconds: float = 15.0,
+    retries: int = 2,
+    events=None,
+) -> Optional[List[Any]]:
+    """``jax.devices()`` (the global view) behind the same bounded first
+    init and cached verdict as :func:`bounded_local_devices`.
+
+    This is the one sanctioned route to the global device list — the
+    analyzer's KTI304 rule flags direct ``jax.devices()`` /
+    ``jax.local_devices()`` calls outside this module, because every
+    unguarded call site re-opens the BENCH_r01–r05 wedge class (the first
+    probe of a process can hang for minutes on a dead tunnel). Returns None
+    when the backend cannot be probed."""
+    if bounded_local_devices(timeout_seconds, retries, events=events) is None:
+        return None
+    import jax
+
+    try:
+        return jax.devices()
+    except Exception:
+        return None  # backend lost between the probe and this call
+
+
+def require_devices(
+    timeout_seconds: float = 15.0,
+    retries: int = 2,
+    events=None,
+) -> List[Any]:
+    """:func:`bounded_devices` that raises instead of returning None — for
+    call sites (mesh construction, worker bootstrap) that cannot proceed
+    without a backend. The raise is loud and immediate; the legacy direct
+    call would have hung the caller on a wedged tunnel instead."""
+    devices = bounded_devices(timeout_seconds, retries, events=events)
+    if not devices:
+        raise RuntimeError(
+            "accelerator backend unavailable: bounded probe failed or wedged "
+            "(see the BackendInitFailed event for the first failure's reason)"
+        )
+    return devices
+
+
+def probe_verdict() -> Optional[bool]:
+    """The cached process-wide backend verdict: True (healthy), False
+    (wedged/dead — every probe call short-circuits to None), or None (not
+    yet probed). Read-only view for the device plane's health snapshot."""
+    with _state_lock:
+        return _BACKEND_OK

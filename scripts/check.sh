@@ -44,6 +44,10 @@ echo "== multi-fidelity smoke (ASHA rungs vs flat TPE device-epochs) =="
 JAX_PLATFORMS=cpu python bench.py asha_device_seconds --smoke
 
 echo
+echo "== device-plane chaos smoke (seeded wedged probe + mid-sweep revocations, zero lost observations) =="
+JAX_PLATFORMS=cpu python bench.py device_chaos_recovery --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

@@ -693,6 +693,26 @@ def test_asha_device_seconds_smoke_integrity(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_device_chaos_recovery_smoke_integrity(bench):
+    """--smoke mode of the device_chaos_recovery scenario (ISSUE 12): the
+    chaos run (1 wedged probe + 2 device revocations) completes with zero
+    lost observations, preempted trials resume to success bit-identically,
+    and the wedged probe costs a bounded attempt — never the round. The
+    1.5x wall-clock ceiling belongs to the full-size run; smoke pins the
+    wiring and the integrity invariants."""
+    out = bench._bench_device_chaos_recovery(smoke=True)
+    assert out["smoke"] is True
+    assert out["trials"] == 8
+    assert out["lost_observations"] == 0
+    assert out["trials_preempted"] >= 1
+    assert out["bit_identical"] is True
+    assert out["device_lost_events"] >= 2
+    assert out["probe_seconds"] < 10.0
+    assert out["free_devices_after_chaos"] == out["devices"] - 2
+    assert out["target_ratio"] == 1.5
+    assert isinstance(out["within_target"], bool)
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

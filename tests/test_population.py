@@ -494,6 +494,61 @@ class TestChunkBoundaryPreemption:
         # the finished sweep cleared its carry checkpoint
         assert not os.path.exists(os.path.join(ckdir, pop.CARRY_FILE))
 
+    def test_device_revocation_mid_sweep_resumes_bit_identically(self, tmp_path):
+        """ISSUE 12 satellite: a fused-population gang whose device is
+        revoked mid-demux (chaos-scheduled on the lease's heartbeat, i.e.
+        inside the demux of the second chunk) must convert to a
+        checkpoint-preemption, requeue every member with its observation
+        log KEPT, and resume from the chunk-boundary carry checkpoint on
+        the surviving devices — the full controller path this time, with
+        the combined per-member rows bit-identical to a fault-free run."""
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.utils import chaos
+
+        def run_once(root, plan):
+            chaos.install(plan)
+            cfg = KatibConfig()
+            cfg.runtime.telemetry = False
+            cfg.runtime.compile_service = False
+            cfg.runtime.population_chunk_generations = 2
+            cfg.runtime.preemption_grace_seconds = 5.0
+            c = ExperimentController(
+                root_dir=root, devices=list(range(4)), config=cfg
+            )
+            try:
+                spec = _pbt_spec("pf-revoke", generations=6, population=5)
+                c.create_experiment(spec)
+                exp = c.run("pf-revoke", timeout=180)
+                assert exp.status.is_succeeded, exp.status.message
+                rows = {
+                    t.name: [
+                        l.value for l in c.obs_store.get_observation_log(t.name)
+                    ]
+                    for t in c.state.list_trials("pf-revoke")
+                }
+                events = [e.reason for e in c.events.list_all()]
+                return rows, events, c.scheduler.allocator.total
+            finally:
+                c.close()
+                chaos.install(None)
+
+        reference, _, _ = run_once(str(tmp_path / "ref"), None)
+        assert all(len(v) == 6 for v in reference.values())
+
+        # chaos: the fused gang is lease grant #1; revoke one of its
+        # devices at its 3rd heartbeat = while the 2nd chunk's rows demux
+        plan = chaos.parse_plan("seed=2;revoke=1@3")
+        rows, events, total = run_once(str(tmp_path / "chaos"), plan)
+        assert "DeviceLost" in events
+        assert "TrialPreempted" in events, events
+        # every member requeued and resumed: two pack formations
+        assert events.count("PackFormed") == 2
+        # the revoked device never returned to the pool
+        assert total == 3
+        # bit-identical lineage: kept rows + replayed tail + continued key
+        # stream reproduce the fault-free run exactly
+        assert rows == reference
+
     def test_pack_short_one_member_freezes_that_slot(self, tmp_path):
         """A member killed while still PENDING leaves the formed pack one
         short of the program's K: its population slot freezes at the first
