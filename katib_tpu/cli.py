@@ -523,13 +523,14 @@ def cmd_population(args) -> int:
 
 
 def cmd_rungs(args) -> int:
-    """Multi-fidelity ladder view (ISSUE 11): per-rung budget, population,
-    running/paused/promoted/pruned/succeeded counts and best objective,
-    rebuilt offline from the persisted trial records (rung labels) and the
-    observation store — no live controller needed."""
+    """Multi-fidelity ladder view (ISSUE 11 + 13): per-bracket, per-rung
+    budget, population, running/paused/promoted/pruned/succeeded counts and
+    best objective, rebuilt offline from the persisted trial records
+    (rung/bracket labels) and the observation store — no live controller
+    needed. ``--format json`` dumps the full report for scripting."""
     import os
 
-    from .controller.multifidelity import ALGORITHM_NAME, ladder_report
+    from .controller.multifidelity import ENGINE_ALGORITHMS, ladder_report
     from .db.state import ExperimentStateStore
     from .db.store import open_store
 
@@ -538,11 +539,11 @@ def cmd_rungs(args) -> int:
     if exp is None:
         print(f"experiment {args.experiment!r} not found under {args.root}", file=sys.stderr)
         return 1
-    if exp.spec.algorithm.algorithm_name != ALGORITHM_NAME:
+    if exp.spec.algorithm.algorithm_name not in ENGINE_ALGORITHMS:
         print(
             f"experiment {args.experiment!r} uses algorithm "
-            f"{exp.spec.algorithm.algorithm_name!r}, not {ALGORITHM_NAME!r} "
-            "(no rung ladder)",
+            f"{exp.spec.algorithm.algorithm_name!r}, not one of "
+            f"{sorted(ENGINE_ALGORITHMS)} (no rung ladder)",
             file=sys.stderr,
         )
         return 1
@@ -554,29 +555,45 @@ def cmd_rungs(args) -> int:
         )
     finally:
         store.close()
+    if getattr(args, "format", "table") == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
     print(
         f"experiment {report['experiment']}: resource={report['resource']} "
         f"eta={report['eta']}"
-    )
-    rows = [
-        (
-            str(r["rung"]),
-            r["budget"],
-            str(r["population"]),
-            str(r["running"]),
-            str(r["paused"]),
-            str(r["promoted"]),
-            str(r["pruned"]),
-            str(r["succeeded"]),
-            "-" if r["best"] is None else f"{r['best']:.6g}",
+        + (
+            f" brackets={report['n_brackets']}"
+            if report["n_brackets"] > 1
+            else ""
         )
-        for r in report["rungs"]
-    ]
-    _table(
-        ["RUNG", "BUDGET", "POPULATION", "RUNNING", "PAUSED", "PROMOTED",
-         "PRUNED", "SUCCEEDED", "BEST"],
-        rows,
     )
+    for section in report["brackets"]:
+        if report["n_brackets"] > 1:
+            print(
+                f"bracket {section['bracket']}: "
+                f"min_resource={section['min_resource']} "
+                f"max_resource={section['max_resource']} "
+                f"({section['n_rungs']} rungs)"
+            )
+        rows = [
+            (
+                str(r["rung"]),
+                r["budget"],
+                str(r["population"]),
+                str(r["running"]),
+                str(r["paused"]),
+                str(r["promoted"]),
+                str(r["pruned"]),
+                str(r["succeeded"]),
+                "-" if r["best"] is None else f"{r['best']:.6g}",
+            )
+            for r in section["rungs"]
+        ]
+        _table(
+            ["RUNG", "BUDGET", "POPULATION", "RUNNING", "PAUSED", "PROMOTED",
+             "PRUNED", "SUCCEEDED", "BEST"],
+            rows,
+        )
     return 0
 
 
@@ -859,10 +876,17 @@ def main(argv=None) -> int:
 
     rg = sub.add_parser(
         "rungs",
-        help="multi-fidelity ladder: per-rung population, paused/promoted/"
-        "pruned counts and best objective (offline from the state root)",
+        help="multi-fidelity ladder: per-bracket, per-rung population, "
+        "paused/promoted/pruned counts and best objective (offline from "
+        "the state root)",
     )
     rg.add_argument("experiment")
+    rg.add_argument(
+        "--format",
+        choices=("table", "json"),
+        default="table",
+        help="table (default) or the full report as JSON for scripting",
+    )
     rg.set_defaults(fn=cmd_rungs)
 
     me = sub.add_parser("metrics", help="raw observation log for a trial")

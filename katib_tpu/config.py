@@ -149,6 +149,14 @@ class RuntimeConfig:
     # engine entirely (asha specs are then rejected at admission) and
     # leaves the legacy stateless hyperband path byte-identical.
     multifidelity: bool = True
+    # Dwell-window promotion packing (ISSUE 13): same-rung promotions
+    # accumulate for up to this many seconds and are resubmitted under one
+    # dispatch barrier, so rung 1+ dispatches as vmapped packs instead of
+    # trickling out one trial at a time. A drain rule flushes immediately
+    # when nothing is running (the last stragglers never wait out the
+    # window). 0 (default) = promotions submit at the decision point,
+    # byte-identical to the PR 11 behavior.
+    promotion_dwell_seconds: float = 0.0
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -193,6 +201,7 @@ ENV_OVERRIDES: Dict[str, str] = {
     "warm_start": "KATIB_TPU_WARM_START",
     "warm_start_max_points": "KATIB_TPU_WARM_START_MAX_POINTS",
     "multifidelity": "KATIB_TPU_MULTIFIDELITY",
+    "promotion_dwell_seconds": "KATIB_TPU_PROMOTION_DWELL_SECONDS",
     "device_plane": "KATIB_TPU_DEVICE_PLANE",
     "device_probe_timeout_seconds": "KATIB_TPU_DEVICE_PROBE_TIMEOUT_SECONDS",
     "device_reprobe_interval_seconds": "KATIB_TPU_DEVICE_REPROBE_INTERVAL_SECONDS",

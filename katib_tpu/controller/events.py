@@ -351,7 +351,10 @@ _HELP_CATALOG: Dict[str, str] = {
     # with these series
     "katib_rung_promotions_total": "Rung-paused trials promoted to the next fidelity (checkpoint-resumed or re-run from scratch).",
     "katib_rung_pruned_total": "Rung-paused trials pruned when the ladder drained (outside the top 1/eta of their rung).",
-    "katib_multifidelity_device_seconds": "Device-seconds consumed by multi-fidelity (asha) trial stints, charged at gang release.",
+    "katib_multifidelity_device_seconds": "Device-seconds consumed by multi-fidelity (asha/bohb) trial stints, charged at gang release.",
+    # model-based multi-fidelity + dwell-window promotion packing (ISSUE 13)
+    "katib_bracket_active": "Hyperband brackets that still hold rung-paused or dwell-pending trials, per experiment.",
+    "katib_promotion_pack_size": "Size of the most recent dwell-batched promotion resubmission (rung 1+ pack seed).",
     # supervised device plane (ISSUE 12, controller/deviceplane.py) — the
     # DeviceLost / DeviceLeaseRevoked / BackendFailedOver warning events
     # pair with these series
@@ -420,6 +423,8 @@ EVENT_CATALOG: Dict[str, str] = {
     "RungPaused": "Trial completed its rung budget and paused (checkpoint + observations intact) awaiting a promotion decision.",
     "RungPromoted": "Rung-paused trial resubmitted at the next fidelity, resuming its checkpoint (or from scratch if unusable).",
     "RungPruned": "Rung-paused trial finalized early-stopped: outside the top 1/eta of its rung when the ladder drained.",
+    # model-based multi-fidelity (ISSUE 13, controller/multifidelity.py)
+    "PromotionBatched": "Same-ladder promotions accumulated under the dwell window were resubmitted as one batch so rung 1+ dispatches as vmapped packs.",
     # supervised device plane (ISSUE 12, controller/deviceplane.py)
     "DeviceLost": "A device left custody (probe failure, heartbeat miss, backend error, or chaos injection); the holding gang preempts.",
     "DeviceLeaseRevoked": "The plane voided a lease: an expired zombie hold was reclaimed into the pool, or a heartbeat-missed holder was cut off.",

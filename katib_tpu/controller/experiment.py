@@ -182,6 +182,11 @@ class ExperimentController:
                 self.obs_store,
                 events=self.events,
                 metrics=self.metrics,
+                # dwell-window promotion packing (ISSUE 13): same-rung
+                # promotions batch under one dispatch barrier so rung 1+
+                # dispatches as vmapped packs; 0 = submit at the decision
+                # point, byte-identical to PR 11
+                dwell_seconds=rt.promotion_dwell_seconds,
             )
         self._completed_seen: set = set()
         self._closed = threading.Event()
@@ -275,15 +280,15 @@ class ExperimentController:
             known_algorithms=registered_algorithms(),
             known_early_stopping=registered_early_stoppers(),
         )
-        from .multifidelity import ALGORITHM_NAME as MF_ALGORITHM
+        from .multifidelity import ENGINE_ALGORITHMS
 
-        if spec.algorithm.algorithm_name == MF_ALGORITHM and self.multifidelity is None:
+        if spec.algorithm.algorithm_name in ENGINE_ALGORITHMS and self.multifidelity is None:
             from ..api.validation import ValidationError
 
             raise ValidationError(
                 [
-                    "algorithm 'asha' requires the multi-fidelity engine: "
-                    "set runtime.multifidelity=true "
+                    f"algorithm {spec.algorithm.algorithm_name!r} requires "
+                    "the multi-fidelity engine: set runtime.multifidelity=true "
                     "(KATIB_TPU_MULTIFIDELITY=1)"
                 ]
             )

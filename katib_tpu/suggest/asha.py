@@ -6,10 +6,19 @@ budget parameter), ASHA's halving lives in the scheduler: the engine in
 katib_tpu.controller.multifidelity pauses trials at rung boundaries,
 promotes survivors by resuming their checkpoints at the next fidelity, and
 prunes the rest. This suggester therefore has exactly one job — every new
-configuration enters the ladder at the BOTTOM rung: uniform random samples
-over the search space with the budget parameter (``resource_name``) pinned
-to the lowest fidelity. ``maxTrialCount`` is the number of admitted
-configurations; the experiment completes when the ladder drains.
+configuration enters its bracket's ladder at the BOTTOM rung: uniform
+random samples over the search space with the budget parameter
+(``resource_name``) pinned to the bracket's lowest fidelity.
+``maxTrialCount`` is the number of admitted configurations; the experiment
+completes when the ladders drain.
+
+Multi-bracket Hyperband (ISSUE 13): the ``brackets`` setting builds B
+ladders with staggered ``min_resource`` (bracket b bottoms out at base
+rung b); new configurations are assigned round-robin by remaining
+per-bracket admission budget (multifidelity.assign_brackets) and stamped
+with the persisted bracket label. ``brackets=1`` (the default) keeps the
+PR 11 single-ladder behavior byte-identical — same rng stream, same
+assignments, no labels.
 
 Settings (algorithm_settings):
 - ``resource_name`` (required): the budget parameter — a host-side loop
@@ -17,17 +26,19 @@ Settings (algorithm_settings):
 - ``eta`` (default 3): halving rate;
 - ``min_resource`` / ``max_resource`` (default: the resource parameter's
   feasible min/max): bottom and top rung budgets;
+- ``brackets`` (default 1): hyperband-style bracket count;
 - ``random_state`` (optional): sampling seed.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Sequence
 
 import numpy as np
 
 from .base import Suggester, SuggestionReply, SuggestionRequest, register
 from ..api.spec import ParameterAssignment, TrialAssignment
+from .internal.search_space import SearchSpace
 
 
 @register
@@ -38,34 +49,54 @@ class Asha(Suggester):
         # ladder construction performs the settings validation (shared with
         # the engine so the two can never disagree about the rungs); lazy
         # import keeps suggest registration free of controller imports
-        from ..controller.multifidelity import FidelityLadder
+        from ..controller.multifidelity import FidelityLadder, bracket_count
 
         ladder = FidelityLadder.from_spec(experiment)
         if len(ladder.rungs) < 2:
             raise ValueError(
-                "asha needs at least two rungs: raise max_resource (or the "
-                "resource parameter's max) above min_resource * eta"
+                f"{self.name} needs at least two rungs: raise max_resource "
+                "(or the resource parameter's max) above min_resource * eta"
+            )
+        raw = self.settings(experiment).get("brackets", "1")
+        try:
+            brackets = int(float(raw))
+        except ValueError:
+            raise ValueError(f"brackets must be an integer, got {raw!r}")
+        if brackets < 1:
+            raise ValueError("brackets must be a positive integer")
+        if brackets > len(ladder.rungs) - 1:
+            raise ValueError(
+                f"brackets ({brackets}) exceeds the ladder: every bracket "
+                f"needs at least two rungs and the base ladder has "
+                f"{len(ladder.rungs)} ({bracket_count(experiment)} requested)"
             )
         if experiment.max_trial_count is None:
             raise ValueError(
-                "asha requires maxTrialCount (the number of admitted "
+                f"{self.name} requires maxTrialCount (the number of admitted "
                 "configurations); the experiment completes when the rung "
                 "ladder drains"
             )
 
     def get_suggestions(self, request: SuggestionRequest) -> SuggestionReply:
-        from ..controller.multifidelity import FidelityLadder
+        from ..controller.multifidelity import (
+            BRACKET_LABEL,
+            assign_brackets,
+            bracket_ladders,
+        )
 
         spec = request.experiment
-        ladder = FidelityLadder.from_spec(spec)
+        ladders = bracket_ladders(spec)
         space = self.search_space(spec)
         rng = np.random.default_rng(
             self.seed_from(spec, salt=len(request.trials))
         )
         n = max(request.current_request_number, 0)
-        budget = ladder.format(ladder.rungs[0])
+        units = self._sample_units(request, space, ladders, rng, n)
+        bracket_ids = assign_brackets(spec, request.trials, ladders, n)
         assignments: List[TrialAssignment] = []
-        for u in space.sample_uniform(rng, n):
+        for u, b in zip(units, bracket_ids):
+            ladder = ladders[b]
+            budget = ladder.format(ladder.rungs[0])
             pa = space.decode(u)
             pa = [
                 ParameterAssignment(a.name, budget)
@@ -73,9 +104,26 @@ class Asha(Suggester):
                 else a
                 for a in pa
             ]
+            labels = {BRACKET_LABEL: str(b)} if len(ladders) > 1 else {}
             assignments.append(
                 TrialAssignment(
-                    name=self.make_trial_name(spec), parameter_assignments=pa
+                    name=self.make_trial_name(spec),
+                    parameter_assignments=pa,
+                    labels=labels,
                 )
             )
         return SuggestionReply(assignments=assignments)
+
+    def _sample_units(
+        self,
+        request: SuggestionRequest,
+        space: SearchSpace,
+        ladders: Sequence,
+        rng: np.random.Generator,
+        n: int,
+    ) -> np.ndarray:
+        """Unit-cube points for ``n`` new admissions. ASHA samples
+        uniformly — one ``rng.random((n, D))`` call, exactly the PR 11 rng
+        stream; BOHB (suggest/bohb.py) overrides this with the per-rung
+        KDE model."""
+        return space.sample_uniform(rng, n)

@@ -162,5 +162,5 @@ def _ensure_builtins() -> None:
         return
     _BUILTINS_LOADED = True
     # Import for registration side effects.
-    from . import random_search, grid, tpe, bayesopt, cmaes, sobol, hyperband, asha, pbt  # noqa: F401
+    from . import random_search, grid, tpe, bayesopt, cmaes, sobol, hyperband, asha, bohb, pbt  # noqa: F401
     from .nas import darts, enas  # noqa: F401

@@ -1,0 +1,258 @@
+"""BOHB — model-based multi-fidelity search (Falkner et al., 2018).
+
+BOHB replaces Hyperband's uniform bottom-rung sampling with a TPE-style
+Parzen (KDE) model, keeping Hyperband's bracket scheduling and halving
+untouched. Here the halving and brackets live in the scheduler-side engine
+(controller/multifidelity.py), so this suggester is exactly the ASHA
+suggester with one override: :meth:`_sample_units` fits the model and
+samples new configurations from it.
+
+Model-selection rule (the BOHB paper's, over the fold index):
+
+- group every terminal trial by the **base-ladder rung** of its current
+  budget (a bracket-b bottom-rung trial and a bracket-0 trial promoted to
+  rung b trained to the same budget, so they share a rung model);
+- the HIGHEST rung with at least ``d + 2`` observations wins (d = the
+  number of non-resource search dimensions) — fidelity beats quantity;
+- warm-start history (PR 10 ``experiment_history`` index, passed by the
+  suggestion service as ``request.warm_start``) counts as rung-0
+  pseudo-observations, so a matching completed experiment arms the model
+  from the very first batch;
+- with no rung qualifying, sampling is uniform — byte-identical to ASHA's
+  cold start.
+
+Sampling: the winning rung's observations split at the ``gamma`` quantile
+into good/bad Parzen sets (the TPE math, multivariate/joint ranking as in
+the BOHB paper); candidates are drawn from the good KDE and ranked by
+l(x)/g(x), with a constant-liar append so one batch spreads out. A
+``random_fraction`` of picks (default 1/3, the paper's rho) stays uniform
+so the model can never starve exploration. The budget axis is EXCLUDED
+from the model (it is pinned to the bracket's bottom rung, not searched).
+
+The heavy scoring runs through the PR 10 vectorized suggestion plane
+(suggest/vectorized.tpe_batch — one jitted scan for the whole batch); the
+NumPy loop below is the bit-compatible oracle, and the same host rng call
+order in both paths keeps selections identical (the parity contract
+tests/test_bohb.py asserts through the vectorized plane).
+
+Settings: everything ASHA takes (resource_name, eta, min_resource,
+max_resource, brackets, random_state) plus ``gamma`` (default 0.25),
+``n_ei_candidates`` (default 24) and ``random_fraction`` (default 1/3).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import vectorized
+from .asha import Asha
+from .base import SuggestionRequest, register
+from .internal.search_space import MIN_GOAL, SearchSpace
+from .tpe import _kde_logpdf, _sample_from_kernels, _split_observations
+from ..api.status import TrialCondition
+
+log = logging.getLogger("katib_tpu.bohb")
+
+DEFAULT_RANDOM_FRACTION = 1.0 / 3.0
+
+
+@register
+class Bohb(Asha):
+    name = "bohb"
+
+    # BOHB's model threshold: a rung qualifies with d + MIN_POINTS_MARGIN
+    # observations (d = non-resource dimensions), the paper's |D_b| >= d+2
+    MIN_POINTS_MARGIN = 2
+
+    def validate_algorithm_settings(self, experiment) -> None:
+        super().validate_algorithm_settings(experiment)
+        s = self.settings(experiment)
+        if "gamma" in s and not (0.0 < float(s["gamma"]) < 1.0):
+            raise ValueError("gamma must be in (0, 1)")
+        if "n_ei_candidates" in s and int(s["n_ei_candidates"]) < 1:
+            raise ValueError("n_ei_candidates must be >= 1")
+        if "random_fraction" in s and not (0.0 <= float(s["random_fraction"]) <= 1.0):
+            raise ValueError("random_fraction must be in [0, 1]")
+
+    # -- model-based bottom-rung sampling ------------------------------------
+
+    def _sample_units(
+        self,
+        request: SuggestionRequest,
+        space: SearchSpace,
+        ladders: Sequence,
+        rng: np.random.Generator,
+        n: int,
+    ) -> np.ndarray:
+        if n <= 0:
+            return np.zeros((0, len(space)), dtype=np.float64)
+        spec = request.experiment
+        s = self.settings(spec)
+        gamma = float(s.get("gamma", 0.25))
+        m = int(s.get("n_ei_candidates", 24))
+        rho = float(s.get("random_fraction", DEFAULT_RANDOM_FRACTION))
+        resource = ladders[0].resource_name
+        ridx = space.names.index(resource)
+        reduced = SearchSpace(
+            params=[p for p in space.params if p.name != resource],
+            goal=space.goal,
+        )
+        if len(reduced) == 0:
+            return space.sample_uniform(rng, n)  # nothing to model
+        model = self._model_rung_data(request, spec, reduced, ridx)
+        if model is None:
+            # cold start: uniform, the exact ASHA rng stream
+            return space.sample_uniform(rng, n)
+        xs, ys = model
+        # Host rng call order is FIXED across the vectorized and oracle
+        # paths (and documented): (1) the random-fraction decisions, (2)
+        # the uniform picks' samples, (3) the model batch's per-pick
+        # candidate draws (integers + normal, inside tpe_batch/the oracle
+        # loop in identical order). Anything else would break the
+        # bit-compatibility contract with suggest/vectorized.py.
+        take_uniform = rng.random(n) < rho
+        n_uniform = int(take_uniform.sum())
+        uniform = space.sample_uniform(rng, n_uniform)
+        n_model = n - n_uniform
+        minimize = space.goal == MIN_GOAL
+        picked: Optional[np.ndarray] = None
+        if n_model > 0:
+            picked = vectorized.tpe_batch(
+                xs, ys, minimize, gamma, m, n_model, rng, multivariate=True
+            )
+            if picked is None:
+                picked = self._oracle_batch(
+                    xs, ys, minimize, gamma, m, n_model, rng
+                )
+        out = np.empty((n, len(space)), dtype=np.float64)
+        iu = im = 0
+        for i in range(n):
+            if take_uniform[i]:
+                out[i] = uniform[iu]
+                iu += 1
+            else:
+                # the resource axis is not modeled: re-insert a placeholder
+                # that get_suggestions overwrites with the bracket budget
+                out[i] = np.insert(picked[im], ridx, 0.0)
+                im += 1
+        return out
+
+    def _model_rung_data(
+        self,
+        request: SuggestionRequest,
+        spec,
+        reduced: SearchSpace,
+        ridx: int,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(xs, ys) of the winning rung, or None (uniform sampling). The
+        rung index is the BASE ladder's rung of each terminal trial's
+        current budget, so observations from every bracket at the same
+        fidelity share one model. Warm-start rows join rung 0; any failure
+        in their extraction degrades to no-priors, never to a failed
+        suggestion."""
+        from ..controller.multifidelity import FidelityLadder
+        from ..db.store import objective_value
+
+        base = FidelityLadder.from_spec(spec)
+        per_rung: Dict[int, List[Tuple[Dict[str, str], float]]] = {}
+        for t in request.trials:
+            if t.condition not in (
+                TrialCondition.SUCCEEDED,
+                TrialCondition.EARLY_STOPPED,
+            ):
+                continue
+            y = objective_value(t.observation, spec.objective)
+            if y is None or math.isnan(y):
+                continue
+            assignments = t.assignments_dict()
+            value = assignments.get(base.resource_name)
+            if value is None:
+                continue
+            try:
+                j = base.rung_of(value)
+            except ValueError:
+                continue
+            per_rung.setdefault(j, []).append((assignments, y))
+        warm_xs, warm_ys = self._warm_rows(request, reduced, ridx)
+        need = len(reduced) + self.MIN_POINTS_MARGIN
+        for j in sorted(set(per_rung) | {0}, reverse=True):
+            points = per_rung.get(j, [])
+            n_here = len(points) + (len(warm_ys) if j == 0 else 0)
+            if n_here < need or n_here == 0:
+                continue
+            xs = (
+                reduced.encode_many([a for a, _ in points])
+                if points
+                else np.zeros((0, len(reduced)), dtype=np.float64)
+            )
+            ys = np.array([y for _, y in points], dtype=np.float64)
+            if j == 0 and len(warm_ys):
+                xs = np.vstack([warm_xs, xs]) if len(xs) else warm_xs.copy()
+                ys = np.concatenate([warm_ys, ys])
+            return xs, ys
+        return None
+
+    def _warm_rows(
+        self, request: SuggestionRequest, reduced: SearchSpace, ridx: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Warm-start pseudo-observations with the resource column dropped
+        (the index stores full-space encodings). Empty on any failure."""
+        empty = (
+            np.zeros((0, len(reduced)), dtype=np.float64),
+            np.zeros(0, dtype=np.float64),
+        )
+        w = request.warm_start
+        if w is None:
+            return empty
+        try:
+            wxs = np.asarray(w.xs, dtype=np.float64)
+            wys = np.asarray(w.ys, dtype=np.float64)
+            if wxs.ndim != 2 or wxs.shape[1] != len(reduced) + 1:
+                return empty
+            return np.delete(wxs, ridx, axis=1), wys
+        except Exception:
+            log.debug("warm-start rows unusable; modeling without priors",
+                      exc_info=True)
+            return empty
+
+    @staticmethod
+    def _oracle_batch(
+        xs: np.ndarray,
+        ys: np.ndarray,
+        minimize: bool,
+        gamma: float,
+        m: int,
+        batch: int,
+        rng: np.random.Generator,
+    ) -> np.ndarray:
+        """The NumPy oracle: sequential multivariate-TPE picks with the
+        constant-liar append — the exact legacy loop suggest/vectorized.py
+        guarantees tpe_batch parity against (same rng call order:
+        ``integers(0, n_good, m)`` then ``normal(0, bw, (m, d))`` per
+        pick)."""
+        n0, d = xs.shape
+        xs_buf = np.empty((n0 + batch, d), dtype=np.float64)
+        ys_buf = np.empty(n0 + batch, dtype=np.float64)
+        xs_buf[:n0] = xs
+        ys_buf[:n0] = ys
+        n_aug = n0
+        out = np.empty((batch, d), dtype=np.float64)
+        for i in range(batch):
+            good, bad = _split_observations(
+                xs_buf[:n_aug], ys_buf[:n_aug], gamma, minimize
+            )
+            cands = _sample_from_kernels(good, rng, m)
+            score = (_kde_logpdf(good, cands) - _kde_logpdf(bad, cands)).sum(
+                axis=1
+            )
+            u = cands[int(np.argmax(score))]
+            out[i] = u
+            lie = ys_buf[:n_aug].max() if minimize else ys_buf[:n_aug].min()
+            xs_buf[n_aug] = u
+            ys_buf[n_aug] = lie
+            n_aug += 1
+        return out

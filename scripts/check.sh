@@ -44,6 +44,10 @@ echo "== multi-fidelity smoke (ASHA rungs vs flat TPE device-epochs) =="
 JAX_PLATFORMS=cpu python bench.py asha_device_seconds --smoke
 
 echo
+echo "== model-based multi-fidelity smoke (BOHB KDE vs ASHA, packed promotions, cold-vs-warm) =="
+JAX_PLATFORMS=cpu python bench.py bohb_convergence --smoke
+
+echo
 echo "== device-plane chaos smoke (seeded wedged probe + mid-sweep revocations, zero lost observations) =="
 JAX_PLATFORMS=cpu python bench.py device_chaos_recovery --smoke
 

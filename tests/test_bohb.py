@@ -1,0 +1,422 @@
+"""Model-based multi-fidelity (ISSUE 13): the BOHB suggester — per-rung
+KDE model selection over the fold index, random-fraction fallback,
+bit-compatible NumPy-oracle parity through the vectorized suggestion
+plane, warm-start priors on the rung-0 model — plus the multi-bracket
+Hyperband geometry (staggered ladders, shared admission budget)."""
+
+import json
+import math
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from katib_tpu.api import (
+    AlgorithmSetting,
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialTemplate,
+)
+from katib_tpu.api.spec import Metric, Observation, ParameterAssignment
+from katib_tpu.api.status import Trial, TrialCondition
+from katib_tpu.config import KatibConfig
+from katib_tpu.controller.experiment import ExperimentController
+from katib_tpu.controller.multifidelity import (
+    BRACKET_LABEL,
+    RUNG_LABEL,
+    assign_brackets,
+    bracket_ladders,
+    bracket_quotas,
+    ladder_report,
+)
+from katib_tpu.suggest import vectorized
+from katib_tpu.suggest.base import SuggestionRequest, WarmStartData, create
+
+
+def _spec(name="bohb-x", *, algorithm="bohb", eta=3, max_resource=27,
+          max_trials=27, parallel=4, seed="11", extra=(), fn=None):
+    return ExperimentSpec(
+        name=name,
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ParameterSpec(
+                "epochs", ParameterType.INT,
+                FeasibleSpace(min="1", max=str(max_resource)),
+            ),
+        ],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec(
+            algorithm,
+            algorithm_settings=[
+                AlgorithmSetting("eta", str(eta)),
+                AlgorithmSetting("resource_name", "epochs"),
+                AlgorithmSetting("random_state", seed),
+                *extra,
+            ],
+        ),
+        trial_template=TrialTemplate(function=fn or (lambda a, c: None)),
+        max_trial_count=max_trials,
+        parallel_trial_count=parallel,
+    )
+
+
+def _trial(name, x, epochs, score, cond=TrialCondition.EARLY_STOPPED):
+    t = Trial(
+        name=name,
+        experiment_name="bohb-x",
+        parameter_assignments=[
+            ParameterAssignment("x", str(x)),
+            ParameterAssignment("epochs", str(epochs)),
+        ],
+    )
+    t.set_condition(cond, "RungPaused", "")
+    s = str(score)
+    t.observation = Observation(metrics=[Metric(name="score", latest=s, min=s, max=s)])
+    return t
+
+
+def _xs_of(reply):
+    return [float(a.assignments_dict()["x"]) for a in reply.assignments]
+
+
+def _budgets_of(reply):
+    return [a.assignments_dict()["epochs"] for a in reply.assignments]
+
+
+# -- validation ---------------------------------------------------------------
+
+
+def test_bohb_registered_and_validates():
+    suggester = create("bohb")
+    suggester.validate_algorithm_settings(_spec())
+
+    bad = _spec(extra=(AlgorithmSetting("gamma", "1.5"),))
+    with pytest.raises(ValueError, match="gamma"):
+        suggester.validate_algorithm_settings(bad)
+    bad = _spec(extra=(AlgorithmSetting("random_fraction", "2"),))
+    with pytest.raises(ValueError, match="random_fraction"):
+        suggester.validate_algorithm_settings(bad)
+    # brackets bounded by the ladder (1/3/9/27 -> at most 3 brackets)
+    bad = _spec(extra=(AlgorithmSetting("brackets", "4"),))
+    with pytest.raises(ValueError, match="brackets"):
+        suggester.validate_algorithm_settings(bad)
+    ok = _spec(extra=(AlgorithmSetting("brackets", "3"),))
+    suggester.validate_algorithm_settings(ok)
+
+
+# -- cold start / model activation -------------------------------------------
+
+
+def test_cold_start_matches_asha_uniform():
+    """With no history the BOHB bottom rung samples exactly like ASHA —
+    same seeded rng stream, same assignments."""
+    bohb = create("bohb").get_suggestions(
+        SuggestionRequest(experiment=_spec(), trials=[], current_request_number=6)
+    )
+    asha = create("asha").get_suggestions(
+        SuggestionRequest(
+            experiment=_spec(algorithm="asha"), trials=[], current_request_number=6
+        )
+    )
+    assert [a.assignments_dict() for a in bohb.assignments] == [
+        a.assignments_dict() for a in asha.assignments
+    ]
+    assert all(b == "1" for b in _budgets_of(bohb))  # bottom-rung budget
+
+
+def test_model_concentrates_on_good_region():
+    """With >= d+2 rung-0 observations whose objective increases in x, the
+    KDE model concentrates new admissions near the good region (uniform
+    would average ~0.5)."""
+    trials = [
+        _trial(f"t{i}", x, 1, x) for i, x in enumerate(
+            [0.05, 0.15, 0.3, 0.45, 0.6, 0.75, 0.88, 0.97]
+        )
+    ]
+    reply = create("bohb").get_suggestions(
+        SuggestionRequest(
+            experiment=_spec(extra=(AlgorithmSetting("random_fraction", "0"),)),
+            trials=trials,
+            current_request_number=8,
+        )
+    )
+    xs = _xs_of(reply)
+    assert len(xs) == 8
+    assert np.mean(xs) > 0.65, xs  # pulled toward the good (high-x) region
+    assert all(b == "1" for b in _budgets_of(reply))
+
+
+def test_vectorized_oracle_parity_through_the_plane():
+    """The acceptance parity contract: the jitted tpe_batch path and the
+    NumPy oracle produce the same selections for the same seeded request."""
+    trials = [
+        _trial(f"t{i}", x, 1, x * 0.9 + 0.05) for i, x in enumerate(
+            np.linspace(0.02, 0.98, 12)
+        )
+    ]
+
+    def run():
+        return create("bohb").get_suggestions(
+            SuggestionRequest(
+                experiment=_spec(), trials=trials, current_request_number=6
+            )
+        )
+
+    if not vectorized.available():
+        pytest.skip("jax unavailable; no vectorized plane to compare")
+    try:
+        vectorized.set_enabled(True)
+        fast = run()
+        assert vectorized.use_vectorized()
+        vectorized.set_enabled(False)
+        oracle = run()
+    finally:
+        vectorized.set_enabled(True)
+    assert _xs_of(fast) == pytest.approx(_xs_of(oracle), abs=1e-9)
+    assert _budgets_of(fast) == _budgets_of(oracle)
+
+
+def test_random_fraction_one_stays_uniform():
+    """rho=1 keeps every pick uniform even with a hot model — the
+    exploration floor can never be starved. The rng order is pinned:
+    decisions first, then the uniform draws."""
+    trials = [_trial(f"t{i}", x, 1, x) for i, x in enumerate(np.linspace(0, 1, 8))]
+    spec = _spec(extra=(AlgorithmSetting("random_fraction", "1"),))
+    reply = create("bohb").get_suggestions(
+        SuggestionRequest(experiment=spec, trials=trials, current_request_number=5)
+    )
+    rng = np.random.default_rng(int(spec.algorithm.settings_dict()["random_state"]) + 8)
+    rng.random(5)  # the random-fraction decisions
+    expected = rng.random((5, 2))[:, 0]
+    assert _xs_of(reply) == pytest.approx(list(expected), abs=1e-12)
+
+
+def test_model_prefers_highest_qualified_rung():
+    """Fidelity beats quantity: plenty of rung-0 points favoring low x
+    must lose to a qualified rung-2 set favoring high x."""
+    low = [_trial(f"l{i}", x, 1, 1.0 - x) for i, x in enumerate(
+        np.linspace(0.05, 0.95, 10)
+    )]
+    # rung 2 (epochs=9): objective increases in x -> good set near 1
+    high = [_trial(f"h{i}", x, 9, x) for i, x in enumerate([0.7, 0.8, 0.9, 0.97])]
+    reply = create("bohb").get_suggestions(
+        SuggestionRequest(
+            experiment=_spec(extra=(AlgorithmSetting("random_fraction", "0"),)),
+            trials=low + high,
+            current_request_number=6,
+        )
+    )
+    assert np.mean(_xs_of(reply)) > 0.6  # the rung-2 model won
+
+
+# -- warm start ---------------------------------------------------------------
+
+
+def test_warm_start_arms_the_rung0_model():
+    """PR 10 history priors count as rung-0 pseudo-observations: a fresh
+    experiment with matching warm rows models from the very first batch
+    (cold would be uniform), and unusable rows degrade to no-priors."""
+    rng = np.random.default_rng(3)
+    xs = np.column_stack([np.linspace(0.6, 0.99, 8), rng.random(8)])  # [x, epochs]
+    warm = WarmStartData(xs=xs, ys=np.linspace(0.6, 0.99, 8), source="old-exp")
+    spec = _spec(extra=(AlgorithmSetting("random_fraction", "0"),))
+    warm_reply = create("bohb").get_suggestions(
+        SuggestionRequest(
+            experiment=spec, trials=[], current_request_number=6, warm_start=warm
+        )
+    )
+    cold_reply = create("bohb").get_suggestions(
+        SuggestionRequest(experiment=spec, trials=[], current_request_number=6)
+    )
+    assert _xs_of(warm_reply) != pytest.approx(_xs_of(cold_reply), abs=1e-12)
+    assert np.mean(_xs_of(warm_reply)) > 0.6  # pulled toward the prior's region
+
+    # malformed priors (wrong width) degrade to the uniform cold start
+    bad = WarmStartData(xs=rng.random((8, 5)), ys=np.linspace(0, 1, 8))
+    degraded = create("bohb").get_suggestions(
+        SuggestionRequest(
+            experiment=spec, trials=[], current_request_number=6, warm_start=bad
+        )
+    )
+    assert _xs_of(degraded) == pytest.approx(_xs_of(cold_reply), abs=1e-12)
+
+
+# -- multi-bracket geometry ---------------------------------------------------
+
+
+def test_bracket_ladders_staggered_min_resource():
+    ladders = bracket_ladders(_spec(extra=(AlgorithmSetting("brackets", "3"),)))
+    assert [l.rungs for l in ladders] == [
+        [1.0, 3.0, 9.0, 27.0],
+        [3.0, 9.0, 27.0],
+        [9.0, 27.0],
+    ]
+    # clamped: every bracket keeps >= 2 rungs
+    clamped = bracket_ladders(_spec(extra=(AlgorithmSetting("brackets", "9"),)))
+    assert len(clamped) == 3
+
+
+def test_bracket_quotas_hyperband_weighted():
+    ladders = bracket_ladders(_spec(extra=(AlgorithmSetting("brackets", "3"),)))
+    quotas = bracket_quotas(27, ladders)
+    assert sum(quotas) == 27
+    # deep-halving cheap bracket admits the most, every bracket admits some
+    assert quotas[0] > quotas[1] > quotas[2] >= 1
+
+
+def test_assign_brackets_round_robin_by_remaining():
+    spec = _spec(extra=(AlgorithmSetting("brackets", "2"),), max_trials=6)
+    ladders = bracket_ladders(spec)
+    quotas = bracket_quotas(6, ladders)
+    ids = assign_brackets(spec, [], ladders, 6)
+    assert Counter(ids) == {0: quotas[0], 1: quotas[1]}
+    # existing admissions (persisted labels) count against the quotas
+    prior = [_trial("p0", 0.5, 1, 0.1) for _ in range(quotas[0])]
+    for t in prior:
+        t.labels[BRACKET_LABEL] = "0"
+    ids2 = assign_brackets(spec, prior, ladders, quotas[1])
+    assert all(b == 1 for b in ids2)
+
+
+def test_multibracket_e2e_and_report(tmp_path):
+    """Two staggered ASHA brackets share one experiment: bracket-1 trials
+    enter at the base ladder's second rung, the report grows per-bracket
+    sections, and the CLI serves them as JSON."""
+    from katib_tpu import cli
+
+    def fn(assignments, ctx):
+        x = float(assignments["x"])
+        budget = int(float(assignments["epochs"]))
+        store = ctx.checkpoint_store()
+        restored = store.restore()
+        start = int(restored["epoch"]) + 1 if restored else 1
+        for epoch in range(start, budget + 1):
+            store.save(epoch, {"epoch": epoch})
+            ctx.report(score=x * math.log1p(epoch), epoch=epoch)
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    c = ExperimentController(
+        root_dir=str(tmp_path), devices=list(range(4)), config=cfg
+    )
+    try:
+        spec = _spec(
+            name="mb", algorithm="asha", eta=2, max_resource=4, max_trials=8,
+            extra=(AlgorithmSetting("brackets", "2"),), fn=fn,
+        )
+        c.create_experiment(spec)
+        exp = c.run("mb", timeout=180)
+        assert exp.status.is_succeeded, exp.status.message
+
+        trials = c.state.list_trials("mb")
+        by_bracket = Counter(t.labels.get(BRACKET_LABEL, "0") for t in trials)
+        assert set(by_bracket) == {"0", "1"} and sum(by_bracket.values()) == 8
+        # bracket-1 admissions enter at the staggered bottom rung (budget 2)
+        for t in trials:
+            if t.labels.get(BRACKET_LABEL) == "1":
+                assert float(t.assignments_dict()["epochs"]) >= 2.0
+
+        report = ladder_report(exp.spec, trials, c.obs_store)
+        assert report["n_brackets"] == 2
+        assert [b["min_resource"] for b in report["brackets"]] == ["1", "2"]
+        pops = [
+            sum(r["population"] for r in b["rungs"]) for b in report["brackets"]
+        ]
+        assert all(p > 0 for p in pops)
+        # every admitted configuration appears in exactly one bracket's
+        # bottom rung
+        bottoms = sum(b["rungs"][0]["population"] for b in report["brackets"])
+        assert bottoms == 8
+
+        rc = cli.main(["--root", str(tmp_path), "rungs", "mb", "--format", "json"])
+        assert rc == 0
+    finally:
+        c.close()
+
+
+def test_multibracket_json_cli_output(tmp_path, capsys):
+    from katib_tpu import cli
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    c = ExperimentController(
+        root_dir=str(tmp_path), devices=list(range(4)), config=cfg
+    )
+    try:
+        def fn(assignments, ctx):
+            ctx.report(score=float(assignments["x"]), epoch=1)
+
+        spec = _spec(
+            name="mbj", algorithm="asha", eta=2, max_resource=4,
+            max_trials=4, fn=fn,
+        )
+        c.create_experiment(spec)
+        c.run("mbj", timeout=120)
+    finally:
+        c.close()
+    rc = cli.main(["--root", str(tmp_path), "rungs", "mbj", "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert report["experiment"] == "mbj"
+    assert report["n_brackets"] == 1
+    assert report["brackets"][0]["rungs"] == report["rungs"]
+
+
+# -- bohb end-to-end ----------------------------------------------------------
+
+
+def test_bohb_e2e_zero_lost_observations(tmp_path):
+    """A full BOHB sweep rides the same ladder machinery: promotions
+    resume checkpoints, every epoch curve is continuous, and the model
+    steers admissions toward the good region once armed."""
+    from katib_tpu.db.store import fold_observation
+
+    def fn(assignments, ctx):
+        x = float(assignments["x"])
+        budget = int(float(assignments["epochs"]))
+        store = ctx.checkpoint_store()
+        restored = store.restore()
+        start = int(restored["epoch"]) + 1 if restored else 1
+        for epoch in range(start, budget + 1):
+            store.save(epoch, {"epoch": epoch})
+            ctx.report(score=x * (1.0 - math.exp(-epoch / 4.0)), epoch=epoch)
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    c = ExperimentController(
+        root_dir=str(tmp_path), devices=list(range(4)), config=cfg
+    )
+    try:
+        spec = _spec(
+            name="bohb-e2e", eta=2, max_resource=4, max_trials=12, fn=fn,
+            seed="5",
+        )
+        c.create_experiment(spec)
+        exp = c.run("bohb-e2e", timeout=180)
+        assert exp.status.is_succeeded, exp.status.message
+        trials = c.state.list_trials("bohb-e2e")
+        assert len(trials) == 12
+        promoted = [t for t in trials if int(t.labels.get(RUNG_LABEL, "0")) > 0]
+        assert promoted, "bohb sweep never promoted a trial"
+        for t in trials:
+            rows = c.obs_store.get_observation_log(t.name, metric_name="epoch")
+            epochs = [int(float(r.value)) for r in rows]
+            assert epochs == list(range(1, len(epochs) + 1)), (t.name, epochs)
+            fold = c.obs_store.folded(t.name, ["score", "epoch"]).to_dict()
+            rescan = fold_observation(
+                c.obs_store.get_observation_log(t.name), ["score", "epoch"]
+            ).to_dict()
+            assert fold == rescan, t.name
+    finally:
+        c.close()

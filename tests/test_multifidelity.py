@@ -357,7 +357,7 @@ def test_kill_during_pause_never_promotes(controller):
         assert "asha-kill-a" not in st.paused
         assert "asha-kill-b" in st.paused
         # its recorded score still informs the rung cut for its peers
-        assert "asha-kill-a" in st.scores[0]
+        assert "asha-kill-a" in st.brackets[0].scores[0]
     assert eng._eligible_locked(st) == []  # killed trial is not a candidate
 
 
@@ -393,8 +393,10 @@ def test_corrupt_checkpoint_promotes_from_scratch(controller, tmp_path):
     for name in ("asha-cor-ok", "asha-cor-bad"):
         with eng._lock:
             st.paused.pop(name, None)
-            st.promoted[0].add(name)
-        assert eng._promote_one(exp, name, 0, st.ladder, c.scheduler)
+            st.brackets[0].promoted[0].add(name)
+        assert eng._promote_one(
+            exp, name, 0, 0, st.brackets[0].ladder, c.scheduler
+        )
     assert _wait_for(lambda: _paused(c, "asha-cor", "asha-cor-ok"))
     assert _wait_for(lambda: _paused(c, "asha-cor", "asha-cor-bad"))
 
@@ -433,9 +435,11 @@ def test_engine_rebuilds_from_persisted_state(controller):
 
     fresh = MultiFidelityEngine(c.state, c.obs_store)
     st = fresh._entry(exp)
-    assert st.paused == {"asha-reb-a": 0, "asha-reb-b": 0}
-    assert set(st.scores[0]) == {"asha-reb-a", "asha-reb-b"}
-    assert st.scores[0]["asha-reb-a"] == pytest.approx(0.9 * math.log1p(1))
+    assert st.paused == {"asha-reb-a": (0, 0), "asha-reb-b": (0, 0)}
+    assert set(st.brackets[0].scores[0]) == {"asha-reb-a", "asha-reb-b"}
+    assert st.brackets[0].scores[0]["asha-reb-a"] == pytest.approx(
+        0.9 * math.log1p(1)
+    )
 
 
 # -- gating ------------------------------------------------------------------
@@ -709,3 +713,203 @@ def test_pack_rung_key_and_plan_packs_split_mixed_rungs():
     plain = _asha_spec("plain", fn, max_trials=8)
     plain.algorithm.algorithm_name = "random"
     assert pack_rung_key(plain, trial("t", 3)) is None
+
+
+# -- tentpole (ISSUE 13): dwell-window promotion packing ----------------------
+
+
+def _pack_curve_fn(assignments, ctx):
+    """Dual-mode (solo/packed) curve trial with per-member epoch
+    checkpoints, so promoted stints resume in either mode."""
+    import numpy as np
+
+    from katib_tpu.runtime.checkpoints import CheckpointStore
+    from katib_tpu.runtime.packed import (
+        population_of,
+        report_population,
+        uniform_param,
+    )
+
+    pop = population_of(assignments)
+    budget = int(uniform_param(pop, "epochs", 1))
+    xs = pop["x"]
+    if hasattr(ctx, "pack_size"):
+        dirs = [cd or wd for cd, wd in zip(ctx.checkpoint_dirs, ctx.workdirs)]
+        stores = [CheckpointStore(d) for d in dirs]
+    else:
+        stores = [ctx.checkpoint_store()]
+    restored = [s.restore() for s in stores]
+    start = min(int(r["epoch"]) + 1 if r else 1 for r in restored)
+    for epoch in range(start, budget + 1):
+        for s in stores:
+            s.save(epoch, {"epoch": epoch})
+        score = xs * (1.0 - np.exp(-epoch / 4.0))
+        report_population(
+            ctx, score=score, epoch=np.full(len(xs), float(epoch))
+        )
+
+
+DWELL_XS = (0.9, 0.8, 0.7, 0.6, 0.4, 0.3, 0.2, 0.1)
+
+
+def _run_dwell_sweep(tmp_path, sub, dwell):
+    """One packed asha sweep (rungs 1/2, 8 fixed configs admitted
+    sequentially so the async claim order is deterministic, pack_size=4)
+    under the given dwell window; returns (outcomes, promoted, events)."""
+    from katib_tpu.api.spec import TrialResources
+
+    root = os.path.join(str(tmp_path), sub)
+    c = ExperimentController(
+        root_dir=root,
+        devices=list(range(4)),
+        config=_quiet_config(promotion_dwell_seconds=dwell),
+    )
+    try:
+        name = f"dw-{sub}"
+        spec = _asha_spec(
+            name, _pack_curve_fn, eta=2, max_resource=2, max_trials=8,
+            parallel=4, seed="23",
+        )
+        spec.trial_template.resources = TrialResources(pack_size=4)
+        exp = c.create_experiment(spec)
+        for i, x in enumerate(DWELL_XS):
+            _submit_solo(c, exp, f"{name}-t{i}", x, 1)
+            # sequential boundaries: claim order (and hence the promoted
+            # set at each async quota step) is identical across runs
+            assert _wait_for(
+                lambda t=f"{name}-t{i}": _paused(c, name, t)
+                or c.state.get_trial(name, t).condition
+                == TrialCondition.SUCCEEDED
+            ), i
+        exp = c.run(name, timeout=180)
+        assert exp.status.is_succeeded, exp.status.message
+        trials = c.state.list_trials(name)
+        outcomes = sorted(
+            (
+                t.assignments_dict()["x"],
+                t.assignments_dict()["epochs"],
+                t.condition.value,
+                t.current_reason,
+            )
+            for t in trials
+        )
+        promoted = {
+            t.name for t in trials if int(t.labels.get(RUNG_LABEL, "0")) > 0
+        }
+        events = list(c.events.list(name))
+        return outcomes, promoted, events
+    finally:
+        c.close()
+
+
+def test_dwell_batches_promotions_into_packs(tmp_path):
+    """The packed-promotion acceptance: with a dwell window the 4 same-rung
+    promotions resubmit as ONE batch and dispatch as ceil(4/pack_capacity)
+    = 1 vmapped pack — not 4 solo trickles — and the sweep outcome is
+    byte-identical to the dwell-off run (the seeded on-vs-off assertion)."""
+    on_out, on_promoted, on_events = _run_dwell_sweep(tmp_path, "on", 30.0)
+    off_out, off_promoted, off_events = _run_dwell_sweep(tmp_path, "off", 0.0)
+
+    # identical seeded outcomes: same configs, budgets, conditions
+    assert on_out == off_out
+    assert len(on_promoted) == 4
+
+    # dwell off: byte-identical PR 11 behavior — no batching events at all
+    assert not [e for e in off_events if e.reason == "PromotionBatched"]
+
+    # dwell on: one batch covering every promotion...
+    batched = [e for e in on_events if e.reason == "PromotionBatched"]
+    assert len(batched) == 1, [e.message for e in batched]
+    assert all(name in batched[0].message for name in on_promoted)
+
+    # ...and the rung-1 stint dispatches as exactly ceil(4/4) = 1 pack of
+    # promoted members (dispatch-group count, not promotion count)
+    def _pack_members(e):
+        return set(e.message.split(": ", 1)[1].split(", "))
+
+    on_packs = [e for e in on_events if e.reason == "PackFormed"]
+    promo_packs = [
+        e for e in on_packs if _pack_members(e) == on_promoted
+    ]
+    assert len(promo_packs) == 1, [e.message for e in on_packs]
+
+
+def test_dwell_chaos_revoke_boundary_and_batch_bit_identical(tmp_path):
+    """The PR 11 x PR 12 seam: a chaos `revoke` strikes (a) a rung-0 stint
+    right at its first boundary heartbeat and (b) a member of the
+    mid-dwell promotion batch. Both convert to device-loss preemptions,
+    resume on the surviving devices from their rung checkpoints, and the
+    final value streams are BIT-identical to the chaos-free replica with
+    zero lost observations."""
+    from katib_tpu.utils import chaos
+
+    # grants: A=1, B=2 (revoked -> resume=3), C=4, D=5; dwell flush then
+    # submits the 2 promotions in claim order: A=6 (revoked -> resume=8),
+    # B=7
+    chaos.install(chaos.parse_plan("seed=3;revoke=2@1;revoke=6@1"))
+    c = ExperimentController(
+        root_dir=str(tmp_path),
+        devices=list(range(4)),
+        config=_quiet_config(promotion_dwell_seconds=30.0),
+    )
+    try:
+        spec = _asha_spec(
+            "asha-chaos", _stream_fn, eta=2, max_resource=4, max_trials=4,
+            extra_settings=(AlgorithmSetting("min_resource", "2"),),
+        )
+        exp = c.create_experiment(spec)
+        xs = {"a": 0.9, "b": 0.8, "c": 0.3, "d": 0.2}
+        for suffix, x in xs.items():
+            _submit_solo(c, exp, f"asha-chaos-{suffix}", x, 2)
+            assert _wait_for(
+                lambda s=suffix: _paused(c, "asha-chaos", f"asha-chaos-{s}")
+            ), suffix
+
+        # the drain rule fired at the last boundary (budget exhausted):
+        # both promotions resubmitted as one mid-dwell batch
+        def _done(name):
+            t = c.state.get_trial("asha-chaos", name)
+            return t is not None and t.condition == TrialCondition.SUCCEEDED
+
+        assert _wait_for(lambda: _done("asha-chaos-a"), timeout=60)
+        assert _wait_for(lambda: _done("asha-chaos-b"), timeout=60)
+
+        batched = [
+            e for e in c.events.list("asha-chaos")
+            if e.reason == "PromotionBatched"
+        ]
+        assert len(batched) == 1
+        assert "asha-chaos-a" in batched[0].message
+        assert "asha-chaos-b" in batched[0].message
+        lost = [
+            e for e in c.events.list("asha-chaos") if e.reason == "DeviceLost"
+        ]
+        assert len(lost) == 2, [e.message for e in lost]
+
+        from katib_tpu.db.store import fold_observation
+
+        for suffix, x in xs.items():
+            name = f"asha-chaos-{suffix}"
+            n = 4 if suffix in ("a", "b") else 2
+            rows = c.obs_store.get_observation_log(name, metric_name="val")
+            got = [float(r.value) for r in rows]
+            # bit-identical to the uninterrupted replica: the revoked
+            # stints resumed their chained PRNG streams from the rung
+            # checkpoints, losing nothing and re-reporting nothing
+            assert got == pytest.approx(_stream_replica(x, n), abs=0.0), name
+            epochs = [
+                int(float(r.value))
+                for r in c.obs_store.get_observation_log(
+                    name, metric_name="epoch"
+                )
+            ]
+            assert epochs == list(range(1, n + 1)), name
+            fold = c.obs_store.folded(name, ["score", "epoch"]).to_dict()
+            rescan = fold_observation(
+                c.obs_store.get_observation_log(name), ["score", "epoch"]
+            ).to_dict()
+            assert fold == rescan, name
+    finally:
+        chaos.install(None)
+        chaos.reset()
+        c.close()
