@@ -2132,6 +2132,294 @@ def _bench_device_chaos_recovery(smoke: bool = False):
     }
 
 
+# Trial workload for the controller-kill harness: a subprocess trial that
+# PUSHES one row per epoch straight into the observation db (durable against
+# a controller SIGKILL) and checkpoints in the runtime/checkpoints.py pickle
+# format AFTER each report — the report-then-save order the truncate-to-
+# checkpoint recovery rule stitches back into one continuous execution.
+_KILL_TRIAL_SCRIPT = """\
+import os, pickle, sys, time
+
+def latest_step():
+    steps = []
+    for fn in os.listdir("."):
+        if fn.startswith("ckpt_") and fn.endswith(".pkl"):
+            try:
+                steps.append(int(fn[5:-4]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+x = float(sys.argv[1])
+epochs = int(sys.argv[2])
+from katib_tpu.runtime.metrics import report_metrics  # env-bound db push
+
+step = latest_step()
+start = step + 1 if step is not None else 1
+for epoch in range(start, epochs + 1):
+    score = x * (1.0 - 0.8 ** epoch)
+    time.sleep(0.05)
+    report_metrics(score=score, epoch=epoch)
+    tmp = "ckpt_%d.pkl.tmp" % epoch
+    with open(tmp, "wb") as f:
+        pickle.dump({"step": epoch, "state": {"epoch": epoch}}, f)
+    os.replace(tmp, "ckpt_%d.pkl" % epoch)
+"""
+
+# Controller driver run as a SUBPROCESS so a SIGKILL injected by the chaos
+# plan (kill_controller=N, fired from inside the recovery journal) kills a
+# real controller process, orphaning its trial children — exactly the
+# failure the lease + fencing + replay machinery exists for.
+_KILL_DRIVER = """\
+import json, os, sys, time
+
+root, phase, n_trials, epochs, n_devices, parallel = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]),
+)
+from katib_tpu.api import (
+    AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+    ObjectiveType, ParameterSpec, ParameterType, TrialParameterSpec,
+    TrialTemplate,
+)
+from katib_tpu.api.spec import ResumePolicy
+from katib_tpu.config import KatibConfig
+from katib_tpu.controller.experiment import ExperimentController
+
+cfg = KatibConfig()
+cfg.runtime.telemetry = False
+cfg.runtime.compile_service = False
+cfg.runtime.tracing = False
+c = ExperimentController(root_dir=root, devices=list(range(n_devices)), config=cfg)
+name = "kill-sweep"
+replay_s = 0.0
+if phase == "create":
+    step = 0.9 / max(n_trials - 1, 1)
+    spec = ExperimentSpec(
+        name=name,
+        parameters=[ParameterSpec(
+            "x", ParameterType.DOUBLE,
+            FeasibleSpace(min="0.1", max="1.0", step=repr(step)),
+        )],
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec("grid"),
+        trial_template=TrialTemplate(
+            command=[sys.executable, os.path.join(root, "trial_script.py"),
+                     "${trialParameters.x}", str(epochs)],
+            trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+            env={"PYTHONPATH": os.environ.get("PYTHONPATH", "")},
+        ),
+        max_trial_count=n_trials,
+        parallel_trial_count=parallel,
+        resume_policy=ResumePolicy.FROM_VOLUME,
+    )
+    c.create_experiment(spec)
+else:
+    t0 = time.time()
+    c.load_experiment(name)
+    replay_s = time.time() - t0
+    # emitted BEFORE run(): a chaos SIGKILL mid-run must not lose the
+    # replay timing the harness asserts on
+    print(json.dumps({"replay_seconds": replay_s}), flush=True)
+exp = c.run(name, timeout=240)
+print(json.dumps({
+    "replay_seconds": replay_s,
+    "succeeded": exp.status.is_succeeded,
+    "recovered_events": sum(
+        1 for e in c.events.list(name) if e.reason == "ControllerRecovered"
+    ),
+}))
+c.close()
+"""
+
+
+def _bench_controller_kill_recovery(smoke: bool = False):
+    """Crash-tolerant controller under injected SIGKILLs (ISSUE 14): the
+    same checkpointed sweep runs fault-free (in-process reference) and then
+    across controller subprocesses that the chaos plan hard-kills
+    (``kill_controller=N``, fired deterministically from inside the
+    recovery journal) at >= 2 journal points mid-flight. Each restart must
+    take over the dead holder's lease immediately, fence orphaned trial
+    processes, replay the journal, and truncate each observation log only
+    to its last durable checkpoint. The finished sweep must show ZERO lost
+    observations (every trial's epoch curve continuous 1..E, no gaps or
+    duplicates), score rows bit-identical to the fault-free run, and every
+    recovery replay bounded under 10s."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from katib_tpu.api import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialParameterSpec,
+        TrialTemplate,
+    )
+    from katib_tpu.api.spec import ResumePolicy
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import SqliteObservationStore
+
+    n_trials = 4 if smoke else 10
+    epochs = 4 if smoke else 6
+    n_devices = parallel = 2 if smoke else 4
+    # per-round journal-append kill points: early enough that every round
+    # still has in-flight work when the SIGKILL lands (round 0: the first
+    # terminals; later rounds: mid-recovery-dispatch of the requeued batch)
+    kill_appends = [6, 5] if smoke else [8, 8, 6]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child_env_base = dict(os.environ)
+    child_env_base["JAX_PLATFORMS"] = "cpu"
+    child_env_base["PYTHONPATH"] = (
+        repo + os.pathsep + child_env_base.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    child_env_base.pop("KATIB_TPU_CHAOS", None)
+
+    def rows_by_x(root):
+        """(epoch rows, score rows) per x — read offline from the root."""
+        state = ExperimentStateStore(os.path.join(root, "state"))
+        state.load("kill-sweep")
+        store = SqliteObservationStore(os.path.join(root, "observations.db"))
+        epochs_by_x, scores_by_x, conditions = {}, {}, {}
+        try:
+            for t in state.list_trials("kill-sweep"):
+                x = t.assignments_dict()["x"]
+                epochs_by_x[x] = [
+                    int(float(r.value))
+                    for r in store.get_observation_log(t.name, metric_name="epoch")
+                ]
+                scores_by_x[x] = [
+                    r.value
+                    for r in store.get_observation_log(t.name, metric_name="score")
+                ]
+                conditions[x] = t.condition.value
+        finally:
+            store.close()
+        return epochs_by_x, scores_by_x, conditions
+
+    def run_child(root, phase, kill_at=None, timeout=300):
+        env = dict(child_env_base)
+        if kill_at is not None:
+            env["KATIB_TPU_CHAOS"] = f"kill_controller={kill_at}"
+        proc = subprocess.run(
+            [sys.executable, "-c", _KILL_DRIVER, root, phase,
+             str(n_trials), str(epochs), str(n_devices), str(parallel)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        out = None
+        replay = None
+        for line in (proc.stdout or "").strip().splitlines():
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            out = parsed
+            if "replay_seconds" in parsed and replay is None:
+                replay = parsed["replay_seconds"]
+        return proc.returncode, out, replay, proc.stderr
+
+    # fault-free reference: same spec, driven in-process
+    ref_root = tempfile.mkdtemp(prefix="bench-killref-")
+    with open(os.path.join(ref_root, "trial_script.py"), "w") as f:
+        f.write(_KILL_TRIAL_SCRIPT)
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    cfg.runtime.tracing = False
+    ctrl = ExperimentController(
+        root_dir=ref_root, devices=list(range(n_devices)), config=cfg
+    )
+    try:
+        step = 0.9 / max(n_trials - 1, 1)
+        spec = ExperimentSpec(
+            name="kill-sweep",
+            parameters=[ParameterSpec(
+                "x", ParameterType.DOUBLE,
+                FeasibleSpace(min="0.1", max="1.0", step=repr(step)),
+            )],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("grid"),
+            trial_template=TrialTemplate(
+                command=[sys.executable,
+                         os.path.join(ref_root, "trial_script.py"),
+                         "${trialParameters.x}", str(epochs)],
+                trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+                env={"PYTHONPATH": child_env_base["PYTHONPATH"]},
+            ),
+            max_trial_count=n_trials,
+            parallel_trial_count=parallel,
+            resume_policy=ResumePolicy.FROM_VOLUME,
+        )
+        ctrl.create_experiment(spec)
+        exp = ctrl.run("kill-sweep", timeout=240)
+        assert exp.status.is_succeeded, exp.status.message
+    finally:
+        ctrl.close()
+    ref_epochs, ref_scores, _ = rows_by_x(ref_root)
+    assert all(
+        steps == list(range(1, epochs + 1)) for steps in ref_epochs.values()
+    ), "fault-free reference lost rows"
+
+    # chaos rounds: each child controller is SIGKILLed at a journal point,
+    # then a fresh child takes over the dead lease and recovers
+    root = tempfile.mkdtemp(prefix="bench-kill-")
+    with open(os.path.join(root, "trial_script.py"), "w") as f:
+        f.write(_KILL_TRIAL_SCRIPT)
+    kills = 0
+    replays = []
+    for i, kill_at in enumerate(kill_appends):
+        phase = "create" if i == 0 else "resume"
+        rcode, out, replay, err = run_child(root, phase, kill_at=kill_at)
+        assert rcode == -_signal.SIGKILL, (
+            f"round {i}: controller was not SIGKILLed (rc={rcode}); "
+            f"raise kill_appends[{i}]\n{err[-2000:]}"
+        )
+        kills += 1
+        if replay is not None:
+            replays.append(replay)
+    rcode, out, replay, err = run_child(root, "resume")
+    assert rcode == 0 and out is not None and out["succeeded"], (
+        f"final recovery run failed (rc={rcode}): {err[-2000:]}"
+    )
+    replays.append(replay)
+    recovered_events = out["recovered_events"]
+
+    chaos_epochs, chaos_scores, conditions = rows_by_x(root)
+    lost = {
+        x: steps
+        for x, steps in chaos_epochs.items()
+        if steps != list(range(1, epochs + 1))
+    }
+    assert not lost, f"lost/duplicated observations after recovery: {lost}"
+    assert chaos_scores == ref_scores, (
+        "recovered sweep rows are not bit-identical to the fault-free run"
+    )
+    assert set(conditions.values()) == {"Succeeded"}, conditions
+    assert kills >= 2, kills
+    assert recovered_events >= 1, "final load did not record ControllerRecovered"
+    max_replay = max(replays) if replays else 0.0
+    assert max_replay < 10.0, f"recovery replay took {max_replay:.1f}s (>= 10s)"
+    shutil.rmtree(ref_root, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "trials": n_trials,
+        "epochs": epochs,
+        "devices": n_devices,
+        "sigkills_injected": kills,
+        "kill_journal_appends": kill_appends,
+        "lost_observations": len(lost),
+        "bit_identical": chaos_scores == ref_scores,
+        "recovery_replays": len(replays),
+        "max_replay_seconds": round(max_replay, 3),
+        "replay_bound_seconds": 10.0,
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -3133,6 +3421,7 @@ OBSLOG_SCENARIOS = {
     "asha_device_seconds": _bench_asha_device_seconds,
     "bohb_convergence": _bench_bohb_convergence,
     "device_chaos_recovery": _bench_device_chaos_recovery,
+    "controller_kill_recovery": _bench_controller_kill_recovery,
 }
 
 

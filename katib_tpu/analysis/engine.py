@@ -212,9 +212,11 @@ def _load_baseline(repo_root: str) -> Set[Tuple[str, str, int]]:
 
 def write_baseline(findings: List[Finding], repo_root: str) -> str:
     path = os.path.join(repo_root, BASELINE_JSON)
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump([f2.to_dict() for f2 in findings], f, indent=2, sort_keys=True)
         f.write("\n")
+    os.replace(tmp, path)
     return path
 
 
@@ -251,6 +253,7 @@ RULE_SUMMARIES: Dict[str, str] = {
     "KTI302": "metric family or event reason missing from the catalog",
     "KTI303": "RuntimeConfig knob missing from ENV_OVERRIDES",
     "KTI304": "unbounded jax.devices()/jax.local_devices() probe outside utils/backend.py",
+    "KTI305": "persistence-path JSON write without the tmp+os.replace idiom",
     **KTX_SUMMARIES,
 }
 

@@ -27,6 +27,14 @@ exactly the kind an innocent-looking local edit silently breaks:
   ``utils.backend.bounded_devices`` / ``bounded_local_devices`` wrap the
   init in a bounded, verdict-cached probe — every unguarded call site
   re-opens the wedge the device plane (ISSUE 12) exists to close.
+- **KTI305 nonatomic-json-persist** — a JSON write into a file opened
+  ``"w"`` with no ``os.replace`` afterwards in the same function. Every
+  persistence path in the repo (state records, checkpoints, snapshots)
+  uses the tmp+``os.replace`` idiom so a crash mid-write leaves the
+  previous record intact; a bare ``open(path, "w")`` + ``json.dump``
+  leaves a truncated file that poisons the next load — exactly the
+  checkpoint corruption the crash-tolerant controller (ISSUE 14) cannot
+  recover from.
 """
 
 from __future__ import annotations
@@ -48,6 +56,7 @@ def check(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
     if ctx.path.endswith("config.py"):
         out += _knob_without_env(tree, ctx)
     out += _unbounded_device_probe(tree, ctx)
+    out += _nonatomic_json_persist(tree, ctx)
     return sorted(set(out), key=Finding.sort_key)
 
 
@@ -169,6 +178,75 @@ def _unbounded_device_probe(tree: ast.Module, ctx: RuleContext) -> List[Finding]
                     "(bounded timeout, cached verdict) instead",
                 )
             )
+    return out
+
+
+# -- KTI305 ------------------------------------------------------------------
+
+def _is_write_open(call: ast.AST) -> bool:
+    """open(path, "w"/"wt"/"w+", ...) — a truncating text open. Read opens
+    and binary opens (pickle paths manage their own tmp files) stay out."""
+    if not isinstance(call, ast.Call) or dotted_name(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and "w" in mode and "b" not in mode
+
+
+def _json_write_lines(body: List[ast.stmt]) -> List[int]:
+    """Lines inside a with-open("w") body that serialize JSON into the
+    handle: ``json.dump(...)`` or ``<f>.write(json.dumps(...))``."""
+    out: List[int] = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is not None and name.endswith("json.dump"):
+                out.append(node.lineno)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "write"
+                and node.args
+                and isinstance(node.args[0], ast.Call)
+                and (dotted_name(node.args[0].func) or "").endswith("json.dumps")
+            ):
+                out.append(node.lineno)
+    return out
+
+
+def _nonatomic_json_persist(tree: ast.Module, ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        replace_lines = [
+            node.lineno
+            for node in ast.walk(func)
+            if isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("os.replace", "os.rename")
+        ]
+        for node in ast.walk(func):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_write_open(item.context_expr) for item in node.items):
+                continue
+            for line in _json_write_lines(node.body):
+                if not any(r >= line for r in replace_lines):
+                    out.append(
+                        Finding(
+                            ctx.path, line, "KTI305",
+                            "JSON written to an open(.., 'w') handle with no "
+                            "os.replace afterwards in this function — a crash "
+                            "mid-write corrupts the record; write to "
+                            "<path>.tmp and os.replace it into place "
+                            "(the repo-wide persistence idiom)",
+                        )
+                    )
     return out
 
 

@@ -30,6 +30,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            running/paused/promoted/pruned counts and best
                            objective), offline from the state root
   metrics <trial>          raw observation log for one trial
+  recover <experiment>     offline crash-recovery inspection: the state
+                           root's single-writer lease, the recovery
+                           journal's tail, and the in-flight trials a
+                           checkpoint-preserving restart would requeue
   algorithms               registered suggestion / early-stopping algorithms
   check [paths]            recompile-hazard / lock-discipline / repo-invariant
                            static analysis (docs/static-analysis.md); exits 1
@@ -54,13 +58,24 @@ import sys
 from typing import Optional
 
 
-def _controller(root: Optional[str], devices: Optional[int] = None):
+def _controller(
+    root: Optional[str], devices: Optional[int] = None, readonly: bool = False
+):
     from .controller.experiment import ExperimentController
 
     devs = None
     if devices:
         devs = list(range(devices))
-    return ExperimentController(root_dir=root, devices=devs)
+    config = None
+    if readonly:
+        # inspection commands must not contend the running controller's
+        # single-writer lease (controller/recovery.py) — they only read
+        # persisted state, so the recovery subsystem stays off
+        from .config import load_config
+
+        config = load_config()
+        config.runtime.recovery = False
+    return ExperimentController(root_dir=root, devices=devs, config=config)
 
 
 def cmd_run(args) -> int:
@@ -111,7 +126,7 @@ def cmd_resume(args) -> int:
 
 
 def cmd_list(args) -> int:
-    ctrl = _controller(args.root)
+    ctrl = _controller(args.root, readonly=True)
     _load_all(ctrl, args.root)
     rows = [
         (e.name, e.status.condition.value, e.status.reason.value,
@@ -123,7 +138,7 @@ def cmd_list(args) -> int:
 
 
 def cmd_status(args) -> int:
-    ctrl = _controller(args.root)
+    ctrl = _controller(args.root, readonly=True)
     _load_all(ctrl, args.root)
     exp = ctrl.state.get_experiment(args.name)
     if exp is None:
@@ -134,7 +149,7 @@ def cmd_status(args) -> int:
 
 
 def cmd_trials(args) -> int:
-    ctrl = _controller(args.root)
+    ctrl = _controller(args.root, readonly=True)
     _load_all(ctrl, args.root)
     trials = ctrl.state.list_trials(args.name)
     rows = []
@@ -199,7 +214,7 @@ def cmd_queue(args) -> int:
     from .api.status import TrialCondition
     from .controller.fairshare import priority_of
 
-    ctrl = _controller(args.root)
+    ctrl = _controller(args.root, readonly=True)
     _load_all(ctrl, args.root)
     now = _time.time()
     rows = []
@@ -232,7 +247,7 @@ def cmd_queue(args) -> int:
 def cmd_importance(args) -> int:
     from .ui.server import parameter_importance
 
-    ctrl = _controller(args.root)
+    ctrl = _controller(args.root, readonly=True)
     _load_all(ctrl, args.root)
     exp = ctrl.state.get_experiment(args.name)
     if exp is None:
@@ -597,6 +612,111 @@ def cmd_rungs(args) -> int:
     return 0
 
 
+def cmd_recover(args) -> int:
+    """Offline crash-recovery inspection (ISSUE 14): the state root's
+    single-writer lease, the recovery journal's tail, and the in-flight
+    trial summary a checkpoint-preserving restart would act on — all read
+    straight from disk, no controller constructed (and therefore no lease
+    contention with a live one)."""
+    import os
+
+    from .controller import recovery
+    from .db.state import ExperimentStateStore
+    from .db.store import open_store
+
+    root = args.root
+    state_root = os.path.join(root, "state")
+    state = ExperimentStateStore(state_root if os.path.isdir(state_root) else None)
+    if state.root is None or not state.has_state(args.experiment):
+        print(f"no persisted state for experiment {args.experiment!r} under "
+              f"{state_root}", file=sys.stderr)
+        return 1
+    exp = state.load(args.experiment)
+    lease = recovery.read_lease(state_root)
+    jdir = recovery.journal_dir(root)
+    records = (
+        recovery.RecoveryJournal(jdir).records(args.experiment)
+        if os.path.isdir(jdir)
+        else []
+    )
+    store = open_store(os.path.join(root, "observations.db"))
+    try:
+        inflight = []
+        for t in state.list_trials(args.experiment):
+            if t.is_terminal and not any(
+                c.type == "Killed" and c.reason == "SchedulerShutdown"
+                for c in t.conditions
+            ):
+                continue
+            workdir = os.path.join(root, "trials", args.experiment, t.name)
+            ck_time = recovery.latest_checkpoint_time(workdir)
+            rows = store.get_observation_log(t.name)
+            preserved = (
+                sum(1 for r in rows if r.timestamp <= ck_time)
+                if ck_time is not None
+                else 0
+            )
+            inflight.append(
+                {
+                    "trial": t.name,
+                    "condition": t.condition.value,
+                    "reason": t.current_reason,
+                    "checkpointed": ck_time is not None,
+                    "rows": len(rows),
+                    "rowsPreservedOnRecovery": preserved,
+                }
+            )
+    finally:
+        store.close()
+    tail = records[-args.journal_tail:] if args.journal_tail else records
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "experiment": args.experiment,
+                "status": exp.status.condition.value,
+                "lease": lease.to_dict(),
+                "journal": {"records": len(records), "tail": tail},
+                "inflight": inflight,
+            },
+            indent=2, sort_keys=True,
+        ))
+        return 0
+    print(f"experiment: {args.experiment} ({exp.status.condition.value})")
+    holder = lease.payload.get("owner") or "-"
+    if not lease.exists:
+        print("lease:      none (no controller has locked this root)")
+    else:
+        verdict = (
+            "released" if lease.state == "released"
+            else "EXPIRED" if lease.expired
+            else "held" if lease.holder_alive
+            else "holder dead (takeable)"
+        )
+        print(
+            f"lease:      {verdict} by {holder} (pid "
+            f"{lease.payload.get('pid')}, fence {lease.payload.get('fence')}, "
+            f"age {lease.age_seconds:.1f}s / ttl {lease.payload.get('ttl')}s)"
+        )
+    print(f"journal:    {len(records)} record(s) under {jdir}")
+    for rec in tail:
+        extra = rec.get("trial") or ",".join(rec.get("trials", []) or [])
+        print(f"  seq {rec.get('seq'):>6}  {rec.get('op'):<9} {extra}")
+    if not inflight:
+        print("in-flight:  none (a recovery load would requeue nothing)")
+    else:
+        print(f"in-flight:  {len(inflight)} trial(s) a recovery load would requeue:")
+        _table(
+            ["TRIAL", "CONDITION", "REASON", "CKPT", "ROWS", "PRESERVED"],
+            [
+                (i["trial"], i["condition"], i["reason"],
+                 "yes" if i["checkpointed"] else "no",
+                 i["rows"], i["rowsPreservedOnRecovery"])
+                for i in inflight
+            ],
+        )
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -740,14 +860,8 @@ def cmd_serve(args) -> int:
 
 def _load_all(ctrl, root: Optional[str]) -> None:
     """Hydrate persisted experiments from the state root."""
-    import os
-
-    state_root = os.path.join(root, "state") if root else None
-    if not state_root or not os.path.isdir(state_root):
-        return
-    for name in sorted(os.listdir(state_root)):
-        if ctrl.state.has_state(name):
-            ctrl.state.load(name)
+    for name in ctrl.state.persisted_experiments():
+        ctrl.state.load(name)
 
 
 def _print_status(exp) -> None:
@@ -941,6 +1055,17 @@ def main(argv=None) -> int:
     ui.add_argument("--host", default="127.0.0.1")
     ui.add_argument("--port", type=int, default=8080)
     ui.set_defaults(fn=cmd_ui)
+
+    rc = sub.add_parser(
+        "recover",
+        help="offline crash-recovery inspection: lease state, journal tail, "
+        "and the in-flight trials a recovery load would requeue",
+    )
+    rc.add_argument("experiment")
+    rc.add_argument("--journal-tail", type=int, default=20,
+                    help="journal records to show (0 = all)")
+    rc.add_argument("--format", choices=("text", "json"), default="text")
+    rc.set_defaults(fn=cmd_recover)
 
     sv = sub.add_parser(
         "serve", help="run the suggestion/early-stopping/db-manager gRPC service"

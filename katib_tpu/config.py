@@ -157,6 +157,19 @@ class RuntimeConfig:
     # window). 0 (default) = promotions submit at the decision point,
     # byte-identical to the PR 11 behavior.
     promotion_dwell_seconds: float = 0.0
+    # Crash-tolerant controller (controller/recovery.py, ISSUE 14): the
+    # recovery journal, the lease-fenced single-writer on the state root,
+    # and checkpoint-preserving load_experiment (truncate the observation
+    # log to the last durable checkpoint instead of dropping it).
+    # recovery=false / KATIB_TPU_RECOVERY=0 constructs nothing and restores
+    # the pre-recovery load_experiment behavior byte-identically.
+    recovery: bool = True
+    # controller lease TTL: a successor may take over this many seconds
+    # after the last heartbeat (immediately when the holder pid is dead)
+    controller_lease_seconds: float = 15.0
+    # standby mode: a second controller on a held state root waits for the
+    # lease to expire and takes over instead of refusing to start
+    controller_lease_standby: bool = False
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -202,6 +215,9 @@ ENV_OVERRIDES: Dict[str, str] = {
     "warm_start_max_points": "KATIB_TPU_WARM_START_MAX_POINTS",
     "multifidelity": "KATIB_TPU_MULTIFIDELITY",
     "promotion_dwell_seconds": "KATIB_TPU_PROMOTION_DWELL_SECONDS",
+    "recovery": "KATIB_TPU_RECOVERY",
+    "controller_lease_seconds": "KATIB_TPU_CONTROLLER_LEASE_SECONDS",
+    "controller_lease_standby": "KATIB_TPU_CONTROLLER_LEASE_STANDBY",
     "device_plane": "KATIB_TPU_DEVICE_PLANE",
     "device_probe_timeout_seconds": "KATIB_TPU_DEVICE_PROBE_TIMEOUT_SECONDS",
     "device_reprobe_interval_seconds": "KATIB_TPU_DEVICE_REPROBE_INTERVAL_SECONDS",

@@ -364,6 +364,18 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_device_lease_zombie": "Leases in ZOMBIE state (abandoned holders awaiting reclaim at lease expiry).",
     "katib_device_lost_total": "Devices removed from custody: probe failures, executor backend errors, chaos revocations.",
     "katib_backend_failover_total": "Whole-backend failovers (every live device lost; the fallback pool was swapped in).",
+    # crash-tolerant controller (ISSUE 14, controller/recovery.py) — the
+    # ControllerRecovered / LeaseTakenOver / QuiesceTimeout events pair
+    # with these series
+    "katib_controller_lease_renewals_total": "Heartbeat renewals of the controller's single-writer lease on the state root.",
+    "katib_controller_lease_takeover_total": "Times this controller took over an expired or dead-holder lease from a previous incarnation.",
+    "katib_controller_lease_age_seconds": "Seconds this controller has continuously held the state-root lease.",
+    "katib_controller_lease_fence": "Monotonic fence token of the held lease (increments on every takeover).",
+    "katib_recovery_replays_total": "Checkpoint-preserving restarts: load_experiment passes that replayed the recovery journal.",
+    "katib_recovery_trials_resubmitted_total": "In-flight trials requeued by a recovery load (one dispatch barrier per restart).",
+    "katib_recovery_rows_preserved_total": "Observation rows preserved across controller restarts (at or before the last durable checkpoint).",
+    "katib_recovery_rows_truncated_total": "Un-checkpointed observation rows truncated at restart (the resumed stint re-reports them).",
+    "katib_recovery_replay_seconds": "Wall-clock of the last recovery replay (journal + truncation + requeue), per experiment.",
 }
 
 
@@ -429,4 +441,8 @@ EVENT_CATALOG: Dict[str, str] = {
     "DeviceLost": "A device left custody (probe failure, heartbeat miss, backend error, or chaos injection); the holding gang preempts.",
     "DeviceLeaseRevoked": "The plane voided a lease: an expired zombie hold was reclaimed into the pool, or a heartbeat-missed holder was cut off.",
     "BackendFailedOver": "Every live device of the backend was lost; the fallback pool was swapped in so the sweep degrades instead of dying.",
+    # crash-tolerant controller (ISSUE 14, controller/recovery.py)
+    "ControllerRecovered": "A restarted controller replayed the recovery journal and requeued in-flight trials with their checkpointed observation rows preserved.",
+    "LeaseTakenOver": "This controller took over the state root's single-writer lease from an expired or dead previous holder (fence token incremented).",
+    "QuiesceTimeout": "The scheduler did not quiesce within its deadline after experiment completion; a zombie trial may still hold its gang allocation.",
 }

@@ -326,13 +326,22 @@ class SubprocessExecutor:
                 cwd=spec.trial_template.working_dir or workdir,
                 start_new_session=True,
             )
+            # crash fencing (controller/recovery.py): record the child's
+            # pid (== its session/pgid) so a controller restarted after a
+            # SIGKILL can fence this orphan before re-running the trial
+            from .recovery import clear_pidfile, write_pidfile
+
+            write_pidfile(workdir, proc.pid)
             if ctx.on_subprocess is not None:
                 # telemetry: /proc sampling follows the child, not this process
                 ctx.on_subprocess([proc.pid])
-            outcome = self._wait(
-                proc, stdout_path, metrics_file, monitor, spec, handle, prom_logs,
-                heartbeat=ctx.on_report,
-            )
+            try:
+                outcome = self._wait(
+                    proc, stdout_path, metrics_file, monitor, spec, handle,
+                    prom_logs, heartbeat=ctx.on_report,
+                )
+            finally:
+                clear_pidfile(workdir)
         if prom_logs:
             self.obs_store.report_observation_log(trial.name, prom_logs)
 

@@ -119,6 +119,27 @@ class ExperimentController:
 
         self.events = EventRecorder()
         self.metrics = MetricsRegistry()
+        # Crash-tolerant controller (controller/recovery.py, ISSUE 14):
+        # lease-fenced single-writer on the state root + the recovery
+        # journal. The lease is acquired BEFORE any other subsystem opens
+        # the root for writing (obslog, tracer, compile registry), so a
+        # second controller is fenced out before it can corrupt anything.
+        # Disabled (runtime.recovery=false / KATIB_TPU_RECOVERY=0, or no
+        # persisted root) nothing is constructed and every consult below
+        # is one `is None` check.
+        self.lease = None
+        self.journal = None
+        if rt.recovery and state_root:
+            from .recovery import ControllerLease, RecoveryJournal, journal_dir
+
+            self.lease = ControllerLease(
+                state_root,
+                ttl_seconds=rt.controller_lease_seconds,
+                standby=rt.controller_lease_standby,
+                events=self.events,
+                metrics=self.metrics,
+            ).acquire()
+            self.journal = RecoveryJournal(journal_dir(root_dir))
         store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
         if rt.obslog_buffered and isinstance(store, SqliteObservationStore):
             # group-commit write-behind pipeline (docs/data-plane.md): the
@@ -187,6 +208,7 @@ class ExperimentController:
                 # dispatches as vmapped packs; 0 = submit at the decision
                 # point, byte-identical to PR 11
                 dwell_seconds=rt.promotion_dwell_seconds,
+                journal=self.journal,
             )
         self._completed_seen: set = set()
         self._closed = threading.Event()
@@ -267,6 +289,7 @@ class ExperimentController:
             ),
             multifidelity=self.multifidelity,
             device_plane=self.device_plane,
+            journal=self.journal,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -498,6 +521,16 @@ class ExperimentController:
         suggest_start = time.time()
         assignments = self.suggestions.sync_assignments(exp, trials, requests)
         suggest_end = time.time()
+        if self.journal is not None and assignments:
+            # journal the committed batch BEFORE any trial record exists: a
+            # crash inside the loop below leaves assignments whose trials
+            # were never persisted, and replay (load_experiment) completes
+            # them from the persisted SuggestionState instead of leaving
+            # them orphaned until the next reconcile recomputes the plan
+            self.journal.append(
+                "suggest", exp.name,
+                trials=[a.name for a in assignments[:add_count]],
+            )
         # Deferred dispatch under the scheduler's barrier: queue the whole
         # batch first, then one dispatch pass — pack formation
         # (controller/packing.py) needs the batch's packable trials waiting
@@ -509,6 +542,11 @@ class ExperimentController:
             for assignment in assignments[:add_count]:
                 trial = Trial.from_assignment(assignment, exp.name)
                 trial.labels["katib-tpu/experiment"] = exp.name
+                if self.journal is not None:
+                    # write-ahead: the submit intent is durable before the
+                    # trial record, so the exactly-once commit has a crash
+                    # edge, not just the thread-race edge under the barrier
+                    self.journal.append("submit", exp.name, trial=trial.name)
                 self.state.create_trial(trial)
                 if self.tracer.enabled:
                     # the trial's trace starts where its lifecycle did: at
@@ -697,7 +735,16 @@ class ExperimentController:
             # immediately reuses the chips (or asserts free_count) races the
             # last release. Bounded: a zombie trial in its kill-grace window
             # stops the wait at the deadline rather than hanging the caller.
-            self.scheduler.quiesce(name, timeout=10.0)
+            if not self.scheduler.quiesce(name, timeout=10.0):
+                # hitting the deadline means a zombie gang still holds chips
+                # — make it visible instead of returning silently
+                self.events.event(
+                    name, "Experiment", name, "QuiesceTimeout",
+                    "scheduler did not quiesce within 10s after completion; "
+                    "a zombie trial may still hold its gang allocation "
+                    "(see /api/queue devices.quarantined)",
+                    warning=True,
+                )
         return exp
 
     def load_experiment(self, name: str) -> Experiment:
@@ -714,6 +761,16 @@ class ExperimentController:
         new process (the callable does not serialize — the reference's
         equivalent constraint is that runSpecs are declarative YAML); such
         in-flight trials are marked Killed instead of requeued.
+
+        With recovery enabled (``runtime.recovery``, the default) the
+        restart is CHECKPOINT-PRESERVING: the journal is replayed first
+        (crash-edge intents — a journaled terminal transition or a
+        committed-but-unpersisted suggestion — are completed), orphaned
+        trial processes of the previous incarnation are fenced, and each
+        in-flight trial's observation log is truncated only to its last
+        durable checkpoint instead of dropped, the whole batch requeued
+        under one dispatch barrier so packed/fused gangs re-form. With
+        ``KATIB_TPU_RECOVERY=0`` the legacy path below runs byte-identically.
         """
         exp = self.state.load(name)
         if exp is None:
@@ -722,6 +779,8 @@ class ExperimentController:
         if exp.status.is_completed:
             self._completed_seen.add(name)
             return exp
+        if self.config.runtime.recovery and self.journal is not None:
+            return self._load_with_recovery(exp)
         resumable = exp.spec.trial_template.function is None
         for trial in self.state.list_trials(name):
             # look up the Killed condition entry by TYPE — _update_conditions
@@ -768,6 +827,211 @@ class ExperimentController:
                 self.state.update_trial(trial)
         return exp
 
+    # -- crash recovery (controller/recovery.py, ISSUE 14) -------------------
+
+    def _load_with_recovery(self, exp: Experiment) -> Experiment:
+        """Checkpoint-preserving restart: journal replay, orphan fencing,
+        truncate-to-checkpoint, and a single-barrier requeue."""
+        from ..runtime import population as fused_population
+        from . import recovery
+
+        t0 = time.time()
+        name = exp.name
+        journal_high = self._replay_journal(exp)
+        resumable = exp.spec.trial_template.function is None
+        requeue: List[Trial] = []
+        for trial in self.state.list_trials(name):
+            killed_cond = next(
+                (
+                    c
+                    for c in trial.conditions
+                    if c.type == TrialCondition.KILLED.value
+                ),
+                None,
+            )
+            shutdown_killed = (
+                trial.condition == TrialCondition.KILLED
+                and killed_cond is not None
+                and killed_cond.reason == "SchedulerShutdown"
+            )
+            if trial.is_terminal and not shutdown_killed:
+                # terminal trials — including rung-paused (EarlyStopped +
+                # PAUSED_LABEL) ones — keep their rows; the multi-fidelity
+                # engine rejoins them on the first pump via the persisted
+                # label rebuild (multifidelity._entry)
+                continue
+            if self.scheduler.is_active(trial.name):
+                continue  # idempotence: a second load must not double-submit
+            if not resumable:
+                trial.set_condition(
+                    TrialCondition.KILLED,
+                    "TrialLost",
+                    "in-memory trial function lost on controller restart",
+                )
+                self.state.update_trial(trial)
+                continue
+            requeue.append(trial)
+        fenced = resubmitted = resumed_from_ckpt = 0
+        rows_preserved = rows_truncated = 0
+        fused_ck_time: Optional[float] = None
+        # ONE barrier around the whole batch: pack formation must see every
+        # in-flight member together, so fused sweeps and packed gangs
+        # re-form from their carry checkpoints instead of the first member
+        # dispatching solo (exactly the batch-submit invariant of
+        # _reconcile_trials, now applied to the restart path)
+        with self.scheduler.dispatch_barrier():
+            for trial in requeue:
+                workdir = (
+                    os.path.join(self.root_dir, "trials", name, trial.name)
+                    if self.root_dir
+                    else None
+                )
+                if recovery.fence_stale_trial_process(workdir, trial.name):
+                    fenced += 1
+                checkpoint_dir = None
+                if fused_population.FUSED_LABEL in trial.labels and self.root_dir:
+                    # fused sweep members share the chunk-boundary carry
+                    # checkpoint — the same dir _reconcile_fused dispatched
+                    # them with (it wins over any suggester lineage dir), so
+                    # the re-formed gang resumes mid-sweep
+                    checkpoint_dir = os.path.join(self.root_dir, "fusedpop", name)
+                else:
+                    try:
+                        self.suggestions.suggester_for(exp)
+                        checkpoint_dir = self._checkpoint_dir_for(exp, trial)
+                    except Exception:
+                        pass  # suggester re-creation fails loudly on next sync
+                ck_time = recovery.latest_checkpoint_time(
+                    checkpoint_dir or workdir
+                )
+                if (
+                    ck_time is not None
+                    and fused_population.FUSED_LABEL in trial.labels
+                ):
+                    fused_ck_time = ck_time
+                if ck_time is None:
+                    # no durable checkpoint: the re-run starts clean — the
+                    # legacy invariant, unchanged
+                    self.obs_store.delete_observation_log(trial.name)
+                    detail = "re-running from scratch"
+                else:
+                    rows_truncated += self.obs_store.truncate_observation_log(
+                        trial.name, ck_time
+                    )
+                    kept = len(self.obs_store.get_observation_log(trial.name))
+                    rows_preserved += kept
+                    resumed_from_ckpt += 1
+                    detail = (
+                        f"resuming from checkpoint ({kept} observation "
+                        "row(s) preserved)"
+                    )
+                self.events.event(
+                    name, "Trial", trial.name, "TrialResubmitted",
+                    f"controller restarted; in-flight trial re-queued, {detail}",
+                )
+                self.scheduler.submit(
+                    exp, trial, checkpoint_dir=checkpoint_dir, dispatch=False
+                )
+                resubmitted += 1
+            if fused_ck_time is not None:
+                # the fused demux writes population best/median rows under
+                # the <exp>-population pseudo-trial AFTER the carry save;
+                # the resumed sweep re-demuxes everything past the carry, so
+                # the pseudo log's tail must be truncated with the members'
+                rows_truncated += self.obs_store.truncate_observation_log(
+                    f"{name}-population", fused_ck_time
+                )
+        if journal_high:
+            # intents at or below the replayed high-water mark are consumed;
+            # the requeued batch writes fresh ones
+            self.journal.compact(name, journal_high)
+        replay_seconds = time.time() - t0
+        self.metrics.inc("katib_recovery_replays_total", experiment=name)
+        self.metrics.inc(
+            "katib_recovery_trials_resubmitted_total",
+            value=float(resubmitted), experiment=name,
+        )
+        self.metrics.inc(
+            "katib_recovery_rows_preserved_total",
+            value=float(rows_preserved), experiment=name,
+        )
+        self.metrics.inc(
+            "katib_recovery_rows_truncated_total",
+            value=float(rows_truncated), experiment=name,
+        )
+        self.metrics.set_gauge(
+            "katib_recovery_replay_seconds", round(replay_seconds, 6),
+            experiment=name,
+        )
+        self.events.event(
+            name, "Experiment", name, "ControllerRecovered",
+            f"recovered in {replay_seconds:.3f}s: {resubmitted} in-flight "
+            f"trial(s) requeued ({resumed_from_ckpt} resuming from "
+            f"checkpoints, {rows_preserved} observation row(s) preserved, "
+            f"{rows_truncated} un-checkpointed row(s) truncated, "
+            f"{fenced} orphaned process(es) fenced)",
+        )
+        return exp
+
+    def _replay_journal(self, exp: Experiment) -> int:
+        """Replay this experiment's journal intents against the loaded
+        state; returns the highest seq seen (0 = empty journal).
+
+        Two crash edges are closed here:
+
+        - ``terminal`` write-ahead: the journal records a trial's terminal
+          transition BEFORE the state store does, so a crash between the
+          two leaves a journaled condition for a trial the state still
+          calls running — apply it (refolding the observation from the
+          durable rows) instead of re-running a finished trial.
+        - ``suggest``/``submit`` intents naming trials that were never
+          persisted: the suggestion commit is durable (SuggestionState) but
+          the trial record is not — complete the commit from the persisted
+          assignment so the budget math sees it immediately rather than an
+          orphan the next reconcile has to re-derive.
+        """
+        records = self.journal.records(exp.name)
+        if not records:
+            return 0
+        trials = {t.name: t for t in self.state.list_trials(exp.name)}
+        suggestion = self.state.get_suggestion(exp.name)
+        assignments = {
+            a.name: a for a in (suggestion.suggestions if suggestion else [])
+        }
+        for rec in records:
+            op = rec.get("op")
+            if op == "terminal":
+                trial = trials.get(rec.get("trial", ""))
+                cond_raw = rec.get("condition")
+                if trial is None or trial.is_terminal or not cond_raw:
+                    continue
+                try:
+                    cond = TrialCondition(cond_raw)
+                except ValueError:
+                    continue
+                trial.observation = self.obs_store.folded(
+                    trial.name, exp.spec.objective.all_metric_names()
+                )
+                trial.set_condition(
+                    cond,
+                    rec.get("reason") or cond.value,
+                    "terminal transition replayed from the recovery journal "
+                    "(crashed between journal append and state write)",
+                )
+                self.state.update_trial(trial)
+            elif op in ("suggest", "submit"):
+                names = rec.get("trials") or (
+                    [rec["trial"]] if rec.get("trial") else []
+                )
+                for tn in names:
+                    if tn in trials or tn not in assignments:
+                        continue
+                    trial = Trial.from_assignment(assignments[tn], exp.name)
+                    trial.labels["katib-tpu/experiment"] = exp.name
+                    self.state.create_trial(trial)
+                    trials[tn] = trial
+        return int(records[-1].get("seq", 0))
+
     def delete_experiment(self, name: str) -> None:
         """Delete an experiment and all its state (kubectl delete experiment)."""
         for t in self.state.list_trials(name):
@@ -795,3 +1059,7 @@ class ExperimentController:
             self.device_plane.stop()
         self.telemetry.stop()
         self.obs_store.close()
+        if self.lease is not None:
+            # released LAST: every subsystem above has stopped writing the
+            # root, so a standby successor taking over sees quiesced state
+            self.lease.release()

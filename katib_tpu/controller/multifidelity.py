@@ -310,11 +310,16 @@ class MultiFidelityEngine:
         events=None,
         metrics=None,
         dwell_seconds: float = 0.0,
+        journal=None,
     ):
         self.state = state
         self.obs_store = obs_store
         self.events = events
         self.metrics = metrics
+        # recovery journal (controller/recovery.py): promotion batches are
+        # journaled before resubmission so the controller-kill chaos grammar
+        # has a deterministic kill point at the promotion seam; None = off
+        self.journal = journal
         self.dwell_seconds = max(float(dwell_seconds or 0.0), 0.0)
         self._lock = threading.Lock()
         self._exps: Dict[str, _ExperimentRungs] = {}
@@ -616,6 +621,14 @@ class MultiFidelityEngine:
         dwelled: bool,
     ) -> bool:
         promoted_any = False
+        if self.journal is not None and candidates:
+            # intent before action: a crash inside the barrier below leaves
+            # the claimed candidates visible to `katib-tpu recover`, and the
+            # label rebuild re-derives their paused state on restart
+            self.journal.append(
+                "promote", exp.name,
+                trials=[name for name, _, _ in candidates],
+            )
         with scheduler.dispatch_barrier():
             for name, b, k in candidates:
                 try:
@@ -682,6 +695,19 @@ class MultiFidelityEngine:
         if trial is None:
             return False
         if trial.condition != TrialCondition.EARLY_STOPPED or PAUSED_LABEL not in trial.labels:
+            if st is not None and not trial.is_terminal:
+                # Mid-transition race: on_rung_boundary registers the pause
+                # (under the engine lock) BEFORE it persists the
+                # EarlyStopped/RungPaused condition, so a concurrent claimer
+                # can reach here while the trial still reads Running.
+                # Consuming the claim would lose the promotion forever (the
+                # trial ends the sweep stuck RungPaused, outside both the
+                # paused map and the prune walk) — un-claim instead so the
+                # next boundary/pump retries once the transition lands.
+                with self._lock:
+                    st.brackets[bracket].promoted[k].discard(name)
+                    st.paused[name] = (bracket, k)
+                return False
             return False  # killed during pause, or already resumed elsewhere
         next_budget = ladder.format(ladder.rungs[k + 1])
         for a in trial.parameter_assignments:

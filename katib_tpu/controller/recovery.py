@@ -1,0 +1,563 @@
+"""Crash-tolerant controller plumbing — journal, lease, checkpoint tail.
+
+Upstream Katib survives controller restarts because every object it owns
+lives out-of-process (CRDs in etcd, observation rows in MySQL, PAPER.md
+§1); the controller pod is stateless and a kubelet can SIGKILL it at any
+instant. katib-tpu's controller holds real in-memory state (scheduler
+queue, dispatch barrier, dwell buffers), so a hard kill used to be lossy:
+``load_experiment`` dropped every in-flight trial's observation log and
+re-ran it from scratch. This module supplies the three pieces that make a
+SIGKILL a *recoverable* event (docs/recovery.md):
+
+- :class:`RecoveryJournal` — a tiny append-only intent log under
+  ``<root>/journal/``. One record per scheduler-visible transition
+  (suggestion batch committed, trial submitted, unit dispatched, terminal
+  condition reached, promotion batch claimed), each written as its own
+  segment file via the tmp+``os.replace`` idiom, so a torn write loses at
+  most the record being appended — never an earlier one. Replay at load
+  time closes the crash edges the thread-race machinery (exactly-once
+  suggestion commit, dispatch barrier) cannot see: a terminal transition
+  journaled but not yet persisted is applied; a suggestion assignment
+  committed without its trial record is completed instead of orphaned.
+  The journal's append counter doubles as the deterministic clock for the
+  ``kill_controller=N`` chaos directive (utils/chaos.py).
+
+- :class:`ControllerLease` — a heartbeated single-writer lease file on
+  the state root (the same acquire/heartbeat/expire lifecycle shape as
+  the device plane's :class:`~.deviceplane.DeviceLease`, lifted from
+  devices to the controller itself). A second controller over the same
+  root either refuses to start (:class:`LeaseHeldError`) or, in standby
+  mode, blocks until the active lease is released, expires, or its
+  holder's pid dies — the seed of ROADMAP item 1's replica failover. The
+  fence token increments on every takeover so split-brain writers are
+  detectable.
+
+- **checkpoint-tail truncation** — :func:`latest_checkpoint_time` reads
+  the last durable checkpoint instant of a trial's checkpoint store
+  (runtime/checkpoints.py pickle artifacts, orbax step dirs, or a fused
+  sweep's carry files), and ``load_experiment`` truncates only the
+  observation rows *newer* than it. Rows covered by the checkpoint are
+  preserved; the resumed stint re-reports everything after it, so the
+  stitched log is exactly one continuous execution (the
+  log-never-mixes-two-executions invariant, now crash-shaped).
+
+- **orphan fencing** — a SIGKILLed controller leaves its subprocess
+  trials running (they own their sessions); the restarted controller
+  must not let the previous incarnation keep writing while it re-runs
+  the same trial. The subprocess executor drops a ``trial.pid`` marker
+  in each trial workdir; :func:`fence_stale_trial_process` verifies the
+  recorded pid still belongs to that trial (``/proc/<pid>/environ``
+  carries the trial-name env binding) and SIGKILLs its process group
+  before the trial is requeued.
+
+Everything here is gated by ``runtime.recovery`` (``KATIB_TPU_RECOVERY``);
+off, nothing is constructed and ``load_experiment`` is byte-identical to
+the pre-recovery behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("katib_tpu.recovery")
+
+LEASE_FILE = "controller.lease"
+JOURNAL_DIRNAME = "journal"
+PIDFILE_NAME = "trial.pid"
+
+# lease lifecycle states — the DeviceLease vocabulary, minus ZOMBIE (a
+# controller has no grace window: its heartbeat either runs or it is dead)
+LEASE_ACTIVE = "active"
+LEASE_RELEASED = "released"
+
+# journal ops (docs/recovery.md): every record carries seq/ts/op/experiment
+OP_SUGGEST = "suggest"      # suggestion batch committed to the state store
+OP_SUBMIT = "submit"        # trial about to be created/queued
+OP_DISPATCH = "dispatch"    # dispatch unit started onto devices
+OP_TERMINAL = "terminal"    # trial reached a terminal condition (write-ahead)
+OP_PROMOTE = "promote"      # multi-fidelity promotion batch claimed
+
+
+class LeaseHeldError(RuntimeError):
+    """Another live controller holds the state root's writer lease."""
+
+
+# -- recovery journal ---------------------------------------------------------
+
+
+class RecoveryJournal:
+    """Append-only intent log: one JSON segment file per record.
+
+    Each append is individually atomic (tmp + ``os.replace``) and carries a
+    monotonic ``seq`` that survives restarts (the next process resumes at
+    ``max(existing)+1``). The per-process append counter — not the absolute
+    seq — keys the ``kill_controller=N`` chaos directive, so a restarted
+    controller under chaos counts its own appends from 1 again and a
+    schedule like "kill at the 6th append" is reproducible per incarnation.
+    """
+
+    MAX_SEGMENTS = 4096  # bound the directory; oldest intents are long-dead
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._next = self._scan_max() + 1
+        self._appended = 0  # this process's appends (the chaos counter)
+
+    def _scan_max(self) -> int:
+        top = 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        for fn in names:
+            if fn.endswith(".json"):
+                try:
+                    top = max(top, int(fn[:-5]))
+                except ValueError:
+                    continue
+        return top
+
+    def append(self, op: str, experiment: str = "", **fields: Any) -> int:
+        """Durably record one intent; returns its seq. After the record is
+        on disk the scheduled chaos kill (if any) fires — SIGKILL of this
+        process, the hard-crash injection the whole module exists for."""
+        from ..utils import chaos
+
+        with self._lock:
+            seq = self._next
+            self._next += 1
+            self._appended += 1
+            appended = self._appended
+            record = {"seq": seq, "ts": time.time(), "op": op,
+                      "experiment": experiment}
+            record.update(fields)
+            path = os.path.join(self.directory, f"{seq:010d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(record))
+            os.replace(tmp, path)
+            if appended % 256 == 0:
+                self._prune_locked()
+        plan = chaos.active()
+        if plan is not None and plan.take_controller_kill(appended):
+            log.warning(
+                "chaos kill_controller firing at journal append %d (seq %d)",
+                appended, seq,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+        return seq
+
+    def _prune_locked(self) -> None:
+        try:
+            segs = sorted(
+                fn for fn in os.listdir(self.directory) if fn.endswith(".json")
+            )
+        except OSError:
+            return
+        for fn in segs[: max(len(segs) - self.MAX_SEGMENTS, 0)]:
+            try:
+                os.remove(os.path.join(self.directory, fn))
+            except OSError:
+                pass
+
+    def records(self, experiment: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Every readable record in seq order; a torn segment (crash mid-
+        replace can only leave a stray ``.tmp``) is skipped, not fatal."""
+        out: List[Dict[str, Any]] = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return out
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.directory, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if experiment is None or rec.get("experiment") == experiment:
+                out.append(rec)
+        out.sort(key=lambda r: r.get("seq", 0))
+        return out
+
+    def compact(self, experiment: str, upto_seq: int) -> int:
+        """Drop this experiment's records with seq <= upto_seq (replay
+        consumed them); returns the number removed."""
+        removed = 0
+        for rec in self.records(experiment):
+            if rec.get("seq", 0) > upto_seq:
+                continue
+            try:
+                os.remove(
+                    os.path.join(self.directory, f"{int(rec['seq']):010d}.json")
+                )
+                removed += 1
+            except (OSError, KeyError, ValueError):
+                continue
+        return removed
+
+
+def journal_dir(root_dir: str) -> str:
+    """Canonical journal location under a controller root."""
+    return os.path.join(root_dir, JOURNAL_DIRNAME)
+
+
+# -- controller lease ---------------------------------------------------------
+
+
+@dataclass
+class LeaseView:
+    """Decoded lease file + liveness verdict (the `recover` CLI view)."""
+
+    path: str
+    exists: bool
+    payload: Dict[str, Any]
+    state: str
+    age_seconds: Optional[float]
+    expired: bool
+    holder_alive: bool
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "exists": self.exists,
+            "state": self.state,
+            "ageSeconds": self.age_seconds,
+            "expired": self.expired,
+            "holderAlive": self.holder_alive,
+            **{k: self.payload.get(k) for k in
+               ("owner", "pid", "host", "fence", "ttl")},
+        }
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def read_lease(state_root: str) -> LeaseView:
+    """Decode the lease file without touching it (offline inspection)."""
+    path = os.path.join(state_root, LEASE_FILE)
+    payload: Dict[str, Any] = {}
+    exists = os.path.exists(path)
+    if exists:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {}
+    state = payload.get("state", LEASE_RELEASED if not payload else LEASE_ACTIVE)
+    renewed = payload.get("renewed")
+    ttl = float(payload.get("ttl", 0.0) or 0.0)
+    age = (time.time() - float(renewed)) if renewed is not None else None
+    expired = age is None or (ttl > 0 and age > ttl)
+    # same-host liveness: the lease records host+pid; a foreign host's pid
+    # cannot be probed, so it is presumed alive until the TTL says otherwise
+    same_host = payload.get("host") in (None, socket.gethostname())
+    alive = _pid_alive(payload.get("pid")) if same_host else not expired
+    return LeaseView(
+        path=path, exists=exists, payload=payload, state=state,
+        age_seconds=age, expired=expired, holder_alive=alive,
+    )
+
+
+class ControllerLease:
+    """Heartbeated single-writer lease on a state root.
+
+    Acquisition rules (in order):
+
+    - no file / ``released`` state / expired TTL / dead same-host holder
+      pid → take over immediately (fence+1);
+    - holder pid is THIS process → re-acquire (a second controller inside
+      one process is a test-only pattern; cross-process single-writer is
+      the contract being enforced);
+    - fresh lease held by a foreign live process → raise
+      :class:`LeaseHeldError`, or in ``standby`` mode poll until one of
+      the above becomes true (the PR 12 zombie-reclaim loop, pointed at
+      the controller itself).
+
+    The heartbeat thread renews at ttl/3; a renewal that finds a foreign
+    owner means another controller fenced us out — we stop writing the
+    file (never fight over it) and mark the lease lost.
+    """
+
+    def __init__(
+        self,
+        state_root: str,
+        ttl_seconds: float = 15.0,
+        standby: bool = False,
+        events=None,
+        metrics=None,
+        standby_timeout: Optional[float] = None,
+    ):
+        self.path = os.path.join(state_root, LEASE_FILE)
+        self.ttl = max(float(ttl_seconds), 1.0)
+        self.standby = standby
+        self.standby_timeout = standby_timeout
+        self.events = events
+        self.metrics = metrics
+        self.owner = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.fence = 0
+        self.lost = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(state_root, exist_ok=True)
+
+    # -- file IO -------------------------------------------------------------
+
+    def _write(self, state: str, acquired: Optional[float] = None) -> None:
+        now = time.time()
+        payload = {
+            "owner": self.owner,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "state": state,
+            "fence": self.fence,
+            "acquired": acquired if acquired is not None else now,
+            "renewed": now,
+            "ttl": self.ttl,
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload))
+        os.replace(tmp, self.path)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _takeable(self, view: LeaseView) -> bool:
+        if not view.exists or not view.payload:
+            return True
+        if view.state == LEASE_RELEASED:
+            return True
+        if view.expired:
+            return True
+        if view.payload.get("host") in (None, socket.gethostname()):
+            pid = view.payload.get("pid")
+            if pid == os.getpid():
+                return True  # in-process namesake: same writer, new handle
+            if not _pid_alive(pid):
+                return True  # SIGKILLed predecessor: no TTL wait needed
+        return False
+
+    def acquire(self) -> "ControllerLease":
+        deadline = (
+            time.time() + self.standby_timeout
+            if (self.standby and self.standby_timeout is not None)
+            else None
+        )
+        while True:
+            view = read_lease(os.path.dirname(self.path))
+            if self._takeable(view):
+                prior = view.payload if view.exists else {}
+                self.fence = int(prior.get("fence", 0) or 0) + 1
+                self._write(LEASE_ACTIVE)
+                taken_over = bool(prior) and prior.get("state") == LEASE_ACTIVE
+                if taken_over and prior.get("pid") != os.getpid():
+                    log.warning(
+                        "took over controller lease from %s (pid %s, %s)",
+                        prior.get("owner"), prior.get("pid"),
+                        "expired" if view.expired else "dead holder",
+                    )
+                    if self.metrics is not None:
+                        self.metrics.inc("katib_controller_lease_takeover_total")
+                    if self.events is not None:
+                        self.events.event(
+                            "", "Controller", self.owner, "LeaseTakenOver",
+                            f"controller lease taken over from "
+                            f"{prior.get('owner')} (pid {prior.get('pid')}, "
+                            f"fence {self.fence})",
+                            warning=True,
+                        )
+                self._start_heartbeat()
+                return self
+            if not self.standby:
+                raise LeaseHeldError(
+                    f"state root is locked by controller "
+                    f"{view.payload.get('owner')} (pid "
+                    f"{view.payload.get('pid')}, renewed "
+                    f"{view.age_seconds:.1f}s ago, ttl {view.payload.get('ttl')}s)"
+                    " — stop it, wait for the lease to expire, or start this "
+                    "one in standby mode (runtime.controller_lease_standby)"
+                )
+            if deadline is not None and time.time() > deadline:
+                raise LeaseHeldError(
+                    "standby takeover timed out waiting for the active "
+                    "controller lease to expire"
+                )
+            time.sleep(min(self.ttl / 4.0, 1.0))
+
+    def _start_heartbeat(self) -> None:
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="controller-lease"
+        )
+        self._thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        acquired = time.time()
+        while not self._stop.wait(self.ttl / 3.0):
+            view = read_lease(os.path.dirname(self.path))
+            if view.payload.get("owner") not in (None, self.owner):
+                # fenced out: another controller took the lease; never
+                # write over it — the takeover is the durable record
+                self.lost.set()
+                log.error(
+                    "controller lease lost to %s (fence %s); this controller "
+                    "is no longer the single writer",
+                    view.payload.get("owner"), view.payload.get("fence"),
+                )
+                return
+            try:
+                self._write(LEASE_ACTIVE, acquired=acquired)
+            except OSError:
+                log.warning("controller lease renewal failed", exc_info=True)
+                continue
+            if self.metrics is not None:
+                self.metrics.inc("katib_controller_lease_renewals_total")
+                self.metrics.set_gauge(
+                    "katib_controller_lease_age_seconds",
+                    round(time.time() - acquired, 3),
+                )
+                self.metrics.set_gauge(
+                    "katib_controller_lease_fence", float(self.fence)
+                )
+
+    def release(self) -> None:
+        """Clean shutdown: mark the lease released so a successor can take
+        over immediately instead of waiting out the TTL."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self.lost.is_set():
+            return  # fenced out: the file belongs to the new owner
+        view = read_lease(os.path.dirname(self.path))
+        if view.payload.get("owner") in (None, self.owner):
+            try:
+                self._write(LEASE_RELEASED)
+            except OSError:
+                pass
+
+
+# -- checkpoint tail ----------------------------------------------------------
+
+
+def latest_checkpoint_time(base_dir: Optional[str]) -> Optional[float]:
+    """The instant the newest durable checkpoint under ``base_dir`` landed,
+    or None when no recognizable checkpoint exists.
+
+    Recognized layouts (all written tmp+replace, so the mtime IS the moment
+    the artifact became durable):
+
+    - runtime/checkpoints.py pickle path: ``ckpt_<step>.pkl``;
+    - the orbax CheckpointManager layout: numeric step directories;
+    - runtime/population.py fused sweep carries: ``population_carry*``.
+
+    Observation rows carry ``time.time()`` stamps from the same host clock,
+    so "rows no newer than the checkpoint" is a well-ordered comparison.
+    """
+    if not base_dir or not os.path.isdir(base_dir):
+        return None
+    newest: Optional[float] = None
+    try:
+        names = os.listdir(base_dir)
+    except OSError:
+        return None
+    for fn in names:
+        path = os.path.join(base_dir, fn)
+        recognized = (
+            (fn.startswith("ckpt_") and fn.endswith(".pkl"))
+            or fn.startswith("population_carry")
+            or (fn.isdigit() and os.path.isdir(path))
+        )
+        if not recognized:
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if newest is None or mtime > newest:
+            newest = mtime
+    return newest
+
+
+# -- orphan fencing -----------------------------------------------------------
+
+
+def write_pidfile(workdir: str, pid: int) -> None:
+    """Subprocess-executor hook: record the trial child's pid (== its
+    process-group id, the executor spawns with start_new_session) so a
+    restarted controller can fence the orphan before re-running the trial."""
+    try:
+        tmp = os.path.join(workdir, PIDFILE_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(str(int(pid)))
+        os.replace(tmp, os.path.join(workdir, PIDFILE_NAME))
+    except OSError:
+        log.debug("trial pidfile write failed", exc_info=True)
+
+
+def clear_pidfile(workdir: str) -> None:
+    try:
+        os.remove(os.path.join(workdir, PIDFILE_NAME))
+    except OSError:
+        pass
+
+
+def _pid_is_trial(pid: int, trial_name: str) -> bool:
+    """True when /proc says the pid still runs THIS trial (the executor's
+    env binding) — the guard against pid reuse between crash and restart."""
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            env = f.read()
+    except OSError:
+        return False
+    return f"KATIB_TPU_TRIAL_NAME={trial_name}".encode() in env
+
+
+def fence_stale_trial_process(workdir: Optional[str], trial_name: str) -> bool:
+    """Kill the previous incarnation's orphaned trial process group, if its
+    pidfile still points at a live process running this trial. Returns True
+    when an orphan was actually fenced."""
+    if not workdir:
+        return False
+    path = os.path.join(workdir, PIDFILE_NAME)
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return False
+    fenced = False
+    if _pid_alive(pid) and _pid_is_trial(pid, trial_name):
+        log.warning(
+            "fencing orphaned trial process group %d of %s left by the "
+            "previous controller incarnation", pid, trial_name,
+        )
+        try:
+            os.killpg(pid, signal.SIGKILL)
+            fenced = True
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                fenced = True
+            except (ProcessLookupError, PermissionError):
+                pass
+    clear_pidfile(workdir)
+    return fenced

@@ -142,6 +142,7 @@ class TrialScheduler:
         suggestion_prefetch: Optional[Callable[[str], None]] = None,
         multifidelity=None,
         device_plane=None,
+        journal=None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -231,6 +232,10 @@ class TrialScheduler:
         # trial finalization is byte-identical to the legacy path; with an
         # engine attached only `algorithm: asha` experiments use it
         self.multifidelity = multifidelity
+        # -- recovery journal (controller/recovery.py, ISSUE 14) -------------
+        # None = disabled: dispatch and terminal transitions leave no intent
+        # records and every consult below is one `is None` check
+        self.journal = journal
         self._gate_since: Dict[Any, float] = {}  # group key -> hold start
         self._gate_held: Dict[str, float] = {}   # trial -> hold start (spans)
         self._gate_timer_live = False            # one wake timer per hold
@@ -852,6 +857,13 @@ class TrialScheduler:
 
         exp, members = entry.exp, entry.trials
         n = len(devices)
+        if self.journal is not None:
+            # one intent per dispatch unit: replay (and `katib-tpu recover`)
+            # can see which trials shared a gang when the crash hit
+            self.journal.append(
+                "dispatch", exp.name,
+                trials=[t.name for t in members], devices=n,
+            )
         if n < entry.requested:
             for t in members:
                 self._devices_clamped(exp, t, entry.requested, n)
@@ -2112,6 +2124,15 @@ class TrialScheduler:
         """Terminal bookkeeping shared by every path that sets a trial's
         final condition (_finalize and _reuse_duplicate): persist, count,
         record the event, apply retainRun workdir semantics."""
+        if self.journal is not None:
+            # write-ahead: the journal carries the terminal condition before
+            # the state store does, so a crash between the two replays to
+            # "finished" instead of re-running a completed trial
+            self.journal.append(
+                "terminal", exp.name, trial=trial.name,
+                condition=trial.condition.value,
+                reason=trial.current_reason,
+            )
         self.state.update_trial(trial)
         if self.suggestion_prefetch is not None:
             # fire-and-forget: the hook only enqueues a precompute job

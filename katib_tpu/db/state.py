@@ -333,6 +333,17 @@ class ExperimentStateStore:
             self._persist(name)
             return exp
 
+    def persisted_experiments(self) -> List[str]:
+        """Names with a loadable snapshot under the root (either layout) —
+        the offline-inspection walk `katib-tpu recover` and `list` use."""
+        if not self.root or not os.path.isdir(self.root):
+            return []
+        return sorted(
+            name
+            for name in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, name)) and self.has_state(name)
+        )
+
     def experiment_dir(self, name: str) -> Optional[str]:
         if not self.root:
             return None

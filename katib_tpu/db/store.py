@@ -111,6 +111,22 @@ class ObservationStore:
     def delete_observation_log(self, trial_name: str) -> None:
         raise NotImplementedError
 
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        """Crash recovery (controller/recovery.py): drop only the rows
+        STRICTLY NEWER than ``after_time`` — the un-checkpointed tail a
+        resumed trial will re-report — and return how many were dropped.
+        Base implementation reads, deletes, and re-appends the kept prefix
+        so every backend (native engine, RPC remotes) inherits correct
+        semantics; SQLite overrides with a single ranged DELETE."""
+        rows = self.get_observation_log(trial_name)
+        kept = [r for r in rows if r.timestamp <= after_time]
+        dropped = len(rows) - len(kept)
+        if dropped:
+            self.delete_observation_log(trial_name)
+            if kept:
+                self.report_observation_log(trial_name, kept)
+        return dropped
+
     # -- transfer-HPO index (ISSUE 10) ---------------------------------------
     # Completed experiments are indexed by search-space signature so a new
     # experiment over a matching space can warm-start its suggester from
@@ -309,6 +325,15 @@ class SqliteObservationStore(ObservationStore):
         with self._lock:
             self._conn.execute("DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,))
             self._conn.commit()
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM observation_logs WHERE trial_name = ? AND time > ?",
+                (trial_name, after_time),
+            )
+            self._conn.commit()
+            return int(cur.rowcount or 0)
 
     def replace_experiment_history(self, experiment, signature, points) -> None:
         import json as _json
@@ -570,6 +595,18 @@ class BufferedObservationStore(ObservationStore):
                 # next folded() rescans — external writers stay visible
                 self._seeded.discard(trial_name)
             self.inner.delete_observation_log(trial_name)
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        # same invalidation contract as delete: the fold index rebuilds from
+        # inner on the trial's next touch, so the truncated tail can't linger
+        # in cached min/max/latest state
+        self.flush()
+        with self._io_lock:
+            with self._cv:
+                for key in [k for k in self._index if k[0] == trial_name]:
+                    del self._index[key]
+                self._seeded.discard(trial_name)
+            return self.inner.truncate_observation_log(trial_name, after_time)
 
     def replace_experiment_history(self, experiment, signature, points) -> None:
         # index writes are rare (one batch per completed experiment) and

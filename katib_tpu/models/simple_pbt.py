@@ -47,8 +47,14 @@ def run_pbt_trial(assignments: Dict[str, str], ctx=None) -> None:
         step += 1
 
     if ckpt_path is not None:
-        with open(ckpt_path, "w") as f:
+        # tmp + os.replace: a crash mid-write must leave the previous
+        # checkpoint intact, not a truncated JSON the next generation (or a
+        # recovery restart) chokes on — the same atomicity every other
+        # persistence path in the repo uses (KTI305)
+        tmp = ckpt_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"step": step, "score": score}, f)
+        os.replace(tmp, ckpt_path)
 
     if ctx is not None:
         ctx.report(**{"Validation-accuracy": score})
@@ -114,8 +120,12 @@ def run_pbt_trial_packed(assignments, ctx=None) -> None:
     for i, path in enumerate(ckpt_paths):
         if path is None or (packed and not ctx.member_active(i)):
             continue
-        with open(path, "w") as f:
+        # atomic per-member lineage write (see run_pbt_trial): exploit
+        # children copy these files — a torn one would poison the lineage
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"step": int(new_steps[i]), "score": float(new_scores[i])}, f)
+        os.replace(tmp, path)
 
     report_population(ctx, **{"Validation-accuracy": new_scores})
 

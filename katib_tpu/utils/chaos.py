@@ -22,6 +22,15 @@ programmatic :func:`install`; when neither is set every hook below is one
 - ``kill=G[@H]``    — the G-th lease's holder is hard-killed after its
                       H-th heartbeat (process-death injection; the holder
                       requeues through the normal loss machinery)
+- ``kill_controller=N`` — the controller SIGKILLs ITSELF right after its
+                      recovery journal's N-th append of this process
+                      (controller/recovery.py) — the hard-crash injection
+                      the controller-kill chaos harness drives. Counter-
+                      keyed like the lease-grant directives: deterministic
+                      per controller incarnation, never wall-clock. Only
+                      ever set on a subprocess controller (a harness
+                      driver, ``bench.py controller_kill_recovery``) —
+                      in-process it would kill the test runner.
 
 The same plan object doubles as the standing bench's fault-injection knob:
 ``bench.py device_chaos_recovery`` installs one programmatically and
@@ -52,11 +61,15 @@ class ChaosPlan:
     wedge_probes: int = 0
     # 1-based lease-grant index -> (action, heartbeat count before it fires)
     grant_actions: Dict[int, Tuple[str, int]] = field(default_factory=dict)
+    # 1-based journal-append index at which the controller SIGKILLs itself
+    # (0 = off); one-shot, keyed by the RecoveryJournal's per-process counter
+    kill_controller: int = 0
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self._grants = 0
         self._wedges_left = int(self.wedge_probes)
+        self._controller_killed = False
 
     # -- probe wedging -------------------------------------------------------
 
@@ -89,6 +102,21 @@ class ChaosPlan:
         with self._lock:
             return self._grants
 
+    # -- controller-kill scheduling ------------------------------------------
+
+    def take_controller_kill(self, appended: int) -> bool:
+        """True exactly once, at (or past — a plan installed mid-flight
+        still fires) the scheduled journal append. The caller SIGKILLs the
+        process, so "once" only matters for plans consulted in-process by
+        tests."""
+        with self._lock:
+            if self.kill_controller <= 0 or self._controller_killed:
+                return False
+            if appended < self.kill_controller:
+                return False
+            self._controller_killed = True
+            return True
+
 
 class ChaosParseError(ValueError):
     pass
@@ -117,6 +145,8 @@ def parse_plan(directives: str) -> ChaosPlan:
             elif key in (ACTION_REVOKE, ACTION_KILL):
                 grant, _, beats = value.partition("@")
                 plan.grant_actions[int(grant)] = (key, int(beats or "1"))
+            elif key == "kill_controller":
+                plan.kill_controller = int(value)
             else:
                 raise ChaosParseError(f"unknown chaos directive {key!r}")
         except ValueError as e:

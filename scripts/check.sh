@@ -52,6 +52,10 @@ echo "== device-plane chaos smoke (seeded wedged probe + mid-sweep revocations, 
 JAX_PLATFORMS=cpu python bench.py device_chaos_recovery --smoke
 
 echo
+echo "== controller-kill chaos smoke (journal-keyed SIGKILLs, lease takeover, checkpoint-preserving recovery) =="
+JAX_PLATFORMS=cpu python bench.py controller_kill_recovery --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

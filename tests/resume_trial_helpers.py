@@ -1,5 +1,7 @@
 """Entry-point trial functions for cross-process resume tests (importable by
-name from a fresh controller process — in-memory lambdas can't resume)."""
+name from a fresh controller process — in-memory lambdas can't resume), plus
+the SIGKILL crash-harness driver the ISSUE 14 recovery tests run as a child
+process."""
 
 import time
 
@@ -11,3 +13,191 @@ def enas_eval(assignments, ctx):
     arch = assignments.get("architecture", "")
     score = 0.3 + (hash(arch) % 1000) / 2000.0  # 0.3 .. 0.8, arch-dependent
     ctx.report(**{"Validation-accuracy": score})
+
+
+def asha_crash_trial(assignments, ctx):
+    """Checkpointed multi-fidelity workload for the controller-kill tests:
+    deterministic per-epoch curve, report-then-save so the truncate-to-
+    checkpoint recovery rule stitches a continuous log."""
+    x = float(assignments["x"])
+    budget = int(float(assignments["budget"]))
+    store = ctx.checkpoint_store()
+    restored = store.restore()
+    start = int(restored["epoch"]) + 1 if restored else 1
+    for epoch in range(start, budget + 1):
+        score = x * (1.0 - 0.8 ** epoch)
+        time.sleep(0.05)
+        ctx.report(score=score, epoch=epoch)
+        store.save(epoch, {"epoch": epoch})
+
+
+def packable_crash_trial(assignments, ctx=None):
+    """Pack-aware slow workload (supports_packing): K members share one
+    vmapped-shaped loop, slow enough for the harness to SIGKILL the
+    controller while the pack is mid-flight."""
+    from katib_tpu.runtime.packed import population_of, report_population
+
+    pop = population_of(assignments)
+    lr = pop["lr"]
+    for step in range(6):
+        time.sleep(0.1)
+        report_population(ctx, score=lr * (step + 1))
+
+
+packable_crash_trial.supports_packing = True
+
+
+def _crash_spec(kind, tests_dir):
+    from katib_tpu.api import (
+        AlgorithmSetting,
+        AlgorithmSpec,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+        TrialTemplate,
+    )
+    from katib_tpu.api.spec import ResumePolicy, TrialResources
+
+    if kind in ("asha", "dwell"):
+        return ExperimentSpec(
+            name="crash-" + kind,
+            parameters=[
+                ParameterSpec(
+                    "x", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.1", max="1.0", step="0.18"),
+                ),
+                ParameterSpec(
+                    "budget", ParameterType.INT, FeasibleSpace(min="1", max="9")
+                ),
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec(
+                "asha",
+                algorithm_settings=[
+                    AlgorithmSetting("resource_name", "budget"),
+                    AlgorithmSetting("eta", "3"),
+                ],
+            ),
+            trial_template=TrialTemplate(
+                entry_point="resume_trial_helpers:asha_crash_trial",
+                env={"PYTHONPATH": tests_dir},
+            ),
+            max_trial_count=6,
+            parallel_trial_count=3,
+            resume_policy=ResumePolicy.FROM_VOLUME,
+        )
+    if kind == "fused":
+        return ExperimentSpec(
+            name="crash-fused",
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE,
+                    FeasibleSpace(min="0.0001", max="0.02"),
+                )
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="Validation-accuracy",
+            ),
+            algorithm=AlgorithmSpec(
+                "pbt",
+                algorithm_settings=[
+                    AlgorithmSetting("n_population", "5"),
+                    AlgorithmSetting("truncation_threshold", "0.4"),
+                    AlgorithmSetting("fused_generations", "24"),
+                    AlgorithmSetting("random_state", "11"),
+                ],
+            ),
+            # entry_point, not function=: the member trials must be
+            # re-executable by a FRESH controller process
+            trial_template=TrialTemplate(
+                entry_point="katib_tpu.models.simple_pbt:run_pbt_trial_packed",
+            ),
+            max_trial_count=120,
+            parallel_trial_count=5,
+            resume_policy=ResumePolicy.FROM_VOLUME,
+        )
+    if kind == "pack":
+        return ExperimentSpec(
+            name="crash-pack",
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DISCRETE,
+                    FeasibleSpace(list=["0.1", "0.2", "0.3", "0.4"]),
+                )
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("grid"),
+            trial_template=TrialTemplate(
+                entry_point="resume_trial_helpers:packable_crash_trial",
+                env={"PYTHONPATH": tests_dir},
+                resources=TrialResources(pack_size=4),
+            ),
+            max_trial_count=4,
+            parallel_trial_count=4,
+            resume_policy=ResumePolicy.FROM_VOLUME,
+        )
+    raise ValueError(f"unknown crash-harness kind {kind!r}")
+
+
+def crash_driver():
+    """Child-process controller driver (``python -c "import
+    resume_trial_helpers as h; h.crash_driver()" <root> <kind>``): create
+    the kind's experiment and drive it until the parent SIGKILLs this
+    process. Trials are in-process entry-point functions, so they die with
+    the controller — exactly the hard-crash shape the recovery load must
+    absorb."""
+    import os
+    import sys
+
+    root, kind = sys.argv[1], sys.argv[2]
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    cfg.runtime.tracing = False
+    if kind == "dwell":
+        # park promotion decisions in the dwell buffer so the SIGKILL lands
+        # mid-dwell (claims are in-memory; the restart must re-derive them
+        # from the persisted paused labels)
+        cfg.runtime.promotion_dwell_seconds = 120.0
+    if kind == "fused":
+        # short scan chunks => frequent chunk-boundary carry checkpoints,
+        # and a watcher that hard-kills THIS process once the second chunk's
+        # carry is durable — a deterministic mid-sweep SIGKILL
+        import json
+        import signal
+        import threading
+
+        cfg.runtime.population_chunk_generations = 4
+        meta = os.path.join(root, "fusedpop", "crash-fused",
+                            "population_carry.json")
+
+        def watch():
+            while True:
+                try:
+                    with open(meta) as f:
+                        m = json.load(f)
+                    if int(m.get("generationDone", 0)) >= 8:
+                        os.kill(os.getpid(), signal.SIGKILL)
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.01)
+
+        threading.Thread(target=watch, daemon=True).start()
+    ctrl = ExperimentController(root_dir=root, devices=list(range(4)), config=cfg)
+    spec = _crash_spec(kind, tests_dir)
+    ctrl.create_experiment(spec)
+    print("READY", flush=True)
+    ctrl.run(spec.name, timeout=180)
+    print("DONE", flush=True)

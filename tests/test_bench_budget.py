@@ -741,6 +741,21 @@ def test_device_chaos_recovery_smoke_integrity(bench):
     assert isinstance(out["within_target"], bool)
 
 
+def test_controller_kill_recovery_smoke_integrity(bench):
+    """--smoke mode of the controller_kill_recovery scenario (ISSUE 14):
+    the checkpointed sweep survives >= 2 controller SIGKILLs (journal-
+    counter-keyed chaos kills of real subprocess controllers) with zero
+    lost observations, score rows bit-identical to the fault-free run, and
+    every recovery replay bounded under 10s."""
+    out = bench._bench_controller_kill_recovery(smoke=True)
+    assert out["smoke"] is True
+    assert out["sigkills_injected"] >= 2
+    assert out["lost_observations"] == 0
+    assert out["bit_identical"] is True
+    assert out["recovery_replays"] >= 2
+    assert out["max_replay_seconds"] < out["replay_bound_seconds"] == 10.0
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

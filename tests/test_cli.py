@@ -193,6 +193,81 @@ def test_cli_resume(tmp_path, capsys):
     assert "no persisted state" in capsys.readouterr().err
 
 
+def test_cli_recover_offline_inspection(tmp_path, capsys):
+    """`katib-tpu recover <exp>` reads the lease, the journal tail, and the
+    in-flight trial summary straight off the state root — no controller is
+    constructed, so it never contends a live controller's lease."""
+    import os
+    import pickle
+    import time
+
+    from katib_tpu.api.spec import ExperimentSpec, ParameterAssignment
+    from katib_tpu.api.status import Trial, TrialCondition
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.db.store import MetricLog
+
+    root = str(tmp_path / "root")
+    spec = {
+        "name": "cli-recover",
+        "parameters": [
+            {
+                "name": "lr",
+                "parameterType": "double",
+                "feasibleSpace": {"min": "0.1", "max": "0.9"},
+            }
+        ],
+        "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+        "algorithm": {"algorithmName": "random", "algorithmSettings": []},
+        "trialTemplate": {
+            "command": [sys.executable, "-c", "print('loss=0.1')"],
+            "trialParameters": [],
+        },
+        "maxTrialCount": 2,
+        "parallelTrialCount": 1,
+        "resumePolicy": "FromVolume",
+    }
+    ctrl = ExperimentController(root_dir=root)
+    ctrl.create_experiment(ExperimentSpec.from_dict(spec))
+    # an in-flight trial with a checkpoint and durable rows, as a crash
+    # would leave it
+    trial = Trial(
+        name="cli-recover-t1", experiment_name="cli-recover",
+        parameter_assignments=[ParameterAssignment("lr", "0.5")],
+    )
+    trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "mid-flight")
+    ctrl.state.create_trial(trial)
+    ctrl.obs_store.report_observation_log(
+        "cli-recover-t1",
+        [MetricLog(timestamp=time.time() - 5, metric_name="loss", value="0.4")],
+    )
+    ctrl.obs_store.flush()
+    workdir = os.path.join(root, "trials", "cli-recover", "cli-recover-t1")
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "ckpt_1.pkl"), "wb") as f:
+        pickle.dump({"step": 1, "state": {}}, f)
+    ctrl.journal.append("submit", "cli-recover", trial="cli-recover-t1")
+    ctrl.close()
+
+    rc = main(["--root", root, "recover", "cli-recover"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "lease:      released" in out
+    assert "journal:" in out and "submit" in out
+    assert "cli-recover-t1" in out
+
+    rc = main(["--root", root, "recover", "cli-recover", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["lease"]["state"] == "released"
+    assert payload["inflight"] and payload["inflight"][0]["checkpointed"] is True
+    assert payload["inflight"][0]["rowsPreservedOnRecovery"] == 1
+    assert any(r["op"] == "submit" for r in payload["journal"]["tail"])
+
+    rc = main(["--root", root, "recover", "ghost"])
+    assert rc == 1
+    assert "no persisted state" in capsys.readouterr().err
+
+
 def test_cli_top_renders_persisted_telemetry(tmp_path, capsys):
     """`katib-tpu top` without --url renders the resource series persisted
     under <root>/telemetry/ — readable after the controller exited (ISSUE 5

@@ -99,6 +99,21 @@ class TestChaosPlan:
         assert (action, beats) == ("revoke", 3)
         assert plan.next_grant() is None
 
+    def test_kill_controller_directive(self):
+        """ISSUE 14: ``kill_controller=N`` fires exactly once, at (or past)
+        the N-th journal append of the process — counter-keyed like the
+        lease-grant directives, never wall-clock."""
+        plan = chaos.parse_plan("kill_controller=3")
+        assert plan.kill_controller == 3
+        assert plan.take_controller_kill(1) is False
+        assert plan.take_controller_kill(2) is False
+        assert plan.take_controller_kill(3) is True
+        assert plan.take_controller_kill(4) is False  # one-shot
+        # off by default: the plain grammar never kills the controller
+        assert chaos.parse_plan("seed=1").take_controller_kill(99) is False
+        with pytest.raises(chaos.ChaosParseError):
+            chaos.parse_plan("kill_controller=x")
+
     def test_env_activation_and_reset(self, monkeypatch):
         monkeypatch.setenv(chaos.ENV_CHAOS, "wedge_probe=1")
         chaos.reset()

@@ -326,6 +326,68 @@ def _submit_solo(c, exp, name, x, budget):
     return trial
 
 
+def test_promotion_claim_unclaims_mid_transition_trial(controller):
+    """Regression (ISSUE 14): a concurrent claimer can reach _promote_one
+    while the boundary thread has registered the pause but not yet set the
+    EarlyStopped condition. The claim used to be consumed (promoted set
+    grown, paused entry popped) with no promotion — the trial ended the
+    sweep stuck RungPaused. The claim must be RESTORED so a later pump
+    promotes once the transition lands."""
+    import contextlib
+
+    from katib_tpu.api.spec import ParameterAssignment
+
+    c = controller
+    spec = _asha_spec("asha-race", _curve_fn, eta=2, max_resource=4, max_trials=4)
+    exp = c.create_experiment(spec)
+    engine = c.multifidelity
+    st = engine._entry(exp)
+    # two recorded boundary scores at rung 0 -> floor(2/2)=1 promotable
+    names = ["asha-race-a", "asha-race-b"]
+    for name, x in zip(names, ("0.9", "0.1")):
+        trial = Trial(
+            name=name, experiment_name="asha-race",
+            parameter_assignments=[
+                ParameterAssignment("x", x),
+                ParameterAssignment("epochs", "1"),
+            ],
+        )
+        # mid-transition shape: paused map + scores registered, but the
+        # trial still reads Running (condition/labels not yet persisted)
+        trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "mid-boundary")
+        c.state.create_trial(trial)
+        st.brackets[0].scores[0][name] = float(x)
+        st.paused[name] = (0, 0)
+
+    submitted = []
+
+    class FakeScheduler:
+        workdir_root = None
+        LINEAGE_LABEL = "checkpoint-lineage"
+
+        def dispatch_barrier(self):
+            return contextlib.nullcontext()
+
+        def submit(self, exp, trial, checkpoint_dir=None, dispatch=True):
+            submitted.append(trial.name)
+
+    assert engine._maybe_promote(exp, FakeScheduler()) is False
+    assert submitted == []
+    # the claim was restored, not consumed
+    assert st.paused.get("asha-race-a") == (0, 0)
+    assert "asha-race-a" not in st.brackets[0].promoted[0]
+
+    # the boundary transition lands; the next pump promotes normally
+    best = c.state.get_trial("asha-race", "asha-race-a")
+    best.labels[PAUSED_LABEL] = "0"
+    best.labels[RUNG_LABEL] = "0"
+    best.set_condition(TrialCondition.EARLY_STOPPED, "RungPaused", "paused")
+    c.state.update_trial(best)
+    assert engine._maybe_promote(exp, FakeScheduler()) is True
+    assert submitted == ["asha-race-a"]
+    assert "asha-race-a" in st.brackets[0].promoted[0]
+
+
 def _paused(c, exp_name, trial_name):
     t = c.state.get_trial(exp_name, trial_name)
     return (

@@ -531,3 +531,502 @@ def test_load_unknown_experiment_raises(tmp_path):
             ctrl.load_experiment("nope")
     finally:
         ctrl.close()
+
+
+# -- crash-tolerant controller (ISSUE 14, controller/recovery.py) ------------
+# SIGKILL-shaped restarts: the phase-1 controller runs as a SUBPROCESS the
+# test hard-kills (never a clean close()), then a fresh in-process
+# controller recovers over the same root.
+
+import json as _json
+import signal as _signal
+import subprocess as _subprocess
+import sys as _sys
+import time as _time
+
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+
+def _spawn_crash_child(root, kind):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        TESTS_DIR + os.pathsep + REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    env.pop("KATIB_TPU_CHAOS", None)
+    return _subprocess.Popen(
+        [_sys.executable, "-c",
+         "import resume_trial_helpers as h; h.crash_driver()", root, kind],
+        env=env, stdout=_subprocess.PIPE, stderr=_subprocess.STDOUT, text=True,
+    )
+
+
+def _persisted_trials(root, exp):
+    """Trial records straight off the state dir (the child's persisted
+    view) — the poll target for deciding when to SIGKILL."""
+    d = os.path.join(root, "state", exp, "state", "trials")
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for fn in os.listdir(d):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, fn)) as f:
+                out.append(_json.load(f))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _sigkill_when(proc, root, exp, predicate, budget=90.0):
+    """Poll the persisted state until ``predicate(trials)`` holds, then
+    SIGKILL the child controller mid-flight. Fails loudly if the child
+    exits (or the predicate never fires) first."""
+    deadline = _time.time() + budget
+    while _time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "crash child exited before the kill point:\n"
+                + (proc.stdout.read() or "")[-3000:]
+            )
+        if predicate(_persisted_trials(root, exp)):
+            proc.send_signal(_signal.SIGKILL)
+            proc.wait(timeout=10)
+            return
+        _time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("kill-point predicate never fired within budget")
+
+
+def _epochs_continuous(ctrl, exp_name):
+    """Every trial's epoch rows must be exactly 1..last with no gaps or
+    duplicates — the zero-lost-observations predicate."""
+    bad = {}
+    for t in ctrl.state.list_trials(exp_name):
+        steps = [
+            int(float(r.value))
+            for r in ctrl.obs_store.get_observation_log(t.name, metric_name="epoch")
+        ]
+        if steps and steps != list(range(1, steps[-1] + 1)):
+            bad[t.name] = steps
+    return bad
+
+
+def _recovery_controller(root, **runtime_overrides):
+    from katib_tpu.config import KatibConfig
+
+    cfg = KatibConfig()
+    cfg.runtime.telemetry = False
+    cfg.runtime.compile_service = False
+    cfg.runtime.tracing = False
+    for k, v in runtime_overrides.items():
+        setattr(cfg.runtime, k, v)
+    return ExperimentController(root_dir=root, devices=list(range(4)), config=cfg)
+
+
+def test_sigkill_resume_paused_rung_trials(tmp_path):
+    """SIGKILL while some trials are rung-paused and others mid-stint: the
+    recovery load must preserve the paused trials' observations (they
+    rejoin the engine via the persisted-label rebuild), requeue the
+    in-flight ones from their checkpoints, and finish with every epoch
+    curve continuous."""
+    from katib_tpu.controller.multifidelity import PAUSED_LABEL
+
+    root = str(tmp_path)
+    proc = _spawn_crash_child(root, "asha")
+
+    def mid_ladder(trials):
+        paused = sum(1 for t in trials if PAUSED_LABEL in t.get("labels", {}))
+        live = sum(
+            1 for t in trials if t.get("condition") in ("Running", "Pending")
+        )
+        return paused >= 2 and live >= 1
+
+    _sigkill_when(proc, root, "crash-asha", mid_ladder)
+
+    ctrl = _recovery_controller(root)
+    try:
+        exp = ctrl.load_experiment("crash-asha")
+        assert not exp.status.is_completed
+        assert any(
+            e.reason == "ControllerRecovered" for e in ctrl.events.list("crash-asha")
+        )
+        exp = ctrl.run("crash-asha", timeout=120)
+        assert exp.status.is_succeeded, exp.status.message
+        trials = ctrl.state.list_trials("crash-asha")
+        assert len(trials) == 6
+        assert all(t.is_terminal for t in trials)
+        # pruned trials kept their rung observations and nobody lost a row
+        assert _epochs_continuous(ctrl, "crash-asha") == {}
+        # ASHA shape survived the crash: 6 admissions at rung 0 (budget 1),
+        # floor(6/eta)=2 promoted to rung 1 (budget 3), rest pruned
+        by_budget = {
+            t.name: int(float(t.assignments_dict()["budget"])) for t in trials
+        }
+        assert sorted(by_budget.values()) == [1, 1, 1, 1, 3, 3], by_budget
+    finally:
+        ctrl.close()
+
+
+def test_sigkill_mid_dwell_promotion_batch(tmp_path):
+    """SIGKILL while promotion decisions sit in the dwell buffer (claimed
+    in-memory, nothing submitted): the restart must re-derive the paused
+    set from the persisted labels and promote normally — no trial lost to
+    a promotion that was claimed but never happened."""
+    from katib_tpu.controller.multifidelity import PAUSED_LABEL
+
+    root = str(tmp_path)
+    proc = _spawn_crash_child(root, "dwell")
+
+    def dwell_parked(trials):
+        # with a 120s dwell window nothing promotes, so the bottom rung
+        # parks: >=2 paused (some possibly claimed into the buffer)
+        return sum(1 for t in trials if PAUSED_LABEL in t.get("labels", {})) >= 2
+
+    _sigkill_when(proc, root, "crash-dwell", dwell_parked)
+
+    ctrl = _recovery_controller(root)  # dwell back to 0: promote at decision
+    try:
+        ctrl.load_experiment("crash-dwell")
+        exp = ctrl.run("crash-dwell", timeout=120)
+        assert exp.status.is_succeeded, exp.status.message
+        assert any(
+            e.reason == "RungPromoted" for e in ctrl.events.list("crash-dwell")
+        ), "no promotion happened after the mid-dwell crash"
+        assert _epochs_continuous(ctrl, "crash-dwell") == {}
+        trials = ctrl.state.list_trials("crash-dwell")
+        assert all(t.is_terminal for t in trials)
+        assert not any(
+            PAUSED_LABEL in t.labels for t in trials
+        ), "a trial stayed rung-paused forever after the crash"
+    finally:
+        ctrl.close()
+
+
+def test_sigkill_packed_members_reform_pack(tmp_path):
+    """SIGKILL while a 4-member pack is mid-flight: the recovery load
+    requeues every member under ONE dispatch barrier, so they re-form a
+    pack instead of the first member dispatching solo."""
+    from katib_tpu.controller.packing import PACK_LABEL
+
+    root = str(tmp_path)
+    proc = _spawn_crash_child(root, "pack")
+
+    def pack_running(trials):
+        return sum(
+            1
+            for t in trials
+            if PACK_LABEL in t.get("labels", {}) and t.get("condition") == "Running"
+        ) >= 3
+
+    _sigkill_when(proc, root, "crash-pack", pack_running)
+
+    ctrl = _recovery_controller(root)
+    try:
+        ctrl.load_experiment("crash-pack")
+        exp = ctrl.run("crash-pack", timeout=120)
+        assert exp.status.is_succeeded, exp.status.message
+        packs = [
+            e for e in ctrl.events.list("crash-pack") if e.reason == "PackFormed"
+        ]
+        assert packs, "recovered members did not re-form a pack"
+        # the barrier requeued the members together: one re-formed pack
+        # holds at least 3 of the 4 members
+        assert any(
+            int(e.message.split("packed ", 1)[1].split("/", 1)[0]) >= 3
+            for e in packs
+        ), [e.message for e in packs]
+    finally:
+        ctrl.close()
+
+
+def test_sigkill_fused_gang_resumes_from_carry_checkpoint(tmp_path):
+    """SIGKILL after the fused sweep's second chunk-boundary carry: the
+    recovery load re-forms the WHOLE K-member gang (one dispatch barrier,
+    shared fusedpop carry dir) and the resumed sweep extends the carry —
+    every member ends with exactly one objective row per generation, no
+    duplicates from the re-demuxed chunk, and the population pseudo-trial
+    log stays exact too."""
+    from katib_tpu.runtime.population import FUSED_LABEL
+
+    root = str(tmp_path)
+    proc = _spawn_crash_child(root, "fused")
+    assert proc.wait(timeout=180) == -_signal.SIGKILL, (
+        "fused crash child did not self-SIGKILL at the carry watchpoint:\n"
+        + (proc.stdout.read() or "")[-3000:]
+    )
+    meta = os.path.join(root, "fusedpop", "crash-fused", "population_carry.json")
+    assert os.path.exists(meta), "no chunk-boundary carry was persisted"
+
+    ctrl = _recovery_controller(root, population_chunk_generations=4)
+    try:
+        ctrl.load_experiment("crash-fused")
+        exp = ctrl.run("crash-fused", timeout=180)
+        assert exp.status.is_succeeded, exp.status.message
+        trials = ctrl.state.list_trials("crash-fused")
+        assert len(trials) == 5
+        assert all(FUSED_LABEL in t.labels for t in trials)
+        for t in trials:
+            logs = ctrl.obs_store.get_observation_log(t.name)
+            assert len(logs) == 24, (t.name, len(logs))
+        # population best/median: exactly 2 rows per generation
+        poplog = ctrl.obs_store.get_observation_log("crash-fused-population")
+        assert len(poplog) == 48, len(poplog)
+        # the carry was consumed and cleared by the completed sweep
+        assert not os.path.exists(meta)
+    finally:
+        ctrl.close()
+
+
+def test_recovery_off_restores_legacy_load_byte_identically(tmp_path):
+    """KATIB_TPU_RECOVERY=0: load_experiment must reproduce the legacy
+    behavior — the whole observation log of a requeued in-flight trial is
+    dropped, no journal/lease files exist, and no recovery events fire."""
+    from katib_tpu.api.status import Trial, TrialCondition
+    from katib_tpu.db.store import MetricLog
+
+    root = str(tmp_path)
+    spec = ExperimentSpec(
+        name="legacy-load",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=_slow_quadratic_template(sleep_s=2.0),
+        max_trial_count=1,
+        parallel_trial_count=1,
+        resume_policy=ResumePolicy.FROM_VOLUME,
+    )
+    from katib_tpu.config import KatibConfig
+
+    cfg = KatibConfig()
+    cfg.runtime.recovery = False
+    cfg.runtime.telemetry = False
+    ctrl1 = ExperimentController(root_dir=root, config=cfg)
+    ctrl1.create_experiment(spec)
+    assert ctrl1.lease is None and ctrl1.journal is None
+    assert not os.path.exists(os.path.join(root, "state", "controller.lease"))
+    assert not os.path.isdir(os.path.join(root, "journal"))
+    # craft an in-flight trial with durable rows, as a crash would leave it
+    from katib_tpu.api.spec import ParameterAssignment
+
+    trial = Trial(
+        name="legacy-load-t1", experiment_name="legacy-load",
+        parameter_assignments=[ParameterAssignment("x", "0.5")],
+    )
+    trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "mid-flight")
+    ctrl1.state.create_trial(trial)
+    ctrl1.obs_store.report_observation_log(
+        "legacy-load-t1", [MetricLog(timestamp=1.0, metric_name="score", value="0.5")]
+    )
+    ctrl1.obs_store.flush()
+    ctrl1.close()
+
+    ctrl2 = ExperimentController(root_dir=root, config=cfg)
+    try:
+        ctrl2.load_experiment("legacy-load")
+        # legacy semantics: the interrupted run's metrics are DROPPED
+        assert ctrl2.obs_store.get_observation_log("legacy-load-t1") == []
+        assert not any(
+            e.reason == "ControllerRecovered"
+            for e in ctrl2.events.list("legacy-load")
+        )
+        t = ctrl2.state.get_trial("legacy-load", "legacy-load-t1")
+        # requeued, like before (may already be dispatching)
+        assert t.condition in (TrialCondition.PENDING, TrialCondition.RUNNING)
+    finally:
+        ctrl2.close()
+
+
+def test_recovery_load_preserves_checkpointed_rows(tmp_path):
+    """The recovery load keeps rows at or before the last durable
+    checkpoint and truncates only the un-checkpointed tail."""
+    import pickle
+
+    from katib_tpu.api.spec import ParameterAssignment
+    from katib_tpu.api.status import Trial, TrialCondition
+    from katib_tpu.db.store import MetricLog
+
+    root = str(tmp_path)
+    spec = ExperimentSpec(
+        name="ck-load",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=_slow_quadratic_template(sleep_s=2.0),
+        max_trial_count=1,
+        parallel_trial_count=1,
+        resume_policy=ResumePolicy.FROM_VOLUME,
+    )
+    ctrl1 = _recovery_controller(root)
+    ctrl1.create_experiment(spec)
+    trial = Trial(
+        name="ck-load-t1", experiment_name="ck-load",
+        parameter_assignments=[ParameterAssignment("x", "0.5")],
+    )
+    trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "mid-flight")
+    ctrl1.state.create_trial(trial)
+    now = _time.time()
+    ctrl1.obs_store.report_observation_log(
+        "ck-load-t1",
+        [
+            MetricLog(timestamp=now - 10.0, metric_name="epoch", value="1"),
+            MetricLog(timestamp=now - 9.0, metric_name="epoch", value="2"),
+            MetricLog(timestamp=now + 60.0, metric_name="epoch", value="3"),
+        ],
+    )
+    ctrl1.obs_store.flush()
+    workdir = os.path.join(root, "trials", "ck-load", "ck-load-t1")
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "ckpt_2.pkl"), "wb") as f:
+        pickle.dump({"step": 2, "state": {"epoch": 2}}, f)
+    ctrl1.close()
+
+    ctrl2 = _recovery_controller(root)
+    try:
+        ctrl2.load_experiment("ck-load")
+        rows = ctrl2.obs_store.get_observation_log("ck-load-t1", metric_name="epoch")
+        # rows 1-2 predate the checkpoint and survive; row 3 (newer than the
+        # checkpoint artifact) is the truncated tail
+        assert [r.value for r in rows] == ["1", "2"], [r.value for r in rows]
+        recovered = [
+            e for e in ctrl2.events.list("ck-load")
+            if e.reason == "ControllerRecovered"
+        ]
+        assert recovered and "1 in-flight trial(s) requeued" in recovered[0].message
+    finally:
+        ctrl2.close()
+
+
+def test_journal_terminal_replay_completes_trial(tmp_path):
+    """Crash between the journal's terminal write-ahead and the state
+    write: the replay applies the journaled condition (refolding the
+    observation from durable rows) instead of re-running the trial."""
+    from katib_tpu.api.spec import ParameterAssignment
+    from katib_tpu.api.status import Trial, TrialCondition
+    from katib_tpu.db.store import MetricLog
+
+    root = str(tmp_path)
+    spec = ExperimentSpec(
+        name="wal",
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+        ],
+        objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+        algorithm=AlgorithmSpec("random"),
+        trial_template=_slow_quadratic_template(sleep_s=2.0),
+        max_trial_count=1,
+        parallel_trial_count=1,
+        resume_policy=ResumePolicy.FROM_VOLUME,
+    )
+    ctrl1 = _recovery_controller(root)
+    ctrl1.create_experiment(spec)
+    trial = Trial(
+        name="wal-t1", experiment_name="wal",
+        parameter_assignments=[ParameterAssignment("x", "0.5")],
+    )
+    trial.set_condition(TrialCondition.RUNNING, "TrialRunning", "mid-flight")
+    ctrl1.state.create_trial(trial)
+    ctrl1.obs_store.report_observation_log(
+        "wal-t1", [MetricLog(timestamp=_time.time(), metric_name="score", value="0.75")]
+    )
+    ctrl1.obs_store.flush()
+    # the write-ahead record lands; the state write never did (the "crash")
+    ctrl1.journal.append(
+        "terminal", "wal", trial="wal-t1",
+        condition="Succeeded", reason="TrialSucceeded",
+    )
+    ctrl1.close()
+
+    ctrl2 = _recovery_controller(root)
+    try:
+        ctrl2.load_experiment("wal")
+        t = ctrl2.state.get_trial("wal", "wal-t1")
+        assert t.condition == TrialCondition.SUCCEEDED
+        assert t.observation.metric("score").latest == "0.75"
+        assert ctrl2.scheduler.active_count() == 0  # nothing requeued
+    finally:
+        ctrl2.close()
+
+
+def test_two_controller_lease_single_writer(tmp_path):
+    """Exactly one active writer per state root: a fresh foreign lease
+    refuses a second controller; standby mode takes over once the active
+    lease expires."""
+    import socket
+    import threading
+
+    from katib_tpu.controller import recovery
+
+    root = str(tmp_path)
+    state_root = os.path.join(root, "state")
+    os.makedirs(state_root, exist_ok=True)
+
+    def write_foreign_lease(renewed):
+        payload = {
+            "owner": "other-controller", "pid": 1,
+            "host": socket.gethostname(), "state": "active", "fence": 3,
+            "acquired": renewed, "renewed": renewed, "ttl": 2.0,
+        }
+        tmp = os.path.join(state_root, "controller.lease.tmp")
+        with open(tmp, "w") as f:
+            _json.dump(payload, f)
+        os.replace(tmp, os.path.join(state_root, "controller.lease"))
+
+    # fresh foreign lease (live pid 1): second controller refuses to start
+    write_foreign_lease(_time.time() + 30.0)
+    with pytest.raises(recovery.LeaseHeldError):
+        _recovery_controller(root)
+
+    # standby: blocks while the lease is fresh, takes over on expiry
+    write_foreign_lease(_time.time() + 1.5)  # fresh for ~3.5s (ttl 2)
+    box = {}
+
+    def standby():
+        ctrl = _recovery_controller(root, controller_lease_standby=True)
+        box["ctrl"] = ctrl
+
+    th = threading.Thread(target=standby, daemon=True)
+    th.start()
+    _time.sleep(0.5)
+    assert "ctrl" not in box, "standby controller started while lease was held"
+    th.join(timeout=30)
+    assert "ctrl" in box, "standby controller never took over the expired lease"
+    ctrl = box["ctrl"]
+    try:
+        view = recovery.read_lease(state_root)
+        assert view.payload["owner"] == ctrl.lease.owner
+        assert view.payload["fence"] == 4  # foreign fence 3 + takeover
+    finally:
+        ctrl.close()
+
+
+def test_quiesce_timeout_emits_warning_event(tmp_path):
+    """run() hitting the quiesce deadline must tell the operator instead
+    of returning silently (a zombie gang would otherwise be invisible)."""
+    ctrl = _recovery_controller(str(tmp_path))
+    try:
+        spec = ExperimentSpec(
+            name="quiesce",
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1")),
+            ],
+            objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="score"),
+            algorithm=AlgorithmSpec("random"),
+            trial_template=_slow_quadratic_template(sleep_s=0.0),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        ctrl.create_experiment(spec)
+        ctrl.scheduler.quiesce = lambda *a, **k: False  # simulated zombie
+        ctrl.run("quiesce", timeout=60)
+        warnings = [
+            e for e in ctrl.events.list("quiesce") if e.reason == "QuiesceTimeout"
+        ]
+        assert warnings and warnings[0].event_type == "Warning"
+    finally:
+        ctrl.close()

@@ -410,6 +410,46 @@ def test_kti303_config_knob_env_override():
     assert "KTI303" not in rules_of(check_source(bad, "katib_tpu/other.py"))
 
 
+def test_kti305_nonatomic_json_persist():
+    """Seeded violation vs clean twin: a JSON write into open(.., 'w')
+    needs an os.replace afterwards in the same function (the repo-wide
+    tmp+replace persistence idiom, ISSUE 14)."""
+    bad = (
+        "import json, os\n"
+        "def persist(path, payload):\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(payload, f)\n"
+    )
+    good = (
+        "import json, os\n"
+        "def persist(path, payload):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(payload, f)\n"
+        "    os.replace(tmp, path)\n"
+    )
+    assert "KTI305" in rules_of(check_source(bad, "x.py"))
+    assert "KTI305" not in rules_of(check_source(good, "x.py"))
+    # the write-string form is the same hazard
+    bad_write = bad.replace("json.dump(payload, f)", "f.write(json.dumps(payload))")
+    assert "KTI305" in rules_of(check_source(bad_write, "x.py"))
+    # read opens and binary opens are out of scope
+    read = (
+        "import json\n"
+        "def load(path):\n"
+        "    with open(path) as f:\n"
+        "        return json.load(f)\n"
+    )
+    assert "KTI305" not in rules_of(check_source(read, "x.py"))
+    binary = (
+        "import json, pickle\n"
+        "def persist(path, payload):\n"
+        "    with open(path, 'wb') as f:\n"
+        "        pickle.dump(payload, f)\n"
+    )
+    assert "KTI305" not in rules_of(check_source(binary, "x.py"))
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     f = check_source("def broken(:\n", "x.py")
     assert [x.rule for x in f] == ["KT000"]
