@@ -2420,6 +2420,338 @@ def _bench_controller_kill_recovery(smoke: bool = False):
     }
 
 
+# In-process entry-point trial for the control-plane load harness: cheap,
+# deterministic (score depends only on x and epoch), and device-slot-bound
+# (the per-epoch dwell stands in for accelerator time on the 1-core CPU
+# box), so aggregate completed-trials/sec is governed by how many device
+# slots the control plane can keep busy — which is exactly what sharding
+# multiplies.
+_CP_TRIAL_MODULE = """\
+import time
+
+EPOCHS = {epochs}
+DWELL = {dwell}
+
+def run_trial(assignments, ctx):
+    x = float(assignments["x"])
+    for epoch in range(1, EPOCHS + 1):
+        time.sleep(DWELL)
+        ctx.report(score=x * (1.0 - 0.8 ** epoch), epoch=epoch)
+"""
+
+
+def _bench_control_plane_scaling(smoke: bool = False):
+    """Sharded control plane under a standing load harness (ISSUE 15): the
+    same batch of cheap experiments is driven through REAL replica
+    subprocesses over the HTTP/JSON wire protocol — specs routed by the
+    client-side placement router, status polled from the owners — at 1 vs
+    N replicas sharing one state root (WAL SQLite, per-experiment placement
+    leases). Aggregate completed-trials/sec must scale >= 2.5x at 3
+    replicas (each replica supervises its own device pool; trials are
+    device-slot-bound). A third phase SIGKILLs one replica mid-run: the
+    survivors must fail its experiments over inside the placement-lease
+    TTL, finish the batch with ZERO lost observations (every epoch curve
+    continuous 1..E) and score rows bit-identical to the fault-free run.
+
+    Scale knobs (the harness is the standing tool for finding the next
+    control-plane bottleneck): BENCH_CP_EXPERIMENTS / BENCH_CP_TRIALS /
+    BENCH_CP_EPOCHS / BENCH_CP_DWELL / BENCH_CP_REPLICAS."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from katib_tpu.client.katib_client import ReplicaRouter
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import SqliteObservationStore
+
+    # full-mode shape: every experiment dispatches as ONE round (trials ==
+    # parallel), so experiment wall == trial wall and the throughput ratio
+    # measures the control plane, not reconcile round-trip quantization;
+    # measured 2.86x at 3 replicas on the 1-core CPU box with these sizes
+    n_exps = int(os.environ.get("BENCH_CP_EXPERIMENTS", "4" if smoke else "18"))
+    n_trials = int(os.environ.get("BENCH_CP_TRIALS", "3" if smoke else "4"))
+    epochs = int(os.environ.get("BENCH_CP_EPOCHS", "2" if smoke else "4"))
+    dwell = float(os.environ.get("BENCH_CP_DWELL", "0.15" if smoke else "0.45"))
+    n_replicas = int(os.environ.get("BENCH_CP_REPLICAS", "2" if smoke else "3"))
+    devices_per_replica = 4 if smoke else 8
+    parallel = 2 if smoke else 4
+    lease_ttl = 8.0
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def exp_names():
+        return [f"cp-{i:03d}" for i in range(n_exps)]
+
+    def spec_for(name):
+        step = 0.9 / max(n_trials - 1, 1)
+        return {
+            "name": name,
+            "parameters": [{
+                "name": "x", "parameterType": "double",
+                "feasibleSpace": {"min": "0.1", "max": "1.0", "step": repr(step)},
+            }],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "grid"},
+            "trialTemplate": {
+                "entryPoint": "cp_trial:run_trial",
+                "trialParameters": [{"name": "x", "reference": "x"}],
+            },
+            "maxTrialCount": n_trials,
+            "parallelTrialCount": parallel,
+            "resumePolicy": "FromVolume",
+        }
+
+    def is_done(status_doc):
+        if not status_doc:
+            return False
+        return any(
+            c.get("type") in ("Succeeded", "Failed") and c.get("status")
+            for c in status_doc.get("status", {}).get("conditions", [])
+        )
+
+    def rows_by_key(root, names):
+        """{(experiment, x): (epoch ints, score strings)} read offline."""
+        state = ExperimentStateStore(os.path.join(root, "state"))
+        store = SqliteObservationStore(os.path.join(root, "observations.db"))
+        epochs_by, scores_by = {}, {}
+        try:
+            for name in names:
+                state.load(name)
+                for t in state.list_trials(name):
+                    key = (name, t.assignments_dict()["x"])
+                    epochs_by[key] = [
+                        int(float(r.value))
+                        for r in store.get_observation_log(t.name, metric_name="epoch")
+                    ]
+                    scores_by[key] = [
+                        r.value
+                        for r in store.get_observation_log(t.name, metric_name="score")
+                    ]
+        finally:
+            store.close()
+        return epochs_by, scores_by
+
+    def run_phase(replicas, kill=False, phase_timeout=420.0):
+        root = tempfile.mkdtemp(prefix="bench-cp-")
+        # the kill phase slows each epoch down so the SIGKILL is guaranteed
+        # to land on in-flight work; scores depend only on (x, epoch), so
+        # the bit-identity comparison against the fault-free phase holds
+        phase_dwell = max(dwell, 0.4) if kill else dwell
+        with open(os.path.join(root, "cp_trial.py"), "w") as f:
+            f.write(_CP_TRIAL_MODULE.format(epochs=epochs, dwell=phase_dwell))
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": (
+                repo + os.pathsep + root + os.pathsep + env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep),
+            "KATIB_TPU_REPLICAS": str(replicas),
+            "KATIB_TPU_REPLICA_CAPACITY": str(n_exps + 4),
+            "KATIB_TPU_PLACEMENT_LEASE_SECONDS": str(lease_ttl),
+            # replicas run lean: no telemetry/tracing/compile service, and
+            # DIRECT per-report SQLite commits (obslog_buffered=0) so every
+            # acknowledged row is durable when the SIGKILL lands
+            "KATIB_TPU_TELEMETRY": "0",
+            "KATIB_TPU_COMPILE_SERVICE": "0",
+            "KATIB_TPU_TRACING": "0",
+            "KATIB_TPU_OBSLOG_BUFFERED": "0",
+        })
+        env.pop("KATIB_TPU_CHAOS", None)
+        procs = {}
+        logs = []
+        deadline = time.time() + phase_timeout
+        try:
+            for i in range(replicas):
+                rid = f"r{i}"
+                out = open(os.path.join(root, f"{rid}.log"), "w+")
+                logs.append(out)
+                procs[rid] = subprocess.Popen(
+                    [sys.executable, "-m", "katib_tpu.controller.replica",
+                     "--root", root, "--replica-id", rid,
+                     "--devices", str(devices_per_replica)],
+                    env=env, stdout=out, stderr=out, text=True,
+                )
+            router = ReplicaRouter(root)
+            while len(router.live_replicas()) < replicas:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"replicas never registered; see {root}/r*.log"
+                    )
+                time.sleep(0.2)
+            # warmup: one 1-trial experiment per replica so the first-trial
+            # costs (module import, jax-backed compile-cache init) are paid
+            # before the measured window
+            warmups = []
+            for i in range(replicas):
+                wname = f"cp-warm-{i}"
+                w = dict(spec_for(wname))
+                w["maxTrialCount"] = 1
+                w["parallelTrialCount"] = 1
+                router.create_experiment(w)
+                warmups.append(wname)
+            while not all(is_done(router.experiment_status(w)) for w in warmups):
+                if time.time() > deadline:
+                    raise TimeoutError("warmup experiments never completed")
+                time.sleep(0.3)
+
+            names = exp_names()
+            t0 = time.time()
+            for name in names:
+                router.create_experiment(spec_for(name))
+            pending = set(names)
+            kill_time = None
+            victim = None
+            victim_claims = set()
+            failover_seen = {}  # experiment -> seconds after the kill the
+            # placement table first showed a SURVIVOR owning it
+            while pending:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} experiment(s) never completed: "
+                        f"{sorted(pending)[:4]}; see {root}/r*.log"
+                    )
+                for name in list(pending):
+                    if is_done(router.experiment_status(name)):
+                        pending.discard(name)
+                if kill and kill_time is None and time.time() - t0 > 0.6:
+                    # mid-run SIGKILL: the replica holding the most still-
+                    # pending placements dies without warning, while its
+                    # trials are in flight (the trigger fires on the first
+                    # poll after trials have had time to start)
+                    counts = {}
+                    rows = router.table()["leases"]
+                    for row in rows:
+                        if (
+                            row.get("state") == "active"
+                            and row.get("replica") in procs
+                            and row.get("experiment") in pending
+                        ):
+                            counts[row["replica"]] = counts.get(row["replica"], 0) + 1
+                    if counts:
+                        victim = max(counts, key=counts.get)
+                        victim_claims = {
+                            row["experiment"]
+                            for row in rows
+                            if row.get("replica") == victim
+                            and row.get("state") == "active"
+                            and row.get("experiment") in pending
+                        }
+                        procs[victim].send_signal(_signal.SIGKILL)
+                        procs[victim].wait()  # reap: a dead pid, not a zombie
+                        kill_time = time.time()
+                if kill_time is not None:
+                    for row in router.table()["leases"]:
+                        name = row.get("experiment", "")
+                        if (
+                            name in victim_claims
+                            and name not in failover_seen
+                            and row.get("replica") != victim
+                        ):
+                            failover_seen[name] = time.time() - kill_time
+                time.sleep(0.25)
+            wall = time.time() - t0
+            total_trials = n_exps * n_trials
+            failovers = 0
+            if kill:
+                assert kill_time is not None, "kill trigger never fired"
+                for rid in procs:
+                    if rid == victim:
+                        continue
+                    url = next(
+                        (
+                            r["url"] for r in router.table()["replicas"]
+                            if r.get("replica") == rid
+                        ),
+                        None,
+                    )
+                    status = router._client(url).replica_status() if url else None
+                    if status:
+                        failovers += int(status.get("failovers", 0))
+            epochs_by, scores_by = rows_by_key(root, names)
+            return {
+                "root": root,
+                "wall": wall,
+                "trials_per_sec": total_trials / wall,
+                "epochs_by": epochs_by,
+                "scores_by": scores_by,
+                "kill_time": kill_time,
+                "victim": victim,
+                "victim_claims": sorted(victim_claims),
+                "failover_seconds": sorted(failover_seen.values()),
+                "failovers": failovers,
+            }
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs.values():
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            for out in logs:
+                out.close()
+
+    # phase A: single replica — the fault-free reference AND the scaling
+    # baseline
+    ref = run_phase(1)
+    lost_ref = {
+        k: v for k, v in ref["epochs_by"].items()
+        if v != list(range(1, epochs + 1))
+    }
+    assert not lost_ref, f"single-replica reference lost rows: {lost_ref}"
+
+    # phase B: N replicas, no faults — the throughput claim
+    scaled = run_phase(n_replicas)
+    speedup = scaled["trials_per_sec"] / ref["trials_per_sec"]
+    if not smoke:
+        assert speedup >= 2.5, (
+            f"aggregate throughput scaled only {speedup:.2f}x at "
+            f"{n_replicas} replicas (>= 2.5x required): "
+            f"{ref['trials_per_sec']:.2f} -> {scaled['trials_per_sec']:.2f} trials/s"
+        )
+
+    # phase C: N replicas + mid-run SIGKILL — the failover claim
+    chaos = run_phase(n_replicas, kill=True)
+    lost = {
+        k: v for k, v in chaos["epochs_by"].items()
+        if v != list(range(1, epochs + 1))
+    }
+    assert not lost, f"lost/duplicated observations after failover: {lost}"
+    assert chaos["scores_by"] == ref["scores_by"], (
+        "failed-over sweep rows are not bit-identical to the fault-free run"
+    )
+    assert chaos["failovers"] >= 1, (
+        f"no survivor recorded a failover (victim {chaos['victim']} held "
+        f"{chaos['victim_claims']})"
+    )
+    max_failover = max(chaos["failover_seconds"], default=0.0)
+    assert max_failover < lease_ttl, (
+        f"failover took {max_failover:.1f}s (>= placement lease ttl {lease_ttl}s)"
+    )
+    for phase in (ref, scaled, chaos):
+        shutil.rmtree(phase["root"], ignore_errors=True)
+    return {
+        "experiments": n_exps,
+        "trials_per_experiment": n_trials,
+        "epochs": epochs,
+        "devices_per_replica": devices_per_replica,
+        "replicas": n_replicas,
+        "trials_per_sec_1_replica": round(ref["trials_per_sec"], 3),
+        f"trials_per_sec_{n_replicas}_replicas": round(scaled["trials_per_sec"], 3),
+        "speedup": round(speedup, 3),
+        "speedup_target": 2.5 if not smoke else None,
+        "sigkill_victim": chaos["victim"],
+        "victim_experiments": len(chaos["victim_claims"]),
+        "failovers": chaos["failovers"],
+        "max_failover_seconds": round(max_failover, 3),
+        "failover_bound_seconds": lease_ttl,
+        "lost_observations": len(lost),
+        "bit_identical": chaos["scores_by"] == ref["scores_by"],
+        "smoke": smoke,
+    }
+
+
 def _bench_preemption_latency(jax, np):
     """Fair-share preemption round trip (controller/fairshare.py) on 8
     abstract device slots: a low-priority 8-chip trial checkpointing every
@@ -3422,6 +3754,7 @@ OBSLOG_SCENARIOS = {
     "bohb_convergence": _bench_bohb_convergence,
     "device_chaos_recovery": _bench_device_chaos_recovery,
     "controller_kill_recovery": _bench_controller_kill_recovery,
+    "control_plane_scaling": _bench_control_plane_scaling,
 }
 
 

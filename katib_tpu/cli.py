@@ -34,6 +34,10 @@ fetch_hp_job_info, fetch_trial_logs). Subcommands:
                            root's single-writer lease, the recovery
                            journal's tail, and the in-flight trials a
                            checkpoint-preserving restart would requeue
+  replicas                 sharded-control-plane placement table: live
+                           replica registrations and per-experiment
+                           placement leases (owner, fence, heartbeat age),
+                           offline from <root>/placement/
   algorithms               registered suggestion / early-stopping algorithms
   check [paths]            recompile-hazard / lock-discipline / repo-invariant
                            static analysis (docs/static-analysis.md); exits 1
@@ -717,6 +721,57 @@ def cmd_recover(args) -> int:
     return 0
 
 
+def cmd_replicas(args) -> int:
+    """Offline placement table of the sharded control plane (ISSUE 15):
+    replica registrations + per-experiment placement leases, read straight
+    from ``<root>/placement/`` — no controller constructed, so it never
+    contends a live lease (the `recover`/`devices` CLI shape)."""
+    from .controller.placement import placement_table
+
+    table = placement_table(args.root)
+    if args.format == "json":
+        print(json.dumps(table, indent=2, sort_keys=True))
+        return 0
+    replicas, leases = table["replicas"], table["leases"]
+    if not replicas and not leases:
+        print(f"no placement state under {args.root}/placement "
+              "(sharded mode never ran here)")
+        return 0
+    print(f"replicas ({len(replicas)}):")
+    _table(
+        ["REPLICA", "ALIVE", "PID", "CLAIMED", "CAPACITY", "AGE", "URL"],
+        [
+            (
+                r.get("replica", "-"),
+                "yes" if r.get("alive") else "no",
+                r.get("pid", "-"),
+                len(r.get("claimed", [])),
+                r.get("capacity", "-"),
+                f"{r['ageSeconds']:.1f}s" if r.get("ageSeconds") is not None else "-",
+                r.get("url", "-"),
+            )
+            for r in replicas
+        ],
+    )
+    print(f"\nplacement leases ({len(leases)}):")
+    _table(
+        ["EXPERIMENT", "REPLICA", "STATE", "FENCE", "AGE", "HOLDER"],
+        [
+            (
+                l.get("experiment", "-"),
+                l.get("replica") or "-",
+                ("EXPIRED" if l.get("expired") and l.get("state") == "active"
+                 else l.get("state", "-")),
+                l.get("fence", "-"),
+                f"{l['ageSeconds']:.1f}s" if l.get("ageSeconds") is not None else "-",
+                ("alive" if l.get("holderAlive") else "dead"),
+            )
+            for l in leases
+        ],
+    )
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -1072,6 +1127,14 @@ def main(argv=None) -> int:
     )
     sv.add_argument("--port", type=int, default=6789)
     sv.set_defaults(fn=cmd_serve)
+
+    rp = sub.add_parser(
+        "replicas",
+        help="sharded-control-plane placement table (replica registrations "
+        "+ per-experiment placement leases), offline from <root>/placement/",
+    )
+    rp.add_argument("--format", choices=("text", "json"), default="text")
+    rp.set_defaults(fn=cmd_replicas)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -321,3 +321,103 @@ class KatibClient:
             ],
             env=dict(env),
         )
+
+
+# -- sharded control plane routing (ISSUE 15) --------------------------------
+
+
+class ReplicaRouter:
+    """The tiny client-side router of the sharded control plane: reads the
+    placement table under ``<root>/placement/`` (controller/placement.py)
+    and answers two questions — which replica OWNS an experiment (follow
+    its lease), and which replica should receive a NEW one (the live
+    replica with the fewest claims). No server round trip: the table is
+    plain files on the shared root, exactly what `katib-tpu replicas`
+    renders."""
+
+    def __init__(self, root_dir: str, token: Optional[str] = None):
+        self.root_dir = root_dir
+        self.token = token
+
+    def table(self) -> Dict[str, Any]:
+        from ..controller.placement import placement_table
+
+        return placement_table(self.root_dir)
+
+    def live_replicas(self) -> List[Dict[str, Any]]:
+        return [r for r in self.table()["replicas"] if r.get("alive")]
+
+    def owner_url(self, experiment: str) -> Optional[str]:
+        """The owning replica's rpc url, or None when unplaced/expired."""
+        for row in self.table()["leases"]:
+            if (
+                row.get("experiment") == experiment
+                and row.get("state") == "active"
+                and not row.get("expired")
+                and row.get("holderAlive")
+            ):
+                return row.get("url") or None
+        return None
+
+    def _persisted_status(self, experiment: str) -> Optional[Dict[str, Any]]:
+        """The persisted experiment record from the shared root — the
+        authoritative view once the run ended and the placement lease was
+        released (completed experiments are unowned by design)."""
+        import json as _json
+
+        path = os.path.join(
+            self.root_dir, "state", experiment, "state", "experiment.json"
+        )
+        try:
+            with open(path) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def pick_for_create(self) -> Optional[Dict[str, Any]]:
+        live = self.live_replicas()
+        if not live:
+            return None
+        return min(live, key=lambda r: (len(r.get("claimed", [])), r.get("replica", "")))
+
+    # -- remote operations ---------------------------------------------------
+
+    def _client(self, url: str):
+        from ..service.httpapi import HttpApiClient
+
+        return HttpApiClient(url, token=self.token)
+
+    def create_experiment(self, spec_mapping: Dict[str, Any]) -> Dict[str, Any]:
+        """Route a spec to the least-loaded live replica; a 429 (capacity)
+        falls through to the next candidate."""
+        from ..service.httpapi import RpcError
+
+        last: Optional[Exception] = None
+        candidates = sorted(
+            self.live_replicas(), key=lambda r: (len(r.get("claimed", [])), r.get("replica", ""))
+        )
+        if not candidates:
+            raise RuntimeError(
+                f"no live replicas registered under {self.root_dir}/placement"
+            )
+        for rep in candidates:
+            try:
+                return self._client(rep["url"]).create_experiment(spec_mapping)
+            except RpcError as e:
+                if e.code == 429:
+                    last = e
+                    continue
+                raise
+        raise RuntimeError(f"every live replica refused the experiment: {last}")
+
+    def experiment_status(self, experiment: str) -> Optional[Dict[str, Any]]:
+        """The experiment's status document: the owner's live view while a
+        replica holds the placement lease, else the persisted record from
+        the shared root (a completed experiment releases its lease, and a
+        just-killed owner's experiment is briefly unowned mid-failover)."""
+        url = self.owner_url(experiment)
+        if url is not None:
+            live = self._client(url).experiment_status(experiment)
+            if live is not None:
+                return live
+        return self._persisted_status(experiment)

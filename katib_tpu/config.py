@@ -170,6 +170,24 @@ class RuntimeConfig:
     # standby mode: a second controller on a held state root waits for the
     # lease to expire and takes over instead of refusing to start
     controller_lease_standby: bool = False
+    # Sharded control plane (controller/placement.py + service/httpapi.py,
+    # ISSUE 15): >0 puts the controller in replica mode — per-experiment
+    # placement leases under <root>/placement/ replace the root-wide
+    # single-writer lease, the journal moves to a per-replica subdir, and
+    # N replica processes share one root, each owning a disjoint experiment
+    # set. 0 (default / KATIB_TPU_REPLICAS unset) is byte-identical to the
+    # single-controller PR 14 behavior.
+    replicas: int = 0
+    # experiments one replica claims at most (the placement target; the
+    # failover scan also honors it when absorbing a dead replica's work)
+    replica_capacity: int = 8
+    # HTTP/JSON wire-protocol port per replica (0 = ephemeral, printed by
+    # the replica process at start)
+    rpc_port: int = 0
+    # placement lease TTL: a dead replica's experiments are takeable this
+    # many seconds after its last heartbeat (immediately when the holder
+    # pid is dead on the same host)
+    placement_lease_seconds: float = 10.0
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -218,6 +236,10 @@ ENV_OVERRIDES: Dict[str, str] = {
     "recovery": "KATIB_TPU_RECOVERY",
     "controller_lease_seconds": "KATIB_TPU_CONTROLLER_LEASE_SECONDS",
     "controller_lease_standby": "KATIB_TPU_CONTROLLER_LEASE_STANDBY",
+    "replicas": "KATIB_TPU_REPLICAS",
+    "replica_capacity": "KATIB_TPU_REPLICA_CAPACITY",
+    "rpc_port": "KATIB_TPU_RPC_PORT",
+    "placement_lease_seconds": "KATIB_TPU_PLACEMENT_LEASE_SECONDS",
     "device_plane": "KATIB_TPU_DEVICE_PLANE",
     "device_probe_timeout_seconds": "KATIB_TPU_DEVICE_PROBE_TIMEOUT_SECONDS",
     "device_reprobe_interval_seconds": "KATIB_TPU_DEVICE_REPROBE_INTERVAL_SECONDS",

@@ -376,6 +376,12 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_recovery_rows_preserved_total": "Observation rows preserved across controller restarts (at or before the last durable checkpoint).",
     "katib_recovery_rows_truncated_total": "Un-checkpointed observation rows truncated at restart (the resumed stint re-reports them).",
     "katib_recovery_replay_seconds": "Wall-clock of the last recovery replay (journal + truncation + requeue), per experiment.",
+    # sharded control plane (ISSUE 15, controller/placement.py +
+    # service/httpapi.py) — the ReplicaJoined / ReplicaFailedOver events
+    # pair with these series
+    "katib_rpc_requests_total": "Wire-protocol requests served, by api.proto service, method and status code.",
+    "katib_rpc_latency_seconds": "Wire-protocol request latency, by api.proto service.",
+    "katib_replica_experiments": "Experiments currently placed on each replica (placement leases held).",
 }
 
 
@@ -445,4 +451,7 @@ EVENT_CATALOG: Dict[str, str] = {
     "ControllerRecovered": "A restarted controller replayed the recovery journal and requeued in-flight trials with their checkpointed observation rows preserved.",
     "LeaseTakenOver": "This controller took over the state root's single-writer lease from an expired or dead previous holder (fence token incremented).",
     "QuiesceTimeout": "The scheduler did not quiesce within its deadline after experiment completion; a zombie trial may still hold its gang allocation.",
+    # sharded control plane (ISSUE 15, controller/placement.py)
+    "ReplicaJoined": "A controller replica registered with the shared root's placement plane and began claiming experiments.",
+    "ReplicaFailedOver": "A replica took over a dead or expired peer's experiment placement (fence bumped) and recovered it from the shared root.",
 }

@@ -132,14 +132,28 @@ class ExperimentController:
         if rt.recovery and state_root:
             from .recovery import ControllerLease, RecoveryJournal, journal_dir
 
-            self.lease = ControllerLease(
-                state_root,
-                ttl_seconds=rt.controller_lease_seconds,
-                standby=rt.controller_lease_standby,
-                events=self.events,
-                metrics=self.metrics,
-            ).acquire()
-            self.journal = RecoveryJournal(journal_dir(root_dir))
+            if rt.replicas > 0:
+                # Sharded control plane (controller/placement.py, ISSUE 15):
+                # per-experiment placement leases replace the root-wide
+                # single-writer — N replicas share this root, each owning a
+                # disjoint experiment set — and each replica journals under
+                # its own subdir so cross-process appends never collide on a
+                # segment name. Replay walks every subdir (merged records)
+                # so a failover replica sees the dead owner's intents.
+                from .placement import replica_id
+
+                self.journal = RecoveryJournal(
+                    journal_dir(root_dir, replica=replica_id())
+                )
+            else:
+                self.lease = ControllerLease(
+                    state_root,
+                    ttl_seconds=rt.controller_lease_seconds,
+                    standby=rt.controller_lease_standby,
+                    events=self.events,
+                    metrics=self.metrics,
+                ).acquire()
+                self.journal = RecoveryJournal(journal_dir(root_dir))
         store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
         if rt.obslog_buffered and isinstance(store, SqliteObservationStore):
             # group-commit write-behind pipeline (docs/data-plane.md): the
@@ -837,7 +851,7 @@ class ExperimentController:
 
         t0 = time.time()
         name = exp.name
-        journal_high = self._replay_journal(exp)
+        journal_high, consumed_files = self._replay_journal(exp)
         resumable = exp.spec.trial_template.function is None
         requeue: List[Trial] = []
         for trial in self.state.list_trials(name):
@@ -941,7 +955,12 @@ class ExperimentController:
                 rows_truncated += self.obs_store.truncate_observation_log(
                     f"{name}-population", fused_ck_time
                 )
-        if journal_high:
+        if consumed_files is not None:
+            # sharded mode: the replayed records may live in ANOTHER
+            # replica's journal subdir — remove exactly the consumed
+            # segments instead of compacting by our own seq counter
+            recovery.remove_journal_files(consumed_files)
+        elif journal_high:
             # intents at or below the replayed high-water mark are consumed;
             # the requeued batch writes fresh ones
             self.journal.compact(name, journal_high)
@@ -973,9 +992,12 @@ class ExperimentController:
         )
         return exp
 
-    def _replay_journal(self, exp: Experiment) -> int:
+    def _replay_journal(self, exp: Experiment):
         """Replay this experiment's journal intents against the loaded
-        state; returns the highest seq seen (0 = empty journal).
+        state; returns ``(highest seq seen, consumed segment paths)`` —
+        0 for an empty journal, and paths only in sharded mode (where the
+        merged cross-replica walk knows each record's file and compaction
+        removes exactly what was consumed).
 
         Two crash edges are closed here:
 
@@ -990,9 +1012,17 @@ class ExperimentController:
           assignment so the budget math sees it immediately rather than an
           orphan the next reconcile has to re-derive.
         """
-        records = self.journal.records(exp.name)
+        sharded = self.config.runtime.replicas > 0
+        if sharded:
+            from . import recovery
+
+            # a failover replica replays the DEAD owner's intents: walk every
+            # journal subdir, ordered by (ts, seq)
+            records = recovery.merged_journal_records(self.root_dir, exp.name)
+        else:
+            records = self.journal.records(exp.name)
         if not records:
-            return 0
+            return 0, ([] if sharded else None)
         trials = {t.name: t for t in self.state.list_trials(exp.name)}
         suggestion = self.state.get_suggestion(exp.name)
         assignments = {
@@ -1030,7 +1060,9 @@ class ExperimentController:
                     trial.labels["katib-tpu/experiment"] = exp.name
                     self.state.create_trial(trial)
                     trials[tn] = trial
-        return int(records[-1].get("seq", 0))
+        if sharded:
+            return 0, [r["_file"] for r in records if r.get("_file")]
+        return int(records[-1].get("seq", 0)), None
 
     def delete_experiment(self, name: str) -> None:
         """Delete an experiment and all its state (kubectl delete experiment)."""

@@ -1,0 +1,392 @@
+"""Experiment placement — sharding the control plane across replicas.
+
+PR 14's :class:`~.recovery.ControllerLease` made the whole state root a
+single-writer domain; this module generalizes that one lock into
+per-experiment *placement leases* under ``<root>/placement/`` so N
+controller replicas share one root, each owning a disjoint set of
+experiments (upstream Katib gets the same property from the API server's
+optimistic concurrency — one controller reconciles an object at a time;
+here the lease file IS the placement record):
+
+- ``<root>/placement/<experiment>.lease`` — who runs the experiment: the
+  same heartbeated acquire/expire/fence lifecycle as the controller lease
+  (dead-pid fast path included, so a SIGKILLed replica's experiments are
+  takeable immediately), plus ``replica``/``url`` payload fields so clients
+  can route to the owner.
+- ``<root>/placement/replicas/<replica>.json`` — the replica registry: one
+  heartbeated registration per live replica (rpc url, capacity, claimed
+  count). The client router picks the least-loaded live replica for new
+  experiments from this table; ``katib-tpu replicas`` renders it offline.
+
+:class:`ReplicaManager` runs inside each replica process: it claims new
+experiments up to ``replica_capacity`` (the HTTP create endpoint calls
+``claim_new``), heartbeats its claims, and on every supervisor tick scans
+for *orphaned* experiments — incomplete, with a takeable lease (expired,
+released, or dead holder) — and fails them over: takeover bumps the fence
+token, ``load_experiment`` replays the dead replica's journal and truncates
+to checkpoints (controller/recovery.py — the machinery is per-experiment
+already), and the experiment resumes on this replica
+(``ReplicaFailedOver``).
+
+Two survivors can race a takeover scan; the lease write is last-writer-wins
+and each claimant re-reads the file after writing, so exactly one keeps the
+claim (the loser backs off before loading any state). Scan phases are
+additionally staggered per replica id to keep the window rare.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .recovery import ControllerLease, LeaseHeldError, read_lease_path
+
+log = logging.getLogger("katib_tpu.placement")
+
+PLACEMENT_DIRNAME = "placement"
+REPLICA_REGISTRY_DIRNAME = "replicas"
+LEASE_SUFFIX = ".lease"
+
+ENV_REPLICA_ID = "KATIB_TPU_REPLICA_ID"
+
+
+def replica_id() -> str:
+    """This process's replica identity: ``KATIB_TPU_REPLICA_ID`` when the
+    launcher pinned one (the bench names its children), else pid-derived —
+    unique per process on one host, which is all the journal subdir and the
+    lease owner field need."""
+    return os.environ.get(ENV_REPLICA_ID) or f"replica-{os.getpid()}"
+
+
+def placement_dir(root_dir: str) -> str:
+    return os.path.join(root_dir, PLACEMENT_DIRNAME)
+
+
+def registry_dir(root_dir: str) -> str:
+    return os.path.join(placement_dir(root_dir), REPLICA_REGISTRY_DIRNAME)
+
+
+def lease_file_for(experiment: str) -> str:
+    return experiment + LEASE_SUFFIX
+
+
+def _experiment_completed(root_dir: str, name: str) -> Optional[bool]:
+    """Read the persisted experiment record's completion verdict without
+    constructing a state store (the failover scan runs every tick). None =
+    no readable record (a torn create — not claimable yet)."""
+    path = os.path.join(root_dir, "state", name, "state", "experiment.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    for cond in rec.get("status", {}).get("conditions", []):
+        if cond.get("type") in ("Succeeded", "Failed") and cond.get("status"):
+            return True
+    return False
+
+
+def placement_table(root_dir: str) -> Dict[str, Any]:
+    """Offline placement snapshot — replicas + per-experiment leases, read
+    straight from ``<root>/placement/`` (the `katib-tpu replicas` CLI and
+    the client router both consume this; no controller is constructed, so
+    it never contends a live lease)."""
+    pdir = placement_dir(root_dir)
+    now = time.time()
+    replicas: List[Dict[str, Any]] = []
+    rdir = registry_dir(root_dir)
+    try:
+        names = sorted(os.listdir(rdir))
+    except OSError:
+        names = []
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(rdir, fn)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        renewed = float(rec.get("renewed", 0.0) or 0.0)
+        ttl = float(rec.get("ttl", 0.0) or 0.0)
+        age = now - renewed if renewed else None
+        rec["ageSeconds"] = age
+        rec["alive"] = bool(
+            age is not None and (ttl <= 0 or age <= ttl) and _pid_alive(rec.get("pid"))
+        )
+        replicas.append(rec)
+    leases: List[Dict[str, Any]] = []
+    try:
+        lease_names = sorted(os.listdir(pdir))
+    except OSError:
+        lease_names = []
+    for fn in lease_names:
+        if not fn.endswith(LEASE_SUFFIX):
+            continue
+        view = read_lease_path(os.path.join(pdir, fn))
+        row = view.to_dict()
+        row["experiment"] = fn[: -len(LEASE_SUFFIX)]
+        row["replica"] = view.payload.get("replica")
+        row["url"] = view.payload.get("url")
+        leases.append(row)
+    return {"root": root_dir, "replicas": replicas, "leases": leases}
+
+
+def _pid_alive(pid) -> bool:
+    try:
+        pid = int(pid)
+    except (TypeError, ValueError):
+        return False
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class ReplicaManager:
+    """Claims, heartbeats and fails over experiment placements for ONE
+    replica process. Owns no scheduler state — it drives the replica's
+    :class:`~.experiment.ExperimentController` through the public
+    create/load/run surface, exactly like the UI's run threads."""
+
+    def __init__(
+        self,
+        controller,
+        replica_id: str,
+        rpc_url: str = "",
+        capacity: int = 8,
+        lease_seconds: float = 10.0,
+        scan_interval: float = 1.0,
+    ):
+        self.controller = controller
+        self.replica_id = replica_id
+        self.rpc_url = rpc_url
+        self.capacity = max(1, int(capacity))
+        self.lease_seconds = max(float(lease_seconds), 1.0)
+        self.scan_interval = max(float(scan_interval), 0.1)
+        assert controller.root_dir, "sharded placement requires a persisted root"
+        self.root_dir = controller.root_dir
+        self._pdir = placement_dir(self.root_dir)
+        os.makedirs(registry_dir(self.root_dir), exist_ok=True)
+        self._lock = threading.Lock()
+        self._leases: Dict[str, ControllerLease] = {}
+        self._runners: Dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.failovers = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaManager":
+        self._register()
+        self.controller.events.event(
+            "", "Replica", self.replica_id, "ReplicaJoined",
+            f"replica {self.replica_id} joined the control plane "
+            f"(capacity {self.capacity}, url {self.rpc_url or 'n/a'})",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"placement-{self.replica_id}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            leases = list(self._leases.values())
+            self._leases.clear()
+        for lease in leases:
+            lease.release()
+        try:
+            os.remove(self._registration_path())
+        except OSError:
+            pass
+
+    # -- registry ------------------------------------------------------------
+
+    def _registration_path(self) -> str:
+        return os.path.join(registry_dir(self.root_dir), self.replica_id + ".json")
+
+    def _register(self) -> None:
+        with self._lock:
+            claimed = sorted(self._leases)
+        payload = {
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "url": self.rpc_url,
+            "capacity": self.capacity,
+            "claimed": claimed,
+            "renewed": time.time(),
+            "ttl": self.lease_seconds,
+        }
+        path = self._registration_path()
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(payload))
+            os.replace(tmp, path)
+        except OSError:
+            log.debug("replica registration write failed", exc_info=True)
+        if self.controller.metrics is not None:
+            self.controller.metrics.set_gauge(
+                "katib_replica_experiments", float(len(claimed)),
+                replica=self.replica_id,
+            )
+
+    # -- claims --------------------------------------------------------------
+
+    def claimed(self) -> List[str]:
+        with self._lock:
+            return sorted(self._leases)
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "url": self.rpc_url,
+            "capacity": self.capacity,
+            "claimed": self.claimed(),
+            "failovers": self.failovers,
+        }
+
+    def claim_new(self, experiment: str) -> bool:
+        """Claim a freshly-submitted experiment (the HTTP create endpoint).
+        False when at capacity or another live replica holds the lease."""
+        with self._lock:
+            if experiment in self._leases:
+                return True  # idempotent re-claim of our own placement
+            if len(self._leases) >= self.capacity:
+                return False
+        return self._claim(experiment) is not None
+
+    def release(self, experiment: str) -> None:
+        with self._lock:
+            lease = self._leases.pop(experiment, None)
+            self._runners.pop(experiment, None)
+        if lease is not None:
+            lease.release()
+        self._register()
+
+    def _claim(self, experiment: str) -> Optional[ControllerLease]:
+        lease = ControllerLease(
+            self._pdir,
+            ttl_seconds=self.lease_seconds,
+            events=self.controller.events,
+            metrics=self.controller.metrics,
+            lease_file=lease_file_for(experiment),
+            owner=self.replica_id,
+            extra={"replica": self.replica_id, "url": self.rpc_url},
+            pid_reacquire=False,
+        )
+        try:
+            lease.acquire()
+        except LeaseHeldError:
+            return None
+        # last-writer-wins double-check: a concurrent claimant may have
+        # overwritten our record between _write and now — re-read and keep
+        # the claim only if the file still names us
+        view = read_lease_path(lease.path)
+        if view.payload.get("owner") != self.replica_id:
+            lease.lost.set()
+            lease.release()
+            return None
+        with self._lock:
+            self._leases[experiment] = lease
+        self._register()
+        return lease
+
+    # -- run threads ---------------------------------------------------------
+
+    def run_experiment(self, experiment: str) -> None:
+        """Drive a claimed experiment to completion on a daemon thread (the
+        ui/server.py run-thread shape); the placement lease is released when
+        the run ends so the table shows completed experiments unowned."""
+
+        def _run():
+            try:
+                self.controller.run(experiment)
+            except KeyError:
+                pass  # deleted while running
+            except Exception:
+                log.exception("replica run thread failed for %s", experiment)
+            finally:
+                self.release(experiment)
+
+        t = threading.Thread(
+            target=_run, daemon=True, name=f"replica-run-{experiment}"
+        )
+        with self._lock:
+            self._runners[experiment] = t
+        t.start()
+
+    # -- supervisor ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        # deterministic stagger so same-tick takeover races between
+        # survivors stay rare (the double-check in _claim resolves the rest)
+        offset = (hash(self.replica_id) % 7) * self.scan_interval / 8.0
+        self._stop.wait(offset)
+        while not self._stop.wait(self.scan_interval):
+            try:
+                self._register()
+                self._tick()
+            except Exception:
+                log.exception("placement tick failed")
+
+    def _tick(self) -> None:
+        with self._lock:
+            free = self.capacity - len(self._leases)
+        if free <= 0:
+            return
+        state_root = os.path.join(self.root_dir, "state")
+        try:
+            names = sorted(os.listdir(state_root))
+        except OSError:
+            return
+        for name in names:
+            if free <= 0:
+                return
+            with self._lock:
+                if name in self._leases:
+                    continue
+            if not os.path.isdir(os.path.join(state_root, name)):
+                continue
+            completed = _experiment_completed(self.root_dir, name)
+            if completed is None or completed:
+                continue
+            view = read_lease_path(os.path.join(self._pdir, lease_file_for(name)))
+            if not view.exists:
+                # never placed (a crash between create and claim): claimable
+                pass
+            elif view.state == "active" and not view.expired and view.holder_alive:
+                continue  # live owner
+            lease = self._claim(name)
+            if lease is None:
+                continue
+            free -= 1
+            self.failovers += 1
+            self.controller.events.event(
+                name, "Replica", self.replica_id, "ReplicaFailedOver",
+                f"replica {self.replica_id} took over experiment {name} "
+                f"from {view.payload.get('replica') or 'nobody'} "
+                f"(fence {lease.fence}); recovering from the shared root",
+                warning=True,
+            )
+            try:
+                self.controller.load_experiment(name)
+            except Exception:
+                log.exception("failover load of %s failed", name)
+                self.release(name)
+                continue
+            self.run_experiment(name)

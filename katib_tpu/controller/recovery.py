@@ -209,9 +209,68 @@ class RecoveryJournal:
         return removed
 
 
-def journal_dir(root_dir: str) -> str:
-    """Canonical journal location under a controller root."""
-    return os.path.join(root_dir, JOURNAL_DIRNAME)
+def journal_dir(root_dir: str, replica: Optional[str] = None) -> str:
+    """Canonical journal location under a controller root. In sharded mode
+    (controller/placement.py) each replica journals under its own subdir so
+    cross-process appends can never collide on a segment name; replay walks
+    every subdir (:func:`merged_journal_records`)."""
+    base = os.path.join(root_dir, JOURNAL_DIRNAME)
+    return os.path.join(base, replica) if replica else base
+
+
+def merged_journal_records(
+    root_dir: str, experiment: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Every readable journal record across ALL journal dirs — the flat
+    single-controller layout plus each replica's subdir — ordered by
+    (ts, seq). Per-replica seqs are independent counters, so timestamps
+    (one host clock: replicas share the root's filesystem) carry the
+    cross-replica order and seq only breaks ties within a dir. Each record
+    gains a ``_file`` key (its segment path) so a consumed replay can
+    remove exactly what it read (:func:`remove_journal_files`)."""
+    base = os.path.join(root_dir, JOURNAL_DIRNAME)
+    out: List[Dict[str, Any]] = []
+    dirs = [base]
+    try:
+        dirs += [
+            os.path.join(base, fn)
+            for fn in sorted(os.listdir(base))
+            if os.path.isdir(os.path.join(base, fn))
+        ]
+    except OSError:
+        return out
+    for d in dirs:
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            continue
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            path = os.path.join(d, fn)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if experiment is None or rec.get("experiment") == experiment:
+                rec["_file"] = path
+                out.append(rec)
+    out.sort(key=lambda r: (r.get("ts", 0.0), r.get("seq", 0)))
+    return out
+
+
+def remove_journal_files(paths: List[str]) -> int:
+    """Drop consumed journal segments (cross-replica compaction after a
+    failover replay); returns the number removed."""
+    removed = 0
+    for path in paths:
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError:
+            continue
+    return removed
 
 
 # -- controller lease ---------------------------------------------------------
@@ -251,12 +310,25 @@ def _pid_alive(pid: Optional[int]) -> bool:
         return False
     except PermissionError:
         return True
-    return True
+    # signal-0 succeeds on a ZOMBIE (dead but unreaped — e.g. a SIGKILLed
+    # replica whose launcher hasn't wait()ed yet); /proc state 'Z' means the
+    # holder is gone and its lease is takeable NOW, not at TTL expiry
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        return stat.rpartition(")")[2].split()[0] != "Z"
+    except (OSError, IndexError):
+        return True
 
 
-def read_lease(state_root: str) -> LeaseView:
-    """Decode the lease file without touching it (offline inspection)."""
-    path = os.path.join(state_root, LEASE_FILE)
+def read_lease(state_root: str, lease_file: str = LEASE_FILE) -> LeaseView:
+    """Decode a lease file without touching it (offline inspection). The
+    default name is the root-wide single-writer lease; placement leases
+    (controller/placement.py) pass their per-experiment file name."""
+    return read_lease_path(os.path.join(state_root, lease_file))
+
+
+def read_lease_path(path: str) -> LeaseView:
     payload: Dict[str, Any] = {}
     exists = os.path.exists(path)
     if exists:
@@ -308,14 +380,25 @@ class ControllerLease:
         events=None,
         metrics=None,
         standby_timeout: Optional[float] = None,
+        lease_file: str = LEASE_FILE,
+        owner: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
+        pid_reacquire: bool = True,
     ):
-        self.path = os.path.join(state_root, LEASE_FILE)
+        self.path = os.path.join(state_root, lease_file)
         self.ttl = max(float(ttl_seconds), 1.0)
         self.standby = standby
         self.standby_timeout = standby_timeout
         self.events = events
         self.metrics = metrics
-        self.owner = f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.owner = owner or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        # extra payload fields (placement leases carry replica id + rpc url)
+        self.extra = dict(extra or {})
+        # root leases treat a same-pid holder as "same writer, new handle"
+        # (the test-only two-controllers-in-one-process pattern); placement
+        # leases must NOT — distinct ReplicaManagers can share a process and
+        # their claims are owner-identity scoped, not pid scoped
+        self.pid_reacquire = pid_reacquire
         self.fence = 0
         self.lost = threading.Event()
         self._stop = threading.Event()
@@ -336,7 +419,10 @@ class ControllerLease:
             "renewed": now,
             "ttl": self.ttl,
         }
-        tmp = self.path + ".tmp"
+        payload.update(self.extra)
+        # pid-unique tmp: two processes racing a placement takeover must not
+        # collide on the staging name (os.replace keeps the install atomic)
+        tmp = f"{self.path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(payload))
         os.replace(tmp, self.path)
@@ -353,7 +439,9 @@ class ControllerLease:
         if view.payload.get("host") in (None, socket.gethostname()):
             pid = view.payload.get("pid")
             if pid == os.getpid():
-                return True  # in-process namesake: same writer, new handle
+                # in-process namesake: same writer, new handle — unless this
+                # lease's claims are owner-scoped (placement)
+                return self.pid_reacquire or view.payload.get("owner") == self.owner
             if not _pid_alive(pid):
                 return True  # SIGKILLed predecessor: no TTL wait needed
         return False
@@ -365,7 +453,7 @@ class ControllerLease:
             else None
         )
         while True:
-            view = read_lease(os.path.dirname(self.path))
+            view = read_lease_path(self.path)
             if self._takeable(view):
                 prior = view.payload if view.exists else {}
                 self.fence = int(prior.get("fence", 0) or 0) + 1
@@ -414,7 +502,7 @@ class ControllerLease:
     def _heartbeat_loop(self) -> None:
         acquired = time.time()
         while not self._stop.wait(self.ttl / 3.0):
-            view = read_lease(os.path.dirname(self.path))
+            view = read_lease_path(self.path)
             if view.payload.get("owner") not in (None, self.owner):
                 # fenced out: another controller took the lease; never
                 # write over it — the takeover is the durable record
@@ -448,7 +536,7 @@ class ControllerLease:
             self._thread.join(timeout=2.0)
         if self.lost.is_set():
             return  # fenced out: the file belongs to the new owner
-        view = read_lease(os.path.dirname(self.path))
+        view = read_lease_path(self.path)
         if view.payload.get("owner") in (None, self.owner):
             try:
                 self._write(LEASE_RELEASED)

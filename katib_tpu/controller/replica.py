@@ -1,0 +1,160 @@
+"""Controller replica — one shard of the sharded control plane.
+
+``python -m katib_tpu.controller.replica --root <root> --replica-id r1
+--port 0 --devices 8`` runs ONE replica process: an
+:class:`~.experiment.ExperimentController` over the shared root (replica
+mode: per-experiment placement leases instead of the root-wide
+single-writer, its own journal subdir), the HTTP/JSON wire API
+(service/httpapi.py — Suggestion / EarlyStopping / DBManager plus the
+replica plane), and the :class:`~.placement.ReplicaManager` claim/failover
+loop. The upstream analogue is the katib-controller Deployment scaled to
+N>1 with per-object leader election.
+
+On start it prints ONE JSON line ``{"replica", "url", "pid"}`` so a
+launcher (the ``control_plane_scaling`` bench, tests) can address it, then
+serves until SIGTERM/SIGINT. The replica exports its own url as
+``KATIB_TPU_RPC_URL`` so subprocess trials it spawns push metric streams
+back over the wire transport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import Any, Optional, Sequence
+
+log = logging.getLogger("katib_tpu.replica")
+
+
+class ReplicaServer:
+    """One controller replica: controller + wire API + placement manager."""
+
+    def __init__(
+        self,
+        root_dir: str,
+        replica_id: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        devices: Optional[Sequence[Any]] = None,
+        auth_token: Optional[str] = None,
+        config=None,
+        export_rpc_env: bool = True,
+    ):
+        from ..config import load_config
+        from . import placement
+
+        self.config = config if config is not None else load_config()
+        rt = self.config.runtime
+        if rt.replicas <= 0:
+            # a ReplicaServer IS the sharded mode; constructing one implies it
+            rt.replicas = 1
+        self.replica_id = replica_id or placement.replica_id()
+        os.environ[placement.ENV_REPLICA_ID] = self.replica_id
+        self.host = host
+        self.port = rt.rpc_port if port is None else port
+        self.auth_token = auth_token
+        self.export_rpc_env = export_rpc_env
+        self.devices = devices
+        self.root_dir = root_dir
+        self.controller = None
+        self.manager = None
+        self.httpd = None
+
+    def start(self) -> "ReplicaServer":
+        from ..service.httpapi import ENV_RPC_TOKEN, ENV_RPC_URL, serve_api
+        from ..service.rpc import ApiServicer
+        from .experiment import ExperimentController
+        from .placement import ReplicaManager
+
+        self.controller = ExperimentController(
+            root_dir=self.root_dir, devices=self.devices, config=self.config
+        )
+        rt = self.config.runtime
+        servicer = ApiServicer(store=self.controller.obs_store)
+        self.manager = ReplicaManager(
+            self.controller,
+            replica_id=self.replica_id,
+            capacity=rt.replica_capacity,
+            lease_seconds=rt.placement_lease_seconds,
+        )
+        self.httpd = serve_api(
+            servicer,
+            host=self.host,
+            port=self.port,
+            controller=self.controller,
+            replica_manager=self.manager,
+            metrics=self.controller.metrics,
+            auth_token=self.auth_token,
+        )
+        self.manager.rpc_url = self.httpd.base_url
+        if self.export_rpc_env:
+            # subprocess trials inherit this env: their report_metrics pushes
+            # land on THIS replica's DBManager over HTTP (runtime/metrics.py)
+            os.environ[ENV_RPC_URL] = self.httpd.base_url
+            if self.auth_token:
+                os.environ[ENV_RPC_TOKEN] = self.auth_token
+        self.manager.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return self.httpd.base_url if self.httpd is not None else ""
+
+    def stop(self) -> None:
+        if self.manager is not None:
+            self.manager.stop()
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        if self.controller is not None:
+            self.controller.close()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="katib-tpu-replica", description=__doc__.split("\n")[0]
+    )
+    p.add_argument("--root", required=True, help="shared state root")
+    p.add_argument("--replica-id", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=None,
+                   help="rpc port (default runtime.rpc_port; 0 = ephemeral)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="synthetic device slots (0 = probe real devices)")
+    p.add_argument("--token", default=None, help="bearer token for writes")
+    args = p.parse_args(argv)
+
+    devices = list(range(args.devices)) if args.devices > 0 else None
+    server = ReplicaServer(
+        root_dir=args.root,
+        replica_id=args.replica_id,
+        host=args.host,
+        port=args.port,
+        devices=devices,
+        auth_token=args.token,
+    ).start()
+    print(
+        json.dumps(
+            {"replica": server.replica_id, "url": server.url, "pid": os.getpid()}
+        ),
+        flush=True,
+    )
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    done.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

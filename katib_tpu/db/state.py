@@ -146,7 +146,7 @@ class ExperimentStateStore:
             if self.root:
                 d = os.path.join(self.root, "templates")
                 os.makedirs(d, exist_ok=True)
-                tmp = os.path.join(d, name + ".json.tmp")
+                tmp = os.path.join(d, f"{name}.json.tmp{os.getpid()}")
                 with open(tmp, "w") as f:
                     json.dump(template, f, indent=2)
                 os.replace(tmp, os.path.join(d, name + ".json"))
@@ -196,8 +196,12 @@ class ExperimentStateStore:
     def _write_record(path: str, payload: dict) -> None:
         """One atomic record write: a single buffered write of the serialized
         form (json.dump's many tiny stream writes dominate the profile),
-        then rename."""
-        tmp = path + ".tmp"
+        then rename. The tmp name is pid-unique: the placement lease makes
+        each experiment single-writer across replicas, but a failover
+        hand-off can overlap the old incarnation's last write with the new
+        owner's first — colliding staging files must never truncate each
+        other mid-serialize (os.replace keeps the install itself atomic)."""
+        tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(payload))
         os.replace(tmp, path)

@@ -219,18 +219,43 @@ class InMemoryObservationStore(ObservationStore):
 
 
 class SqliteObservationStore(ObservationStore):
-    """SQLite-WAL store; schema mirrors mysql.go observation_logs."""
+    """SQLite-WAL store; schema mirrors mysql.go observation_logs.
 
-    def __init__(self, path: str) -> None:
+    Hardened for CROSS-PROCESS multi-writer access (the sharded control
+    plane: N replica processes + their trial subprocesses share one db
+    file, each with its own connection — the "per-replica connection"
+    topology):
+
+    - ``busy_timeout`` on every connection, so a write that lands while
+      another process holds the WAL write lock parks in SQLite's own busy
+      handler instead of raising ``SQLITE_BUSY`` instantly;
+    - a bounded retry loop (:meth:`_retry`) around every statement batch —
+      a genuinely saturated writer (or a reader holding the file past the
+      busy window) surfaces as a few jittered retries, not an exception
+      thrown through the BufferedObservationStore durability barrier.
+    """
+
+    BUSY_TIMEOUT_MS = 10_000
+    BUSY_RETRIES = 5
+    BUSY_RETRY_SLEEP_S = 0.05
+
+    def __init__(self, path: str, busy_timeout_ms: Optional[int] = None) -> None:
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            path,
+            check_same_thread=False,
+            timeout=(busy_timeout_ms or self.BUSY_TIMEOUT_MS) / 1000.0,
+        )
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                f"PRAGMA busy_timeout={busy_timeout_ms or self.BUSY_TIMEOUT_MS}"
+            )
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS observation_logs ("
                 " trial_name TEXT NOT NULL,"
@@ -263,17 +288,46 @@ class SqliteObservationStore(ObservationStore):
             )
             self._conn.commit()
 
+    def _retry_locked(self, fn):
+        """Run one statement batch, retrying SQLITE_BUSY/locked errors with
+        linear backoff (caller holds ``self._lock``; the contention being
+        absorbed is CROSS-process — another replica's write transaction or
+        an external reader pinning the WAL). Anything else raises through."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.BUSY_RETRIES):
+            try:
+                return fn()
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                last = e
+                try:
+                    self._conn.rollback()
+                except sqlite3.Error:
+                    pass
+                time.sleep(self.BUSY_RETRY_SLEEP_S * (attempt + 1))
+        raise last
+
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
-        with self._lock:
+        rows = [(trial_name, l.timestamp, l.metric_name, l.value) for l in logs]
+
+        def _write():
             self._conn.executemany(
                 "INSERT INTO observation_logs(trial_name, time, metric_name, value) VALUES (?,?,?,?)",
-                [(trial_name, l.timestamp, l.metric_name, l.value) for l in logs],
+                rows,
             )
             self._conn.commit()
 
+        with self._lock:
+            self._retry_locked(_write)
+
     def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
         """Group commit: every trial's rows in ONE explicit transaction —
-        one fsync for the whole drained batch instead of one per report."""
+        one fsync for the whole drained batch instead of one per report.
+        SQLITE_BUSY (a concurrent replica's writer, an external reader)
+        retries the whole transaction rather than raising through the
+        buffered store's durability barrier."""
         rows = [
             (trial_name, l.timestamp, l.metric_name, l.value)
             for trial_name, logs in entries
@@ -281,7 +335,8 @@ class SqliteObservationStore(ObservationStore):
         ]
         if not rows:
             return
-        with self._lock:
+
+        def _write():
             self._conn.execute("BEGIN")
             try:
                 self._conn.executemany(
@@ -293,6 +348,9 @@ class SqliteObservationStore(ObservationStore):
             except BaseException:
                 self._conn.rollback()
                 raise
+
+        with self._lock:
+            self._retry_locked(_write)
 
     def get_observation_log(
         self,
@@ -322,18 +380,26 @@ class SqliteObservationStore(ObservationStore):
         return [MetricLog(timestamp=r[0], metric_name=r[1], value=r[2]) for r in rows]
 
     def delete_observation_log(self, trial_name: str) -> None:
-        with self._lock:
-            self._conn.execute("DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,))
+        def _write():
+            self._conn.execute(
+                "DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,)
+            )
             self._conn.commit()
 
-    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
         with self._lock:
+            self._retry_locked(_write)
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        def _write():
             cur = self._conn.execute(
                 "DELETE FROM observation_logs WHERE trial_name = ? AND time > ?",
                 (trial_name, after_time),
             )
             self._conn.commit()
             return int(cur.rowcount or 0)
+
+        with self._lock:
+            return self._retry_locked(_write)
 
     def replace_experiment_history(self, experiment, signature, points) -> None:
         import json as _json

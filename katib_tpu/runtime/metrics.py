@@ -40,10 +40,16 @@ from ..db.store import MetricLog, ObservationStore, open_store
 DEFAULT_FILTER = r"([\w|-]+)\s*=\s*([+-]?\d*(\.\d+)?([Ee][+-]?\d+)?)"
 
 # env keys used to rebind a subprocess trial to the store (replaces the
-# sidecar + db-manager address plumbing of the reference webhook)
+# sidecar + db-manager address plumbing of the reference webhook). The RPC
+# URL binding (service/httpapi.py) is the out-of-process transport of the
+# sharded control plane: when set it wins over the direct-SQLite path, so a
+# trial on another host pushes metric streams to its owning replica over
+# HTTP with retry/backoff instead of needing the db file mounted.
 ENV_TRIAL_NAME = "KATIB_TPU_TRIAL_NAME"
 ENV_DB_PATH = "KATIB_TPU_DB_PATH"
 ENV_METRICS_FILE = "KATIB_TPU_METRICS_FILE"
+ENV_RPC_URL = "KATIB_TPU_RPC_URL"
+ENV_RPC_TOKEN = "KATIB_TPU_RPC_TOKEN"
 
 
 class EarlyStopped(Exception):
@@ -243,14 +249,36 @@ def _env_bound_store(db_path: str) -> ObservationStore:
         return store
 
 
+def _env_bound_rpc_store(url: str) -> ObservationStore:
+    """One HTTP store per (pid, url) — same caching/atexit shape as the
+    SQLite binding; the client's retry/backoff makes a restarting replica a
+    stall, not a lost report."""
+    from ..service.httpapi import HttpRemoteObservationStore
+
+    key = (os.getpid(), url)
+    with _env_store_lock:
+        store = _env_stores.get(key)
+        if store is None:
+            if not _env_stores:
+                atexit.register(_close_env_stores)
+            store = HttpRemoteObservationStore(
+                url, token=os.environ.get(ENV_RPC_TOKEN) or None
+            )
+            _env_stores[key] = store
+        return store
+
+
 def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> None:
     """SDK push entry point, reference sdk report_metrics.py:24+.
 
-    Works in three bindings:
+    Works in four bindings:
     1. in-process trial: a contextvar reporter was installed by the runtime;
-    2. subprocess trial with env binding: pushes to the cached store handle
+    2. subprocess trial with RPC binding: pushes over HTTP to the owning
+       replica's DBManager ($KATIB_TPU_RPC_URL, service/httpapi.py) — the
+       wire transport of the sharded control plane, preferred when set;
+    3. subprocess trial with env binding: pushes to the cached store handle
        for $KATIB_TPU_DB_PATH (one connection per process, closed at exit);
-    3. bare subprocess: prints ``name=value`` lines for the stdout collector.
+    4. bare subprocess: prints ``name=value`` lines for the stdout collector.
     """
     merged = dict(metrics or {})
     merged.update(kw)
@@ -259,9 +287,10 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
         r.report(**merged)  # MetricsReporter.report validates + normalizes
         return
     trial = os.environ.get(ENV_TRIAL_NAME)
+    rpc_url = os.environ.get(ENV_RPC_URL)
     db = os.environ.get(ENV_DB_PATH)
-    if trial and db:
-        store = _env_bound_store(db)
+    if trial and (rpc_url or db):
+        store = _env_bound_rpc_store(rpc_url) if rpc_url else _env_bound_store(db)
         MetricsReporter(store=store, trial_name=trial).report(**merged)
         # rejoin the controller trace: $KATIB_TPU_TRACEPARENT (issued by the
         # subprocess executor) parents this process's report span onto the

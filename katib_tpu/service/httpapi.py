@@ -1,0 +1,452 @@
+"""HTTP/JSON wire protocol — the sharded control plane's transport.
+
+The gRPC plane (service/rpc.py) already mirrors the reference's
+``api.proto`` method surface as JSON payloads; this module serves the SAME
+:class:`~.rpc.ApiServicer` handlers over plain HTTP/JSON using the
+zero-dependency ThreadingHTTPServer pattern (and bearer-token auth) of
+``ui/server.py``, so a replica needs nothing beyond the standard library to
+expose its Suggestion / EarlyStopping / DBManager services:
+
+    POST /rpc/<Method>                 api.proto method, JSON body -> JSON
+    GET  /replica/status               replica identity + claimed experiments
+    GET  /replica/experiments/<name>   experiment status (owner's live view)
+    POST /replica/experiments          create + claim + run a spec   [auth]
+    GET  /metrics                      Prometheus text exposition
+
+Method names are exactly the :attr:`ApiServicer.METHODS` keys (plus the
+batched ``ReportManyObservationLogs``); each is attributed to its api.proto
+service for the ``katib_rpc_requests_total`` / ``katib_rpc_latency_seconds``
+``{service=}`` series. Every ``/rpc`` call is a POST (even reads — the
+payload is a JSON document, the gRPC convention), authenticated by the same
+bearer token as the replica-plane writes when one is configured.
+
+The client half mirrors the reference suggestion-client retry policy
+(consts/const.go DefaultGRPCRetryAttempts/Period) with exponential backoff:
+connection errors and 5xx are retried, 4xx propagate immediately —
+:class:`HttpApiClient`, :class:`HttpRemoteObservationStore` (with a batched
+``report_many``), and the ``report_metrics`` env binding
+(``KATIB_TPU_RPC_URL`` / ``KATIB_TPU_RPC_TOKEN``, runtime/metrics.py) all
+ride it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Sequence
+from urllib.parse import unquote, urlparse
+
+from ..db.store import MetricLog, ObservationStore
+from .rpc import ApiServicer
+
+log = logging.getLogger("katib_tpu.httpapi")
+
+ENV_RPC_URL = "KATIB_TPU_RPC_URL"
+ENV_RPC_TOKEN = "KATIB_TPU_RPC_TOKEN"
+
+# api.proto service attribution for the {service=} metric labels
+_METHOD_SERVICE: Dict[str, str] = {
+    "GetSuggestions": "Suggestion",
+    "ValidateAlgorithmSettings": "Suggestion",
+    "GetEarlyStoppingRules": "EarlyStopping",
+    "ValidateEarlyStoppingSettings": "EarlyStopping",
+    "SetTrialStatus": "EarlyStopping",
+    "ReportObservationLog": "DBManager",
+    "ReportManyObservationLogs": "DBManager",
+    "GetObservationLog": "DBManager",
+    "GetFoldedObservation": "DBManager",
+    "TruncateObservationLog": "DBManager",
+    "DeleteObservationLog": "DBManager",
+}
+
+
+class RpcError(RuntimeError):
+    """Wire-level failure after retries, or a non-retryable status."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+
+class _ApiHandler(BaseHTTPRequestHandler):
+    servicer: ApiServicer = None        # injected by serve_api
+    controller = None                   # optional: replica-plane endpoints
+    replica_manager = None              # optional: claim/run hooks
+    metrics = None                      # optional MetricsRegistry
+    auth_token: Optional[str] = None    # None disables auth entirely
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, payload: Any, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authorized(self) -> bool:
+        if self.auth_token is None:
+            return True
+        import secrets
+
+        supplied = self.headers.get("X-Katib-Token", "")
+        auth = self.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            supplied = auth[len("Bearer "):]
+        return secrets.compare_digest(
+            supplied.encode("utf-8", "replace"), self.auth_token.encode()
+        )
+
+    def _record(self, service: str, method: str, t0: float, code: int) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.inc(
+            "katib_rpc_requests_total",
+            service=service, method=method, code=str(code),
+        )
+        self.metrics.observe(
+            "katib_rpc_latency_seconds", time.perf_counter() - t0,
+            service=service,
+        )
+
+    # -- /rpc dispatch -------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = unquote(urlparse(self.path).path).rstrip("/")
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length).decode() if length else ""
+            if path.startswith("/rpc/"):
+                return self._rpc(path[len("/rpc/"):], body)
+            if path == "/replica/experiments":
+                return self._create_experiment(body)
+            return self._send({"error": "not found"}, code=404)
+        except Exception as e:  # pragma: no cover - defensive
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+
+    def _rpc(self, method: str, body: str) -> None:
+        t0 = time.perf_counter()
+        service = _METHOD_SERVICE.get(method, "Api")
+        fn = ApiServicer.METHODS.get(method)
+        if fn is None:
+            self._record(service, method, t0, 404)
+            return self._send({"error": f"unknown method {method!r}"}, code=404)
+        if not self._authorized():
+            self._record(service, method, t0, 403)
+            return self._send({"error": "missing or invalid auth token"}, code=403)
+        try:
+            payload = json.loads(body) if body else {}
+            reply = fn(self.servicer, payload)
+        except (ValueError, KeyError) as e:
+            self._record(service, method, t0, 400)
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
+        except Exception as e:
+            self._record(service, method, t0, 500)
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+        self._record(service, method, t0, 200)
+        return self._send(reply)
+
+    # -- replica plane -------------------------------------------------------
+
+    def _create_experiment(self, body: str) -> None:
+        t0 = time.perf_counter()
+        if not self._authorized():
+            self._record("Replica", "CreateExperiment", t0, 403)
+            return self._send({"error": "missing or invalid auth token"}, code=403)
+        ctrl, mgr = self.controller, self.replica_manager
+        if ctrl is None or mgr is None:
+            self._record("Replica", "CreateExperiment", t0, 404)
+            return self._send(
+                {"error": "no controller bound (servicer-only endpoint)"}, code=404
+            )
+        from ..api.spec import experiment_spec_from_mapping, parse_spec_document
+
+        try:
+            payload = parse_spec_document(body)
+            if not isinstance(payload, dict):
+                raise ValueError("spec body must be a JSON or YAML mapping")
+            spec = experiment_spec_from_mapping(payload)
+        except Exception as e:
+            self._record("Replica", "CreateExperiment", t0, 400)
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
+        if not mgr.claim_new(spec.name):
+            # at capacity (or the experiment is already placed elsewhere):
+            # the client router retries against another replica
+            self._record("Replica", "CreateExperiment", t0, 429)
+            return self._send(
+                {"error": f"replica {mgr.replica_id!r} cannot claim "
+                          f"{spec.name!r} (capacity {mgr.capacity})"},
+                code=429,
+            )
+        try:
+            ctrl.create_experiment(spec)
+            mgr.run_experiment(spec.name)
+        except Exception as e:
+            mgr.release(spec.name)
+            self._record("Replica", "CreateExperiment", t0, 400)
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
+        self._record("Replica", "CreateExperiment", t0, 201)
+        return self._send(
+            {"created": spec.name, "replica": mgr.replica_id}, code=201
+        )
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = unquote(urlparse(self.path).path).rstrip("/")
+        try:
+            if path == "/metrics" and self.metrics is not None:
+                body = self.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            mgr = self.replica_manager
+            if path == "/replica/status" and mgr is not None:
+                return self._send(mgr.status())
+            parts = path.split("/")
+            if (
+                len(parts) == 4
+                and parts[1] == "replica"
+                and parts[2] == "experiments"
+                and self.controller is not None
+            ):
+                exp = self.controller.state.get_experiment(parts[3])
+                if exp is None:
+                    return self._send(
+                        {"error": f"experiment {parts[3]!r} not placed here"},
+                        code=404,
+                    )
+                return self._send(exp.to_dict())
+            return self._send({"error": "not found"}, code=404)
+        except Exception as e:  # pragma: no cover - defensive
+            return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+
+
+def serve_api(
+    servicer: ApiServicer,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    controller=None,
+    replica_manager=None,
+    metrics=None,
+    auth_token: Optional[str] = None,
+    block: bool = False,
+) -> ThreadingHTTPServer:
+    """Start the HTTP/JSON api server; returns the ThreadingHTTPServer with
+    ``.bound_port`` and ``.base_url`` set (port=0 lets the OS pick)."""
+    handler = type(
+        "BoundApiHandler",
+        (_ApiHandler,),
+        {
+            "servicer": servicer,
+            "controller": controller,
+            "replica_manager": replica_manager,
+            "metrics": metrics,
+            "auth_token": auth_token,
+        },
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.bound_port = httpd.server_address[1]
+    httpd.base_url = f"http://{host}:{httpd.bound_port}"
+    httpd.auth_token = auth_token
+    if block:
+        httpd.serve_forever()
+    else:
+        t = threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="katib-rpc-http"
+        )
+        t.start()
+    return httpd
+
+
+# -- client ------------------------------------------------------------------
+
+# the reference retries every suggestion-client RPC 10x (rpc.py
+# DEFAULT_RETRY_ATTEMPTS); over HTTP the fixed 3s period becomes a capped
+# exponential backoff so a restarting replica is re-dialed quickly but a
+# dead one doesn't burn 30s per call
+DEFAULT_HTTP_RETRIES = 10
+DEFAULT_BACKOFF_BASE_S = 0.05
+DEFAULT_BACKOFF_CAP_S = 2.0
+
+
+class HttpApiClient:
+    """JSON-over-HTTP client for :func:`serve_api`.
+
+    Retry semantics: connection failures and 5xx responses are retried with
+    exponential backoff (a replica restarting mid-experiment is re-dialed,
+    exactly the UNAVAILABLE policy of the gRPC client); 4xx responses raise
+    :class:`RpcError` immediately (validation errors must not be retried
+    into duplicates — the DBManager receiver is idempotent for the one
+    at-least-once write path, ReportObservationLog)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        timeout: float = 30.0,
+        retries: int = DEFAULT_HTTP_RETRIES,
+        backoff_base: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_cap: float = DEFAULT_BACKOFF_CAP_S,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+
+    def _post(self, path: str, payload: Dict) -> Dict:
+        data = json.dumps(payload).encode()
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            req = urllib.request.Request(
+                self.base_url + path, data=data, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    body = resp.read().decode()
+                    return json.loads(body) if body else {}
+            except urllib.error.HTTPError as e:
+                detail = ""
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except Exception:
+                    pass
+                if e.code < 500:
+                    raise RpcError(
+                        f"{path} -> HTTP {e.code}: {detail}", code=e.code
+                    ) from None
+                last = RpcError(f"{path} -> HTTP {e.code}: {detail}", code=e.code)
+            except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+                last = e
+            if attempt < self.retries - 1:
+                time.sleep(min(self.backoff_base * (2 ** attempt), self.backoff_cap))
+        raise RpcError(
+            f"{path} failed after {self.retries} attempt(s): {last}"
+        ) from last
+
+    def call(self, method: str, payload: Dict) -> Dict:
+        """One api.proto method (an ApiServicer.METHODS key)."""
+        return self._post(f"/rpc/{method}", payload)
+
+    def create_experiment(self, spec_mapping: Dict) -> Dict:
+        """Replica-plane create: the receiving replica claims the placement
+        lease and runs the experiment. 429 (at capacity) raises RpcError
+        with ``code=429`` so the router can try the next replica."""
+        return self._post("/replica/experiments", spec_mapping)
+
+    def experiment_status(self, name: str) -> Optional[Dict]:
+        """The owner's live experiment view, or None when not placed here."""
+        req = urllib.request.Request(
+            f"{self.base_url}/replica/experiments/{name}", method="GET"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise RpcError(f"experiment_status -> HTTP {e.code}", code=e.code) from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+            return None
+
+    def replica_status(self) -> Optional[Dict]:
+        req = urllib.request.Request(f"{self.base_url}/replica/status", method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+            return None
+
+
+class HttpRemoteObservationStore(ObservationStore):
+    """ObservationStore over the HTTP DBManager — what a trial process on
+    another host uses to push metric streams (the ``KATIB_TPU_RPC_URL``
+    binding of report_metrics). ``report_many`` ships a whole group-commit
+    batch as ONE request, so the buffered store's flusher pays one round
+    trip per drained batch instead of one per trial."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        timeout: float = 30.0,
+        retries: int = DEFAULT_HTTP_RETRIES,
+    ):
+        self.client = HttpApiClient(
+            base_url, token=token, timeout=timeout, retries=retries
+        )
+
+    @staticmethod
+    def _rows(logs: Sequence[MetricLog]) -> list:
+        return [
+            {"timestamp": l.timestamp, "metricName": l.metric_name, "value": l.value}
+            for l in logs
+        ]
+
+    def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
+        from ..tracing import current_traceparent
+
+        payload = {"trialName": trial_name, "metricLogs": self._rows(logs)}
+        tp = current_traceparent()
+        if tp:
+            payload["traceparent"] = tp  # rejoined server-side (api servicer)
+        self.client.call("ReportObservationLog", payload)
+
+    def report_many(self, entries: Sequence) -> None:
+        batch = [
+            {"trialName": t, "metricLogs": self._rows(logs)}
+            for t, logs in entries
+            if logs
+        ]
+        if batch:
+            self.client.call("ReportManyObservationLogs", {"entries": batch})
+
+    def get_observation_log(
+        self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None
+    ):
+        out = self.client.call(
+            "GetObservationLog",
+            {
+                "trialName": trial_name,
+                "metricName": metric_name,
+                "startTime": start_time,
+                "endTime": end_time,
+                "limit": limit,
+            },
+        )
+        return [
+            MetricLog(float(l["timestamp"]), l["metricName"], str(l["value"]))
+            for l in out.get("metricLogs", [])
+        ]
+
+    def folded(self, trial_name, metric_names):
+        from ..api.spec import Metric, Observation
+
+        out = self.client.call(
+            "GetFoldedObservation",
+            {"trialName": trial_name, "metricNames": list(metric_names)},
+        )
+        return Observation(metrics=[Metric.from_dict(m) for m in out.get("metrics", [])])
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        out = self.client.call(
+            "TruncateObservationLog",
+            {"trialName": trial_name, "afterTime": after_time},
+        )
+        return int(out.get("dropped", 0))
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        self.client.call("DeleteObservationLog", {"trialName": trial_name})

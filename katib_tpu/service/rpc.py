@@ -166,6 +166,29 @@ class ApiServicer:
             self.store.report_observation_log(trial, fresh)
         return {}
 
+    def report_many_observation_logs(self, payload: Dict) -> Dict:
+        """Batched DBManager write — the group-commit unit over the wire.
+        One request carries many trials' rows (``entries``: a list of
+        ReportObservationLog payloads); each entry keeps the idempotent
+        exact-duplicate drop of the single-trial receiver, so a retried
+        batch after a half-committed crash never double-appends."""
+        for entry in payload.get("entries", []):
+            if payload.get("traceparent") and "traceparent" not in entry:
+                entry = dict(entry, traceparent=payload["traceparent"])
+            self.report_observation_log(entry)
+        return {}
+
+    def truncate_observation_log(self, payload: Dict) -> Dict:
+        """Crash-recovery truncation (controller/recovery.py) over the wire:
+        drop rows strictly newer than ``afterTime`` — a failed-over replica
+        resuming a trial from its checkpoint uses this through the same
+        store interface as the local path."""
+        assert self.store is not None
+        dropped = self.store.truncate_observation_log(
+            payload["trialName"], float(payload["afterTime"])
+        )
+        return {"dropped": int(dropped)}
+
     def get_observation_log(self, payload: Dict) -> Dict:
         assert self.store is not None
         rows = self.store.get_observation_log(
@@ -223,8 +246,10 @@ class ApiServicer:
         "ValidateEarlyStoppingSettings": validate_early_stopping_settings,
         "SetTrialStatus": set_trial_status,
         "ReportObservationLog": report_observation_log,
+        "ReportManyObservationLogs": report_many_observation_logs,
         "GetObservationLog": get_observation_log,
         "GetFoldedObservation": get_folded_observation,
+        "TruncateObservationLog": truncate_observation_log,
         "DeleteObservationLog": delete_observation_log,
     }
 
@@ -413,6 +438,31 @@ class RemoteObservationStore(ObservationStore):
         if tp:
             payload["traceparent"] = tp  # rejoined server-side (api servicer)
         self.client._call("ReportObservationLog", payload)
+
+    def report_many(self, entries) -> None:
+        """Batched push: one RPC per group-commit batch (the
+        BufferedObservationStore flusher's drain unit)."""
+        batch = [
+            {
+                "trialName": t,
+                "metricLogs": [
+                    {"timestamp": l.timestamp, "metricName": l.metric_name,
+                     "value": l.value}
+                    for l in logs
+                ],
+            }
+            for t, logs in entries
+            if logs
+        ]
+        if batch:
+            self.client._call("ReportManyObservationLogs", {"entries": batch})
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        out = self.client._call(
+            "TruncateObservationLog",
+            {"trialName": trial_name, "afterTime": after_time},
+        )
+        return int(out.get("dropped", 0))
 
     def get_observation_log(
         self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None

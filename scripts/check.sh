@@ -56,6 +56,10 @@ echo "== controller-kill chaos smoke (journal-keyed SIGKILLs, lease takeover, ch
 JAX_PLATFORMS=cpu python bench.py controller_kill_recovery --smoke
 
 echo
+echo "== sharded control-plane smoke (replica subprocesses over the wire protocol, mid-run SIGKILL failover) =="
+JAX_PLATFORMS=cpu python bench.py control_plane_scaling --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

@@ -756,6 +756,26 @@ def test_controller_kill_recovery_smoke_integrity(bench):
     assert out["max_replay_seconds"] < out["replay_bound_seconds"] == 10.0
 
 
+def test_control_plane_scaling_smoke_integrity(bench):
+    """--smoke mode of the control_plane_scaling scenario (ISSUE 15): the
+    load harness drives the same experiment batch through 1 and then 2
+    REAL replica subprocesses over the HTTP wire protocol, SIGKILLs one
+    replica mid-run, and the survivors fail its experiments over inside
+    the placement-lease TTL with zero lost observations and rows
+    bit-identical to the fault-free run. The >= 2.5x aggregate-throughput
+    assertion belongs to the full-size (3-replica) run; smoke pins the
+    wiring and the integrity invariants."""
+    out = bench._bench_control_plane_scaling(smoke=True)
+    assert out["smoke"] is True
+    assert out["replicas"] == 2
+    assert out["lost_observations"] == 0
+    assert out["bit_identical"] is True
+    assert out["failovers"] >= 1
+    assert out["victim_experiments"] >= 1
+    assert out["max_failover_seconds"] < out["failover_bound_seconds"]
+    assert out["speedup"] > 0
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""
