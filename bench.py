@@ -2455,7 +2455,12 @@ def _bench_control_plane_scaling(smoke: bool = False):
 
     Scale knobs (the harness is the standing tool for finding the next
     control-plane bottleneck): BENCH_CP_EXPERIMENTS / BENCH_CP_TRIALS /
-    BENCH_CP_EPOCHS / BENCH_CP_DWELL / BENCH_CP_REPLICAS."""
+    BENCH_CP_EPOCHS / BENCH_CP_DWELL / BENCH_CP_REPLICAS. Ambient
+    KATIB_TPU_* env passes through to the replica subprocesses, so
+    `KATIB_TPU_INGEST_FRAMED=1 python bench.py control_plane_scaling` runs
+    every phase — the SIGKILL failover included — on the framed ingest
+    plane (ISSUE 16); the thousands-of-experiments streaming regime has
+    its own dedicated scenario, `ingest_throughput`."""
     import shutil
     import signal as _signal
     import tempfile
@@ -2750,6 +2755,270 @@ def _bench_control_plane_scaling(smoke: bool = False):
         "bit_identical": chaos["scores_by"] == ref["scores_by"],
         "smoke": smoke,
     }
+
+
+def _bench_ingest_throughput(smoke: bool = False):
+    """The thousands-of-concurrent-experiments ingest regime (ISSUE 16):
+    thousands of experiments' streaming trials push observation rows at
+    REAL replica subprocesses sharing one WAL SQLite root, once over the
+    PR 15 HTTP/JSON wire (`ReportObservationLog` per report) and once over
+    the framed ingest plane (service/ingest.py: persistent sockets,
+    struct-packed frames, server-side coalescing into one group commit).
+    Aggregate observation-rows/sec must be >= 5x with framed ingest on at
+    3 replicas (full mode). A final framed phase SIGKILLs one replica
+    mid-stream: streamers reroute to the survivors and resend their
+    unacked batches; the per-entry idempotent duplicate drop must land the
+    full row set exactly once — zero lost observations, every row
+    bit-identical to the deterministic expectation (timestamps compared as
+    raw IEEE-754 doubles, the truncate-to-checkpoint contract).
+
+    Scale knobs: BENCH_ING_EXPERIMENTS / BENCH_ING_TRIALS /
+    BENCH_ING_REPORTS / BENCH_ING_STREAMERS / BENCH_ING_REPLICAS."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from katib_tpu.client.katib_client import ReplicaRouter
+    from katib_tpu.db.store import MetricLog, SqliteObservationStore
+    from katib_tpu.service.httpapi import HttpRemoteObservationStore, RpcError
+    from katib_tpu.service.ingest import FramedObservationStore
+
+    n_exps = int(os.environ.get("BENCH_ING_EXPERIMENTS", "30" if smoke else "2000"))
+    n_trials = int(os.environ.get("BENCH_ING_TRIALS", "1"))
+    n_reports = int(os.environ.get("BENCH_ING_REPORTS", "2" if smoke else "3"))
+    n_streamers = int(os.environ.get("BENCH_ING_STREAMERS", "6" if smoke else "24"))
+    n_replicas = int(os.environ.get("BENCH_ING_REPLICAS", "2" if smoke else "3"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_ts = 1_700_000_000.0  # deterministic: rows must be bit-identical
+
+    def trial_names():
+        return [
+            f"ing-{e:04d}-t{t}" for e in range(n_exps) for t in range(n_trials)
+        ]
+
+    def expected_rows(trial):
+        """The exact (timestamp, metric_name, value) triples this trial
+        reports — what must be in the store afterwards, nothing else."""
+        idx = int(trial[4:8]) * n_trials + int(trial.rsplit("t", 1)[1])
+        x = 0.1 + (idx % 97) * 0.009
+        rows = []
+        for step in range(1, n_reports + 1):
+            ts = base_ts + idx * 1e-3 + step * 1e-6
+            rows.append((ts, "epoch", str(float(step))))
+            rows.append((ts, "score", str(x * (1 - 0.8 ** step))))
+        return rows
+
+    def spawn_replicas(root, framed):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": (repo + os.pathsep + env.get("PYTHONPATH", "")).rstrip(os.pathsep),
+            "KATIB_TPU_REPLICAS": str(n_replicas),
+            "KATIB_TPU_INGEST_FRAMED": "1" if framed else "0",
+            # direct per-batch SQLite commits: every acked row is durable
+            # when the SIGKILL lands (the failover phase's contract)
+            "KATIB_TPU_TELEMETRY": "0",
+            "KATIB_TPU_COMPILE_SERVICE": "0",
+            "KATIB_TPU_TRACING": "0",
+            "KATIB_TPU_OBSLOG_BUFFERED": "0",
+        })
+        env.pop("KATIB_TPU_CHAOS", None)
+        procs, logs = {}, []
+        for i in range(n_replicas):
+            rid = f"r{i}"
+            out = open(os.path.join(root, f"{rid}.log"), "w+")
+            logs.append(out)
+            procs[rid] = subprocess.Popen(
+                [sys.executable, "-m", "katib_tpu.controller.replica",
+                 "--root", root, "--replica-id", rid, "--devices", "2"],
+                env=env, stdout=out, stderr=out, text=True,
+            )
+        return procs, logs
+
+    def endpoints(router, framed, deadline):
+        """[(rpc_url, ingest_addr)] once every replica is registered."""
+        while True:
+            rows = [
+                r for r in router.table()["replicas"]
+                if r.get("alive") and r.get("url")
+                and (not framed or r.get("ingest"))
+            ]
+            if len(rows) >= n_replicas:
+                return [(r["url"], r.get("ingest", "")) for r in rows]
+            if time.time() > deadline:
+                raise TimeoutError("replicas never registered their endpoints")
+            time.sleep(0.2)
+
+    def run_phase(framed, kill=False, phase_timeout=600.0):
+        root = tempfile.mkdtemp(prefix="bench-ing-")
+        deadline = time.time() + phase_timeout
+        procs, logs = spawn_replicas(root, framed)
+        sent = [0]          # rows acked, all streamers (under count_lock)
+        count_lock = threading.Lock()
+        errors = []
+        try:
+            router = ReplicaRouter(root)
+            eps = endpoints(router, framed, deadline)
+
+            def make_store(ep):
+                url, addr = ep
+                if framed:
+                    return FramedObservationStore(addr, base_url=url, retries=3)
+                return HttpRemoteObservationStore(url, retries=3)
+
+            trials = trial_names()
+            shards = [trials[s::n_streamers] for s in range(n_streamers)]
+
+            def stream(shard_idx):
+                """One streamer = the flusher of many trial processes: each
+                report is one at-least-once batch pushed to the trial's home
+                replica, rerouted to a survivor when the home dies."""
+                stores = [None] * len(eps)
+                try:
+                    for trial in shards[shard_idx]:
+                        home = hash(trial) % len(eps)
+                        rows = expected_rows(trial)
+                        for step in range(n_reports):
+                            batch = [
+                                MetricLog(ts, name, value)
+                                for ts, name, value in rows[2 * step: 2 * step + 2]
+                            ]
+                            for attempt in range(len(eps)):
+                                target = (home + attempt) % len(eps)
+                                if stores[target] is None:
+                                    stores[target] = make_store(eps[target])
+                                try:
+                                    stores[target].report_observation_log(trial, batch)
+                                    break
+                                except RpcError:
+                                    if attempt == len(eps) - 1:
+                                        raise  # every replica refused
+                            with count_lock:
+                                sent[0] += len(batch)
+                except BaseException as e:  # surfaced after join
+                    errors.append(f"streamer {shard_idx}: {type(e).__name__}: {e}")
+                finally:
+                    for s in stores:
+                        if s is not None:
+                            try:
+                                s.close()
+                            except Exception:
+                                pass
+
+            # warmup outside the measured window: first-touch SQLite DDL and
+            # one connection per endpoint per protocol
+            warm = make_store(eps[0])
+            warm.report_observation_log(
+                "ing-warmup", [MetricLog(1.0, "warm", "0.0")]
+            )
+            warm.close()
+
+            total_rows = n_exps * n_trials * n_reports * 2
+            t0 = time.time()
+            threads = [
+                threading.Thread(target=stream, args=(s,), daemon=True)
+                for s in range(n_streamers)
+            ]
+            for t in threads:
+                t.start()
+            victim = None
+            if kill:
+                # SIGKILL one replica once the stream is well established;
+                # its unacked batches are resent to the survivors
+                while time.time() < deadline:
+                    with count_lock:
+                        done = sent[0]
+                    if done >= total_rows // 4:
+                        victim = f"r{n_replicas - 1}"
+                        procs[victim].send_signal(_signal.SIGKILL)
+                        procs[victim].wait()
+                        break
+                    time.sleep(0.05)
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.time()))
+                assert not t.is_alive(), f"streamer hung; see {root}/r*.log"
+            wall = time.time() - t0
+            assert not errors, f"streamers failed: {errors[:3]} (see {root}/r*.log)"
+
+            # offline verification against the shared WAL store: the full
+            # deterministic row set, exactly once, bit-identical
+            store = SqliteObservationStore(os.path.join(root, "observations.db"))
+            lost, mismatched = [], []
+            try:
+                for trial in trials:
+                    got = sorted(
+                        (r.timestamp, r.metric_name, r.value)
+                        for r in store.get_observation_log(trial)
+                    )
+                    want = sorted(expected_rows(trial))
+                    if len(got) != len(want):
+                        lost.append((trial, len(got), len(want)))
+                    elif got != want:
+                        mismatched.append(trial)
+            finally:
+                store.close()
+            assert not lost, f"lost/duplicated rows: {lost[:5]}"
+            assert not mismatched, f"rows not bit-identical: {mismatched[:5]}"
+            return {
+                "root": root,
+                "wall": wall,
+                "rows_per_sec": total_rows / wall,
+                "victim": victim,
+            }
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs.values():
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            for out in logs:
+                out.close()
+
+    # phase A: the PR 15 HTTP/JSON wire — the baseline the framed plane
+    # must beat on the SAME workload
+    json_phase = run_phase(framed=False)
+    results = {"json": json_phase}
+    speedup = None
+    if not smoke:
+        # phase B: framed ingest, fault-free — the throughput claim
+        framed_phase = run_phase(framed=True)
+        results["framed"] = framed_phase
+        speedup = framed_phase["rows_per_sec"] / json_phase["rows_per_sec"]
+        assert speedup >= 5.0, (
+            f"framed ingest scaled only {speedup:.2f}x over the JSON wire "
+            f"(>= 5x required): {json_phase['rows_per_sec']:.0f} -> "
+            f"{framed_phase['rows_per_sec']:.0f} rows/s"
+        )
+    # phase C: framed ingest + mid-stream SIGKILL — the zero-loss claim
+    # (row-set verification happens inside run_phase)
+    chaos = run_phase(framed=True, kill=True)
+    results["chaos"] = chaos
+    assert chaos["victim"] is not None, "kill trigger never fired"
+    for phase in results.values():
+        shutil.rmtree(phase["root"], ignore_errors=True)
+    out = {
+        "experiments": n_exps,
+        "trials_per_experiment": n_trials,
+        "reports_per_trial": n_reports,
+        "streamers": n_streamers,
+        "replicas": n_replicas,
+        "rows_per_sec_json": round(json_phase["rows_per_sec"], 1),
+        "rows_per_sec_framed_chaos": round(chaos["rows_per_sec"], 1),
+        "sigkill_victim": chaos["victim"],
+        "lost_observations": 0,
+        "bit_identical": True,
+        "smoke": smoke,
+    }
+    if speedup is not None:
+        out["rows_per_sec_framed"] = round(results["framed"]["rows_per_sec"], 1)
+        out["speedup"] = round(speedup, 3)
+        out["speedup_target"] = 5.0
+    return out
 
 
 def _bench_preemption_latency(jax, np):
@@ -3755,6 +4024,7 @@ OBSLOG_SCENARIOS = {
     "device_chaos_recovery": _bench_device_chaos_recovery,
     "controller_kill_recovery": _bench_controller_kill_recovery,
     "control_plane_scaling": _bench_control_plane_scaling,
+    "ingest_throughput": _bench_ingest_throughput,
 }
 
 

@@ -188,6 +188,22 @@ class RuntimeConfig:
     # many seconds after its last heartbeat (immediately when the holder
     # pid is dead on the same host)
     placement_lease_seconds: float = 10.0
+    # -- framed ingest plane (service/ingest.py, ISSUE 16): when True each
+    # replica opens a sibling binary-framed ingest port for observation
+    # streaming (N trial sockets on one selectors loop, frames coalesced
+    # into one group commit) and exports KATIB_TPU_INGEST_ADDR to trial
+    # subprocesses. False (default) is byte-identical to the PR 15
+    # JSON-only wire.
+    ingest_framed: bool = False
+    # framed ingest port per replica (0 = ephemeral, printed in the replica
+    # ready line and surfaced via the placement registry)
+    ingest_port: int = 0
+    # coalescing window: a drain waits at most this long for more frames
+    # before committing the pending batch (also drains on quiescence or on
+    # reaching ingest_coalesce_rows, whichever comes first)
+    ingest_coalesce_window_seconds: float = 0.005
+    # row-count bound that forces a drain regardless of the window
+    ingest_coalesce_rows: int = 4096
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -240,6 +256,10 @@ ENV_OVERRIDES: Dict[str, str] = {
     "replica_capacity": "KATIB_TPU_REPLICA_CAPACITY",
     "rpc_port": "KATIB_TPU_RPC_PORT",
     "placement_lease_seconds": "KATIB_TPU_PLACEMENT_LEASE_SECONDS",
+    "ingest_framed": "KATIB_TPU_INGEST_FRAMED",
+    "ingest_port": "KATIB_TPU_INGEST_PORT",
+    "ingest_coalesce_window_seconds": "KATIB_TPU_INGEST_COALESCE_WINDOW_SECONDS",
+    "ingest_coalesce_rows": "KATIB_TPU_INGEST_COALESCE_ROWS",
     "device_plane": "KATIB_TPU_DEVICE_PLANE",
     "device_probe_timeout_seconds": "KATIB_TPU_DEVICE_PROBE_TIMEOUT_SECONDS",
     "device_reprobe_interval_seconds": "KATIB_TPU_DEVICE_REPROBE_INTERVAL_SECONDS",

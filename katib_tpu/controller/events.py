@@ -382,6 +382,11 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_rpc_requests_total": "Wire-protocol requests served, by api.proto service, method and status code.",
     "katib_rpc_latency_seconds": "Wire-protocol request latency, by api.proto service.",
     "katib_replica_experiments": "Experiments currently placed on each replica (placement leases held).",
+    # framed ingest plane (ISSUE 16, service/ingest.py) — the binary
+    # observation-streaming sibling of the JSON DBManager wire
+    "katib_ingest_frames_total": "Binary observation DATA frames accepted by the framed ingest plane.",
+    "katib_ingest_batch_rows": "Observation rows landed per coalesced ingest group commit.",
+    "katib_ingest_coalesce_depth": "Frames merged into the most recent coalesced ingest drain.",
 }
 
 

@@ -166,10 +166,16 @@ class ReplicaManager:
         capacity: int = 8,
         lease_seconds: float = 10.0,
         scan_interval: float = 1.0,
+        ingest_addr: str = "",
     ):
         self.controller = controller
         self.replica_id = replica_id
         self.rpc_url = rpc_url
+        # framed ingest address ("host:port", service/ingest.py) when this
+        # replica streams observations on a sibling binary port; "" on the
+        # JSON-only wire — surfaced through the registry and status so
+        # launchers and the placement table can route streams
+        self.ingest_addr = ingest_addr
         self.capacity = max(1, int(capacity))
         self.lease_seconds = max(float(lease_seconds), 1.0)
         self.scan_interval = max(float(scan_interval), 0.1)
@@ -230,6 +236,8 @@ class ReplicaManager:
             "renewed": time.time(),
             "ttl": self.lease_seconds,
         }
+        if self.ingest_addr:
+            payload["ingest"] = self.ingest_addr
         path = self._registration_path()
         tmp = f"{path}.tmp{os.getpid()}"
         try:
@@ -251,7 +259,7 @@ class ReplicaManager:
             return sorted(self._leases)
 
     def status(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replica": self.replica_id,
             "pid": os.getpid(),
             "url": self.rpc_url,
@@ -259,6 +267,9 @@ class ReplicaManager:
             "claimed": self.claimed(),
             "failovers": self.failovers,
         }
+        if self.ingest_addr:
+            out["ingest"] = self.ingest_addr
+        return out
 
     def claim_new(self, experiment: str) -> bool:
         """Claim a freshly-submitted experiment (the HTTP create endpoint).

@@ -570,6 +570,12 @@ def latest_checkpoint_time(base_dir: Optional[str]) -> Optional[float]:
         return None
     for fn in names:
         path = os.path.join(base_dir, fn)
+        if ".tmp" in fn:
+            # atomic-write staging (tmp + os.replace): a crash mid-write
+            # leaves one behind, and its mtime is NOT a durability instant —
+            # counting it would move the cutoff past un-checkpointed rows
+            # and silently disable tail truncation
+            continue
         recognized = (
             (fn.startswith("ckpt_") and fn.endswith(".pkl"))
             or fn.startswith("population_carry")

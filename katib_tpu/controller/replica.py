@@ -64,6 +64,7 @@ class ReplicaServer:
         self.controller = None
         self.manager = None
         self.httpd = None
+        self.ingest = None
 
     def start(self) -> "ReplicaServer":
         from ..service.httpapi import ENV_RPC_TOKEN, ENV_RPC_URL, serve_api
@@ -76,11 +77,27 @@ class ReplicaServer:
         )
         rt = self.config.runtime
         servicer = ApiServicer(store=self.controller.obs_store)
+        if rt.ingest_framed:
+            # the framed ingest plane (ISSUE 16): a sibling binary port for
+            # the hot observation-streaming path; the JSON server below
+            # keeps serving the low-rate control RPCs and reads
+            from ..service.ingest import IngestServer
+
+            self.ingest = IngestServer(
+                self.controller.obs_store,
+                host=self.host,
+                port=rt.ingest_port,
+                auth_token=self.auth_token,
+                metrics=self.controller.metrics,
+                coalesce_window_s=rt.ingest_coalesce_window_seconds,
+                coalesce_rows=rt.ingest_coalesce_rows,
+            )
         self.manager = ReplicaManager(
             self.controller,
             replica_id=self.replica_id,
             capacity=rt.replica_capacity,
             lease_seconds=rt.placement_lease_seconds,
+            ingest_addr=self.ingest.address if self.ingest is not None else "",
         )
         self.httpd = serve_api(
             servicer,
@@ -94,10 +111,16 @@ class ReplicaServer:
         self.manager.rpc_url = self.httpd.base_url
         if self.export_rpc_env:
             # subprocess trials inherit this env: their report_metrics pushes
-            # land on THIS replica's DBManager over HTTP (runtime/metrics.py)
+            # land on THIS replica's DBManager over HTTP (runtime/metrics.py),
+            # or — framed mode — stream binary frames to the ingest port
+            # (writes) while reads stay on the JSON url
             os.environ[ENV_RPC_URL] = self.httpd.base_url
             if self.auth_token:
                 os.environ[ENV_RPC_TOKEN] = self.auth_token
+            if self.ingest is not None:
+                from ..service.ingest import ENV_INGEST_ADDR
+
+                os.environ[ENV_INGEST_ADDR] = self.ingest.address
         self.manager.start()
         return self
 
@@ -105,9 +128,15 @@ class ReplicaServer:
     def url(self) -> str:
         return self.httpd.base_url if self.httpd is not None else ""
 
+    @property
+    def ingest_addr(self) -> str:
+        return self.ingest.address if self.ingest is not None else ""
+
     def stop(self) -> None:
         if self.manager is not None:
             self.manager.stop()
+        if self.ingest is not None:
+            self.ingest.close()
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()
@@ -138,12 +167,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         devices=devices,
         auth_token=args.token,
     ).start()
-    print(
-        json.dumps(
-            {"replica": server.replica_id, "url": server.url, "pid": os.getpid()}
-        ),
-        flush=True,
-    )
+    ready = {"replica": server.replica_id, "url": server.url, "pid": os.getpid()}
+    if server.ingest_addr:
+        ready["ingest"] = server.ingest_addr
+    print(json.dumps(ready), flush=True)
     done = threading.Event()
 
     def _stop(signum, frame):

@@ -50,6 +50,11 @@ ENV_DB_PATH = "KATIB_TPU_DB_PATH"
 ENV_METRICS_FILE = "KATIB_TPU_METRICS_FILE"
 ENV_RPC_URL = "KATIB_TPU_RPC_URL"
 ENV_RPC_TOKEN = "KATIB_TPU_RPC_TOKEN"
+# framed ingest binding (service/ingest.py): "host:port" of the owning
+# replica's binary ingest plane. Wins over the RPC URL for WRITES (one
+# persistent socket, struct-packed frames, server-side coalescing); reads
+# still ride the JSON url. Exported by a replica running ingest_framed.
+ENV_INGEST_ADDR = "KATIB_TPU_INGEST_ADDR"
 
 
 class EarlyStopped(Exception):
@@ -268,17 +273,42 @@ def _env_bound_rpc_store(url: str) -> ObservationStore:
         return store
 
 
+def _env_bound_ingest_store(addr: str, base_url: Optional[str]) -> ObservationStore:
+    """One framed store per (pid, addr): writes stream binary frames over a
+    persistent socket to the replica's ingest plane; reads fall back to the
+    JSON url when one is bound. Same caching/atexit shape as the other
+    bindings — the pid key keeps a fork()ed child off its parent's socket."""
+    from ..service.ingest import FramedObservationStore
+
+    key = (os.getpid(), addr)
+    with _env_store_lock:
+        store = _env_stores.get(key)
+        if store is None:
+            if not _env_stores:
+                atexit.register(_close_env_stores)
+            store = FramedObservationStore(
+                addr, base_url=base_url,
+                token=os.environ.get(ENV_RPC_TOKEN) or None,
+            )
+            _env_stores[key] = store
+        return store
+
+
 def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> None:
     """SDK push entry point, reference sdk report_metrics.py:24+.
 
-    Works in four bindings:
+    Works in five bindings (most-specific wins):
     1. in-process trial: a contextvar reporter was installed by the runtime;
-    2. subprocess trial with RPC binding: pushes over HTTP to the owning
+    2. subprocess trial with framed-ingest binding: streams binary frames
+       over one persistent socket to the owning replica's ingest plane
+       ($KATIB_TPU_INGEST_ADDR, service/ingest.py) — the hot path of the
+       high-throughput ingest plane;
+    3. subprocess trial with RPC binding: pushes over HTTP to the owning
        replica's DBManager ($KATIB_TPU_RPC_URL, service/httpapi.py) — the
-       wire transport of the sharded control plane, preferred when set;
-    3. subprocess trial with env binding: pushes to the cached store handle
+       wire transport of the sharded control plane;
+    4. subprocess trial with env binding: pushes to the cached store handle
        for $KATIB_TPU_DB_PATH (one connection per process, closed at exit);
-    4. bare subprocess: prints ``name=value`` lines for the stdout collector.
+    5. bare subprocess: prints ``name=value`` lines for the stdout collector.
     """
     merged = dict(metrics or {})
     merged.update(kw)
@@ -287,10 +317,16 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
         r.report(**merged)  # MetricsReporter.report validates + normalizes
         return
     trial = os.environ.get(ENV_TRIAL_NAME)
+    ingest_addr = os.environ.get(ENV_INGEST_ADDR)
     rpc_url = os.environ.get(ENV_RPC_URL)
     db = os.environ.get(ENV_DB_PATH)
-    if trial and (rpc_url or db):
-        store = _env_bound_rpc_store(rpc_url) if rpc_url else _env_bound_store(db)
+    if trial and (ingest_addr or rpc_url or db):
+        if ingest_addr:
+            store = _env_bound_ingest_store(ingest_addr, rpc_url or None)
+        elif rpc_url:
+            store = _env_bound_rpc_store(rpc_url)
+        else:
+            store = _env_bound_store(db)
         MetricsReporter(store=store, trial_name=trial).report(**merged)
         # rejoin the controller trace: $KATIB_TPU_TRACEPARENT (issued by the
         # subprocess executor) parents this process's report span onto the

@@ -670,7 +670,14 @@ def save_sweep_checkpoint(
     exact stream), how many generations have completed on-device, the
     not-yet-demuxed summaries of the interrupted chunk and how many of its
     generations already reached the obslog. tmp + ``os.replace`` — a crash
-    mid-write leaves the previous checkpoint intact."""
+    mid-write leaves the previous checkpoint intact.
+
+    The meta rides INSIDE the npz (``__meta__``) so carry+meta commit in
+    ONE replace: a SIGKILL between two separate file replaces used to
+    leave a torn pair (new carry arrays, stale generation counter) and the
+    resumed sweep double-reported the stale tail. The json file is still
+    written afterwards, but purely as a mirror for watchers/humans —
+    loads treat the embedded copy as authoritative."""
     import jax
 
     os.makedirs(directory, exist_ok=True)
@@ -679,19 +686,26 @@ def save_sweep_checkpoint(
     if pending_ys:
         for k2, v in pending_ys.items():
             arrays[f"y_{k2}"] = np.asarray(v)
-    path = os.path.join(directory, CARRY_FILE)
-    tmp = path + ".tmp.npz"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-    os.replace(tmp, path)
     meta = {
         "generationDone": int(generation_done),
         "reported": int(reported),
         "pendingKeys": sorted(pending_ys) if pending_ys else [],
         "leaves": len(leaves),
     }
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+    )
+    # staging names are dot-prefixed so recovery's checkpoint-instant scan
+    # (latest_checkpoint_time matches population_carry*) can never mistake
+    # a torn half-written tmp for a durable carry — a SIGKILL mid-savez
+    # used to leave a too-new tmp that silently disabled tail truncation
+    path = os.path.join(directory, CARRY_FILE)
+    tmp = os.path.join(directory, "." + CARRY_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
     mpath = os.path.join(directory, CARRY_META_FILE)
-    mtmp = mpath + ".tmp"
+    mtmp = os.path.join(directory, "." + CARRY_META_FILE + ".tmp")
     with open(mtmp, "w") as f:
         json.dump(meta, f)
     os.replace(mtmp, mpath)
@@ -707,12 +721,16 @@ def load_sweep_checkpoint(directory: Optional[str], program: PopulationProgram):
         return None
     path = os.path.join(directory, CARRY_FILE)
     mpath = os.path.join(directory, CARRY_META_FILE)
-    if not (os.path.exists(path) and os.path.exists(mpath)):
+    if not os.path.exists(path):
         return None
     try:
-        with open(mpath) as f:
-            meta = json.load(f)
         with np.load(path) as data:
+            if "__meta__" in data.files:
+                meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+            else:
+                # pre-embedded-meta checkpoint: the sidecar json is all there is
+                with open(mpath) as f:
+                    meta = json.load(f)
             template = program.init_carry(program.seed)
             t_leaves, treedef = jax.tree_util.tree_flatten(template)
             if meta.get("leaves") != len(t_leaves):

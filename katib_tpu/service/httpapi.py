@@ -31,14 +31,17 @@ ride it.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import os
+import socket
 import threading
 import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import unquote, urlparse
 
 from ..db.store import MetricLog, ObservationStore
@@ -79,6 +82,13 @@ class _ApiHandler(BaseHTTPRequestHandler):
     replica_manager = None              # optional: claim/run hooks
     metrics = None                      # optional MetricsRegistry
     auth_token: Optional[str] = None    # None disables auth entirely
+
+    # HTTP/1.1 => persistent connections: a trial process's pooled client
+    # reuses one socket per replica instead of paying a TCP handshake per
+    # group-commit batch. _send always sets Content-Length, which keep-alive
+    # requires; idle connections are reaped by the handler timeout.
+    protocol_version = "HTTP/1.1"
+    timeout = 60.0
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -230,6 +240,47 @@ class _ApiHandler(BaseHTTPRequestHandler):
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
 
 
+class _KeepAliveHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that force-closes accepted keep-alive sockets on
+    ``server_close()``. Stock ThreadingHTTPServer only closes the LISTEN
+    socket, so with HTTP/1.1 persistent connections a logically-stopped
+    server would keep answering pooled clients through its still-open
+    handler threads — a restarted replica on the same port must not share
+    the wire with its corpse."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._live_requests = set()
+        self._live_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._live_lock:
+            self._live_requests.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live_requests.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        with self._live_lock:
+            live = list(self._live_requests)
+            self._live_requests.clear()
+        for sock in live:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 def serve_api(
     servicer: ApiServicer,
     host: str = "127.0.0.1",
@@ -253,7 +304,7 @@ def serve_api(
             "auth_token": auth_token,
         },
     )
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = _KeepAliveHTTPServer((host, port), handler)
     httpd.bound_port = httpd.server_address[1]
     httpd.base_url = f"http://{host}:{httpd.bound_port}"
     httpd.auth_token = auth_token
@@ -276,6 +327,33 @@ def serve_api(
 DEFAULT_HTTP_RETRIES = 10
 DEFAULT_BACKOFF_BASE_S = 0.05
 DEFAULT_BACKOFF_CAP_S = 2.0
+
+# -- persistent-connection pool ----------------------------------------------
+# One idle-connection pool per (pid, netloc): HTTP/1.1 keep-alive lets a
+# trial process reuse a socket across group-commit batches instead of paying
+# TCP setup per request. Keyed by pid so a fork()ed child never inherits (and
+# corrupts) its parent's sockets; capped so dozens of streamer threads don't
+# hoard file descriptors.
+_POOL_MAX_IDLE = 32
+_POOL: Dict[Tuple[int, str], List[http.client.HTTPConnection]] = {}
+_POOL_LOCK = threading.Lock()
+
+
+def _pool_get(netloc: str) -> Optional[http.client.HTTPConnection]:
+    with _POOL_LOCK:
+        conns = _POOL.get((os.getpid(), netloc))
+        if conns:
+            return conns.pop()
+    return None
+
+
+def _pool_put(netloc: str, conn: http.client.HTTPConnection) -> None:
+    with _POOL_LOCK:
+        conns = _POOL.setdefault((os.getpid(), netloc), [])
+        if len(conns) < _POOL_MAX_IDLE:
+            conns.append(conn)
+            return
+    conn.close()  # pool full: don't hoard fds
 
 
 class HttpApiClient:
@@ -303,34 +381,69 @@ class HttpApiClient:
         self.retries = max(1, int(retries))
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        parsed = urlparse(self.base_url)
+        self._netloc = parsed.netloc
+        self._path_prefix = parsed.path.rstrip("/")
+
+    @staticmethod
+    def _error_detail(raw: bytes) -> str:
+        """The server's {"error": ...} field when the body is our JSON
+        envelope, the raw body text otherwise — a proxy's HTML 502 page or a
+        bare traceback must surface, not a JSONDecodeError masking it."""
+        if not raw:
+            return ""
+        try:
+            detail = json.loads(raw.decode())
+            if isinstance(detail, dict) and "error" in detail:
+                return str(detail["error"])
+        except Exception:
+            pass
+        return raw.decode("utf-8", "replace").strip()
 
     def _post(self, path: str, payload: Dict) -> Dict:
         data = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         last: Optional[BaseException] = None
         for attempt in range(self.retries):
-            req = urllib.request.Request(
-                self.base_url + path, data=data, method="POST",
-                headers={"Content-Type": "application/json"},
-            )
-            if self.token:
-                req.add_header("Authorization", f"Bearer {self.token}")
+            conn = _pool_get(self._netloc)
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    self._netloc, timeout=self.timeout
+                )
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    body = resp.read().decode()
-                    return json.loads(body) if body else {}
-            except urllib.error.HTTPError as e:
-                detail = ""
-                try:
-                    detail = json.loads(e.read().decode()).get("error", "")
-                except Exception:
-                    pass
-                if e.code < 500:
-                    raise RpcError(
-                        f"{path} -> HTTP {e.code}: {detail}", code=e.code
-                    ) from None
-                last = RpcError(f"{path} -> HTTP {e.code}: {detail}", code=e.code)
-            except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+                conn.request("POST", self._path_prefix + path, body=data,
+                             headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                reusable = not resp.will_close
+            except (http.client.HTTPException, ConnectionError,
+                    TimeoutError, OSError) as e:
+                conn.close()
                 last = e
+                # a pooled socket may have been reaped by the server's idle
+                # timeout; its loss is expected — redial before backing off
+                if not fresh:
+                    continue
+            else:
+                if reusable:
+                    _pool_put(self._netloc, conn)
+                else:
+                    conn.close()
+                if resp.status < 400:
+                    body = raw.decode()
+                    return json.loads(body) if body else {}
+                detail = self._error_detail(raw)
+                if resp.status < 500:
+                    raise RpcError(
+                        f"{path} -> HTTP {resp.status}: {detail}",
+                        code=resp.status,
+                    ) from None
+                last = RpcError(
+                    f"{path} -> HTTP {resp.status}: {detail}", code=resp.status
+                )
             if attempt < self.retries - 1:
                 time.sleep(min(self.backoff_base * (2 ** attempt), self.backoff_cap))
         raise RpcError(

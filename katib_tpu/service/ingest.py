@@ -1,0 +1,722 @@
+"""High-throughput ingest plane — binary-framed observation streaming.
+
+The JSON wire (service/httpapi.py) pays a full HTTP parse and a JSON
+codec round trip on every ``ReportManyObservationLogs`` — the hottest RPC
+in the system: one call per group-commit batch, from every trial process,
+on every flush. Upstream Katib fronts exactly this path with a dedicated
+DB-manager service (PAPER.md §1); the Podracer decoupling pattern
+(arXiv:2104.06272) that already shapes ``BufferedObservationStore`` argues
+the same for the wire: producers enqueue cheap frames, one drainer owns
+the expensive work. This module is that plane, three layers:
+
+- **codec** — a length-prefixed binary frame format (``KF`` magic,
+  versioned) for observation batches: struct-packed header, compact row
+  encoding with IEEE-754 timestamps shipped bit-exactly (``!d`` — the
+  truncate-to-checkpoint recovery rule compares these floats, so the wire
+  must never round them). Truncated/torn/oversized frames are rejected
+  loudly (:class:`FrameError`), never half-applied.
+- **server** — :class:`IngestServer`: a ``selectors``-based (stdlib,
+  zero-dependency) event loop serving persistent connections on a sibling
+  ingest port, so N trial processes streaming metrics cost N *sockets*,
+  not N threads. Frames from many connections are **coalesced** into one
+  ``store.report_many`` group commit per drain window; each entry keeps
+  the JSON receiver's idempotent exact-duplicate drop, so at-least-once
+  delivery stays effectively-once across client reconnects. ACKs are sent
+  only after the batch was handed to the store — the same durability
+  point as the JSON path's 200.
+- **client** — :class:`FramedIngestClient` / :class:`FramedObservationStore`:
+  one pooled persistent socket per store, capped-backoff reconnect (the
+  HttpApiClient retry policy), and resend-on-reconnect of the unacked
+  frame. Reads and the rare control RPCs stay on the JSON plane.
+
+Everything is gated by ``runtime.ingest_framed``
+(``KATIB_TPU_INGEST_FRAMED``): off (the default), no ingest server is
+constructed, no env is exported, and the wire is byte-identical to the
+PR 15 JSON path (asserted by tests/test_ingest_plane.py's seeded
+on-vs-off sweep).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..db.store import MetricLog, ObservationStore
+
+log = logging.getLogger("katib_tpu.ingest")
+
+# env binding exported by a replica running with framed ingest on: trial
+# subprocesses stream observation batches here instead of POSTing JSON
+# (runtime/metrics.py resolves precedence: ingest > rpc url > db path)
+ENV_INGEST_ADDR = "KATIB_TPU_INGEST_ADDR"
+
+# -- frame format ------------------------------------------------------------
+#
+#   header (8 bytes):  !2sBBI  = magic "KF", version, frame type, payload len
+#   HELLO   payload:   token utf-8 (may be empty)
+#   DATA    payload:   !QI seq, n_entries, then per entry:
+#                        !HI trial_len, n_rows + trial utf-8
+#                        per row: !dHH timestamp, name_len, value_len
+#                                 + name utf-8 + value utf-8
+#   ACK     payload:   !Q  cumulative seq: every frame <= seq is in the store
+#   ERR     payload:   !B  code + message utf-8
+#                      code 1 = auth rejected   (client must not retry)
+#                      code 2 = malformed frame (client must not retry)
+#                      code 3 = store write failed (client reconnects+resends)
+#
+# The magic is versioned so JSON and framed clients can interoperate on one
+# port if a future revision multiplexes them: a JSON POST starts "PO", never
+# "KF", so the first two bytes of a connection identify the protocol.
+
+MAGIC = b"KF"
+VERSION = 1
+F_HELLO, F_DATA, F_ACK, F_ERR = 1, 2, 3, 4
+ERR_AUTH, ERR_FRAME, ERR_WRITE = 1, 2, 3
+
+_HEADER = struct.Struct("!2sBBI")
+_DATA_HEAD = struct.Struct("!QI")
+_ENTRY_HEAD = struct.Struct("!HI")
+_ROW_HEAD = struct.Struct("!dHH")
+_SEQ = struct.Struct("!Q")
+
+MAX_FRAME_BYTES = 8 * 1024 * 1024  # one group-commit batch, bounded
+
+
+class FrameError(ValueError):
+    """A torn, truncated, oversized or non-protocol frame. Always loud:
+    the receiver closes the connection rather than guessing at row
+    boundaries — the client's unacked frame is resent on reconnect."""
+
+
+def _frame(ftype: int, payload: bytes) -> bytes:
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(
+            f"frame payload {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte bound — split the batch"
+        )
+    return _HEADER.pack(MAGIC, VERSION, ftype, len(payload)) + payload
+
+
+def encode_hello(token: str = "") -> bytes:
+    return _frame(F_HELLO, token.encode("utf-8"))
+
+
+def encode_ack(seq: int) -> bytes:
+    return _frame(F_ACK, _SEQ.pack(seq))
+
+
+def encode_err(code: int, message: str) -> bytes:
+    return _frame(F_ERR, bytes([code]) + message.encode("utf-8", "replace"))
+
+
+def encode_data_frame(
+    entries: Sequence[Tuple[str, Sequence[MetricLog]]], seq: int
+) -> bytes:
+    """One observation batch -> one DATA frame. Timestamps travel as raw
+    IEEE-754 doubles (bit-exact, NaN payloads and -0.0 included)."""
+    parts = [_DATA_HEAD.pack(seq, len(entries))]
+    for trial_name, logs in entries:
+        t = trial_name.encode("utf-8")
+        if len(t) > 0xFFFF:
+            raise FrameError(f"trial name {trial_name[:40]!r}... too long")
+        parts.append(_ENTRY_HEAD.pack(len(t), len(logs)))
+        parts.append(t)
+        for row in logs:
+            n = row.metric_name.encode("utf-8")
+            v = row.value.encode("utf-8")
+            if len(n) > 0xFFFF or len(v) > 0xFFFF:
+                raise FrameError(
+                    f"metric name/value too long in trial {trial_name!r}"
+                )
+            parts.append(_ROW_HEAD.pack(row.timestamp, len(n), len(v)))
+            parts.append(n)
+            parts.append(v)
+    return _frame(F_DATA, b"".join(parts))
+
+
+def decode_data_payload(
+    payload: bytes,
+) -> Tuple[int, List[Tuple[str, List[MetricLog]]]]:
+    """Strict inverse of :func:`encode_data_frame`; any overrun or leftover
+    bytes raises :class:`FrameError` (a torn frame must never land rows)."""
+    view = memoryview(payload)
+    off = 0
+
+    def take(n: int) -> memoryview:
+        nonlocal off
+        if off + n > len(view):
+            raise FrameError(
+                f"torn data frame: needed {n} bytes at offset {off}, "
+                f"payload is {len(view)} bytes"
+            )
+        chunk = view[off:off + n]
+        off += n
+        return chunk
+
+    seq, n_entries = _DATA_HEAD.unpack(take(_DATA_HEAD.size))
+    entries: List[Tuple[str, List[MetricLog]]] = []
+    for _ in range(n_entries):
+        t_len, n_rows = _ENTRY_HEAD.unpack(take(_ENTRY_HEAD.size))
+        trial_name = str(take(t_len), "utf-8")
+        rows: List[MetricLog] = []
+        for _ in range(n_rows):
+            ts, n_len, v_len = _ROW_HEAD.unpack(take(_ROW_HEAD.size))
+            name = str(take(n_len), "utf-8")
+            value = str(take(v_len), "utf-8")
+            rows.append(MetricLog(timestamp=ts, metric_name=name, value=value))
+        entries.append((trial_name, rows))
+    if off != len(view):
+        raise FrameError(
+            f"torn data frame: {len(view) - off} trailing bytes after "
+            f"{n_entries} entries"
+        )
+    return seq, entries
+
+
+def frames_from_buffer(buf: bytearray):
+    """Yield complete ``(ftype, payload)`` frames from ``buf``, consuming
+    them. Stops at an incomplete tail (more bytes pending); raises
+    :class:`FrameError` on a non-protocol or oversized header."""
+    while len(buf) >= _HEADER.size:
+        magic, version, ftype, length = _HEADER.unpack_from(buf, 0)
+        if magic != MAGIC:
+            raise FrameError(f"bad magic {bytes(magic)!r} (not a KF frame)")
+        if version != VERSION:
+            raise FrameError(f"unsupported frame version {version}")
+        if length > MAX_FRAME_BYTES:
+            raise FrameError(
+                f"declared payload {length} bytes exceeds the "
+                f"{MAX_FRAME_BYTES}-byte bound"
+            )
+        if len(buf) < _HEADER.size + length:
+            return  # incomplete: wait for more bytes
+        payload = bytes(buf[_HEADER.size:_HEADER.size + length])
+        del buf[:_HEADER.size + length]
+        yield ftype, payload
+
+
+# -- server ------------------------------------------------------------------
+
+
+class _Conn:
+    __slots__ = ("sock", "rbuf", "wbuf", "authed", "peer", "closing")
+
+    def __init__(self, sock: socket.socket, peer) -> None:
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.wbuf = bytearray()
+        self.authed = False
+        self.peer = peer
+        self.closing = False  # flush wbuf, then close
+
+
+class IngestServer:
+    """Event-loop listener for framed observation streaming.
+
+    One thread runs the ``selectors`` loop: accepts persistent
+    connections, parses frames, and coalesces DATA frames from MANY
+    connections into one ``store.report_many`` group commit per drain.
+    The drain fires when the coalesce window elapses, the pending batch
+    reaches ``coalesce_rows``, or the loop goes quiescent (no more
+    readable sockets — every sync client is waiting on its ACK, so
+    waiting out the window would only add latency).
+
+    Delivery contract (mirrors the JSON ``ReportManyObservationLogs``
+    receiver): per-entry idempotent exact-duplicate drop against the
+    store, ACK only after ``report_many`` returned — a client that never
+    saw the ACK resends the identical frame and the dedup makes it a
+    no-op. A store write failure ERRs (code 3) every contributing
+    connection instead of acking, so no row is silently dropped.
+    """
+
+    def __init__(
+        self,
+        store: ObservationStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        auth_token: Optional[str] = None,
+        metrics=None,
+        coalesce_window_s: float = 0.005,
+        coalesce_rows: int = 4096,
+    ) -> None:
+        self.store = store
+        self.auth_token = auth_token
+        self.metrics = metrics
+        self.coalesce_window_s = max(0.0, float(coalesce_window_s))
+        self.coalesce_rows = max(1, int(coalesce_rows))
+        self._lsock = socket.create_server((host, port))
+        self._lsock.setblocking(False)
+        self.bound_port = self._lsock.getsockname()[1]
+        self.host = host
+        self.address = f"{host}:{self.bound_port}"
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._lsock, selectors.EVENT_READ, "accept")
+        # self-pipe: close() wakes the loop out of select immediately
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._pending: List[Tuple[_Conn, int, List[Tuple[str, List[MetricLog]]], int]] = []
+        self._pending_rows = 0
+        self._pending_since: Optional[float] = None
+        self._closed = False
+        self.stats = {"frames_total": 0, "drains_total": 0, "rows_total": 0}
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="katib-ingest-loop"
+        )
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+        try:
+            self._wake_w.close()
+        except OSError:
+            pass
+
+    # -- event loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        try:
+            while not self._closed:
+                if self._pending_since is not None:
+                    elapsed = time.monotonic() - self._pending_since
+                    timeout = max(0.0, self.coalesce_window_s - elapsed)
+                else:
+                    timeout = 0.5
+                events = self._sel.select(timeout)
+                if self._closed:
+                    break
+                for key, mask in events:
+                    if key.data == "accept":
+                        self._accept()
+                    elif key.data == "wake":
+                        try:
+                            self._wake_r.recv(64)
+                        except OSError:
+                            pass
+                    else:
+                        conn = key.data
+                        if mask & selectors.EVENT_READ:
+                            self._readable(conn)
+                        if mask & selectors.EVENT_WRITE:
+                            self._writable(conn)
+                if self._pending and (
+                    self._pending_rows >= self.coalesce_rows
+                    or time.monotonic() - self._pending_since >= self.coalesce_window_s
+                    or not self._sel.select(0)  # quiescent: every client is
+                    # blocked on its ACK; draining now costs nothing
+                ):
+                    self._drain()
+        finally:
+            for key in list(self._sel.get_map().values()):
+                if isinstance(key.data, _Conn):
+                    self._close_conn(key.data)
+            self._sel.unregister(self._lsock)
+            self._lsock.close()
+            try:
+                self._sel.unregister(self._wake_r)
+            except KeyError:
+                pass
+            self._wake_r.close()
+            self._sel.close()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, peer = self._lsock.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock, peer)
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _interest(self, conn: _Conn) -> None:
+        mask = selectors.EVENT_READ
+        if conn.wbuf:
+            mask |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, mask, conn)
+        except (KeyError, ValueError):
+            pass
+
+    def _send(self, conn: _Conn, data: bytes) -> None:
+        conn.wbuf += data
+        self._writable(conn)
+
+    def _writable(self, conn: _Conn) -> None:
+        try:
+            while conn.wbuf:
+                sent = conn.sock.send(conn.wbuf)
+                if sent <= 0:
+                    break
+                del conn.wbuf[:sent]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_conn(conn)
+            return
+        if conn.closing and not conn.wbuf:
+            self._close_conn(conn)
+            return
+        self._interest(conn)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        # a partially-read frame dies with the connection; the client never
+        # saw an ACK for it and resends on reconnect (dedup absorbs overlap)
+        self._pending = [p for p in self._pending if p[0] is not conn]
+        self._pending_rows = sum(p[3] for p in self._pending)
+        if not self._pending:
+            self._pending_since = None
+
+    def _readable(self, conn: _Conn) -> None:
+        try:
+            while True:
+                chunk = conn.sock.recv(262144)
+                if not chunk:
+                    self._close_conn(conn)
+                    return
+                conn.rbuf += chunk
+                if len(chunk) < 262144:
+                    break
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_conn(conn)
+            return
+        try:
+            for ftype, payload in frames_from_buffer(conn.rbuf):
+                self._frame(conn, ftype, payload)
+        except FrameError as e:
+            log.warning("ingest: rejecting %s from %s", e, conn.peer)
+            conn.closing = True
+            self._send(conn, encode_err(ERR_FRAME, str(e)))
+
+    def _frame(self, conn: _Conn, ftype: int, payload: bytes) -> None:
+        if ftype == F_HELLO:
+            if self.auth_token is not None:
+                import secrets
+
+                if not secrets.compare_digest(payload, self.auth_token.encode()):
+                    conn.closing = True
+                    self._send(
+                        conn, encode_err(ERR_AUTH, "missing or invalid auth token")
+                    )
+                    return
+            conn.authed = True
+            self._send(conn, encode_ack(0))
+            return
+        if ftype == F_DATA:
+            if self.auth_token is not None and not conn.authed:
+                conn.closing = True
+                self._send(conn, encode_err(ERR_AUTH, "HELLO with token required"))
+                return
+            seq, entries = decode_data_payload(payload)
+            n_rows = sum(len(rows) for _, rows in entries)
+            self._pending.append((conn, seq, entries, n_rows))
+            self._pending_rows += n_rows
+            if self._pending_since is None:
+                self._pending_since = time.monotonic()
+            self.stats["frames_total"] += 1
+            if self.metrics is not None:
+                self.metrics.inc("katib_ingest_frames_total")
+            return
+        raise FrameError(f"unexpected frame type {ftype} from a client")
+
+    # -- coalesced drain -----------------------------------------------------
+
+    def _drain(self) -> None:
+        batch, self._pending = self._pending, []
+        rows_in = self._pending_rows
+        self._pending_rows = 0
+        self._pending_since = None
+        # merge all frames' entries per trial, preserving arrival order
+        by_trial: Dict[str, List[MetricLog]] = {}
+        for _, _, entries, _ in batch:
+            for trial_name, rows in entries:
+                by_trial.setdefault(trial_name, []).extend(rows)
+        fresh_entries: List[Tuple[str, List[MetricLog]]] = []
+        err: Optional[BaseException] = None
+        try:
+            for trial_name, rows in by_trial.items():
+                fresh = self._dedup(trial_name, rows)
+                if fresh:
+                    fresh_entries.append((trial_name, fresh))
+            if fresh_entries:
+                self.store.report_many(fresh_entries)
+        except BaseException as e:  # surface to every contributor, stay up
+            err = e
+            log.error("ingest: coalesced group commit failed: %s", e)
+        # stats/metrics BEFORE the acks go out: a client acts on its ACK
+        # immediately (scrapes /metrics, asserts in tests) and must observe
+        # this drain already counted
+        if err is None:
+            self.stats["drains_total"] += 1
+            self.stats["rows_total"] += rows_in
+            if self.metrics is not None:
+                self.metrics.inc("katib_ingest_batch_rows", value=float(rows_in))
+                self.metrics.set_gauge(
+                    "katib_ingest_coalesce_depth", float(len(batch))
+                )
+        acks: Dict[_Conn, int] = {}
+        for conn, seq, _, _ in batch:
+            acks[conn] = max(acks.get(conn, 0), seq)
+        for conn, seq in acks.items():
+            if err is not None:
+                conn.closing = True
+                self._send(conn, encode_err(ERR_WRITE, f"store write failed: {err}"))
+            else:
+                self._send(conn, encode_ack(seq))
+
+    def _dedup(self, trial_name: str, rows: List[MetricLog]) -> List[MetricLog]:
+        """The JSON receiver's idempotent exact-duplicate drop, batched: one
+        windowed store read per trial per drain (instead of per entry), plus
+        intra-batch dedup so a resent frame coalescing with its original
+        never lands twice."""
+        min_ts = min(
+            (r.timestamp for r in rows if not math.isnan(r.timestamp)),
+            default=None,
+        )
+        seen = set()
+        if min_ts is not None:
+            seen = {
+                (r.timestamp, r.metric_name, r.value)
+                for r in self.store.get_observation_log(trial_name, start_time=min_ts)
+            }
+        fresh: List[MetricLog] = []
+        for r in rows:
+            key = (r.timestamp, r.metric_name, r.value)
+            if key in seen:
+                continue
+            seen.add(key)
+            fresh.append(r)
+        return fresh
+
+
+# -- client ------------------------------------------------------------------
+
+# shared retry policy with the JSON client (service/httpapi.py)
+from .httpapi import (  # noqa: E402  (import placed after codec: no cycle —
+    DEFAULT_BACKOFF_BASE_S,  # httpapi never imports this module)
+    DEFAULT_BACKOFF_CAP_S,
+    DEFAULT_HTTP_RETRIES,
+    HttpRemoteObservationStore,
+    RpcError,
+)
+
+
+class FramedIngestClient:
+    """One persistent framed connection to an :class:`IngestServer`.
+
+    ``report_many`` is synchronous at-least-once: encode one DATA frame,
+    send, wait for the cumulative ACK. Connection failures and ERR-code-3
+    (store write failed) reconnect with the capped exponential backoff of
+    the JSON client and RESEND the identical frame — the server's
+    exact-duplicate drop makes the retry effectively-once. Auth and
+    protocol rejections raise :class:`RpcError` immediately (the 4xx
+    rule: never retried into duplicates)."""
+
+    def __init__(
+        self,
+        address: str,
+        token: Optional[str] = None,
+        timeout: float = 30.0,
+        retries: int = DEFAULT_HTTP_RETRIES,
+        backoff_base: float = DEFAULT_BACKOFF_BASE_S,
+        backoff_cap: float = DEFAULT_BACKOFF_CAP_S,
+    ) -> None:
+        host, _, port = address.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"ingest address must be host:port, got {address!r}")
+        self.address = address
+        self.host = host
+        self.port = int(port)
+        self.token = token
+        self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rbuf = bytearray()
+        self._seq = 0
+
+    # -- connection management ----------------------------------------------
+
+    def _connect_locked(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._sock = sock
+        self._rbuf.clear()
+        sock.sendall(encode_hello(self.token or ""))
+        self._await_ack_locked(0)
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._rbuf.clear()
+
+    def _await_ack_locked(self, target_seq: int) -> None:
+        deadline = time.monotonic() + self.timeout
+        while True:
+            for ftype, payload in frames_from_buffer(self._rbuf):
+                if ftype == F_ACK:
+                    (seq,) = _SEQ.unpack(payload)
+                    if seq >= target_seq:
+                        return
+                elif ftype == F_ERR:
+                    code = payload[0] if payload else 0
+                    message = str(payload[1:], "utf-8", "replace")
+                    self._close_locked()
+                    if code == ERR_WRITE:
+                        # transient: the reconnect loop resends the frame
+                        raise ConnectionError(f"ingest server: {message}")
+                    raise RpcError(
+                        f"ingest {self.address} rejected: {message}",
+                        code=403 if code == ERR_AUTH else 400,
+                    )
+                else:
+                    raise FrameError(f"unexpected frame type {ftype} from server")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no ACK from {self.address} within {self.timeout}s"
+                )
+            self._sock.settimeout(remaining)
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError(f"ingest {self.address} closed mid-ack")
+            self._rbuf += chunk
+
+    # -- the hot path --------------------------------------------------------
+
+    def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
+        batch = [(t, list(ls)) for t, ls in entries if ls]
+        if not batch:
+            return
+        with self._lock:
+            self._seq += 1
+            frame = encode_data_frame(batch, self._seq)
+            last: Optional[BaseException] = None
+            for attempt in range(self.retries):
+                try:
+                    if self._sock is None:
+                        self._connect_locked()
+                    self._sock.settimeout(self.timeout)
+                    self._sock.sendall(frame)
+                    self._await_ack_locked(self._seq)
+                    return
+                except RpcError:
+                    raise  # auth/protocol rejection: the 4xx rule
+                except (OSError, FrameError, TimeoutError, ConnectionError) as e:
+                    last = e
+                    self._close_locked()
+                if attempt < self.retries - 1:
+                    time.sleep(
+                        min(self.backoff_base * (2 ** attempt), self.backoff_cap)
+                    )
+            raise RpcError(
+                f"framed ingest to {self.address} failed after "
+                f"{self.retries} attempt(s): {last}"
+            ) from last
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+class FramedObservationStore(ObservationStore):
+    """ObservationStore whose WRITE path is the framed ingest plane and
+    whose read/control path stays on the JSON wire — what a trial process
+    under ``KATIB_TPU_INGEST_ADDR`` uses. ``report_many`` ships a whole
+    group-commit batch as ONE binary frame over a persistent socket, so
+    the buffered store's flusher pays neither connection setup nor a JSON
+    codec per drained batch."""
+
+    def __init__(
+        self,
+        ingest_addr: str,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        timeout: float = 30.0,
+        retries: int = DEFAULT_HTTP_RETRIES,
+    ) -> None:
+        self.ingest = FramedIngestClient(
+            ingest_addr, token=token, timeout=timeout, retries=retries
+        )
+        self._http: Optional[HttpRemoteObservationStore] = (
+            HttpRemoteObservationStore(
+                base_url, token=token, timeout=timeout, retries=retries
+            )
+            if base_url
+            else None
+        )
+
+    def _control(self) -> HttpRemoteObservationStore:
+        if self._http is None:
+            raise RpcError(
+                "framed store has no JSON control-plane url (base_url) — "
+                "reads/truncate/delete need the rpc binding"
+            )
+        return self._http
+
+    def report_observation_log(
+        self, trial_name: str, logs: Sequence[MetricLog]
+    ) -> None:
+        self.ingest.report_many([(trial_name, logs)])
+
+    def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
+        self.ingest.report_many(entries)
+
+    def get_observation_log(
+        self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None
+    ):
+        return self._control().get_observation_log(
+            trial_name, metric_name=metric_name,
+            start_time=start_time, end_time=end_time, limit=limit,
+        )
+
+    def folded(self, trial_name, metric_names):
+        return self._control().folded(trial_name, metric_names)
+
+    def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        return self._control().truncate_observation_log(trial_name, after_time)
+
+    def delete_observation_log(self, trial_name: str) -> None:
+        self._control().delete_observation_log(trial_name)
+
+    def close(self) -> None:
+        self.ingest.close()

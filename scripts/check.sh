@@ -60,6 +60,14 @@ echo "== sharded control-plane smoke (replica subprocesses over the wire protoco
 JAX_PLATFORMS=cpu python bench.py control_plane_scaling --smoke
 
 echo
+echo "== framed control-plane smoke (the same failover phases on the binary ingest plane) =="
+JAX_PLATFORMS=cpu KATIB_TPU_INGEST_FRAMED=1 python bench.py control_plane_scaling --smoke
+
+echo
+echo "== ingest-throughput smoke (streamed observation rows: JSON wire vs framed plane + mid-stream SIGKILL) =="
+JAX_PLATFORMS=cpu python bench.py ingest_throughput --smoke
+
+echo
 echo "== lockgraph stress smoke (dynamic lock-order) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_stress.py::test_parallel_64_throughput_and_cleanup \

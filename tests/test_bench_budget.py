@@ -776,6 +776,25 @@ def test_control_plane_scaling_smoke_integrity(bench):
     assert out["speedup"] > 0
 
 
+def test_ingest_throughput_smoke_integrity(bench):
+    """--smoke mode of the ingest_throughput scenario (ISSUE 16): the same
+    streaming workload lands once over the HTTP/JSON wire and once over
+    the framed ingest plane with a mid-stream replica SIGKILL — streamers
+    reroute to the survivors, the idempotent duplicate drop absorbs the
+    resends, and the full deterministic row set verifies offline exactly
+    once, bit-identical. The >= 5x rows/sec assertion belongs to the
+    full-size (3-replica, thousands-of-experiments) run; smoke pins the
+    wiring and the integrity invariants."""
+    out = bench._bench_ingest_throughput(smoke=True)
+    assert out["smoke"] is True
+    assert out["replicas"] == 2
+    assert out["lost_observations"] == 0
+    assert out["bit_identical"] is True
+    assert out["sigkill_victim"]
+    assert out["rows_per_sec_json"] > 0
+    assert out["rows_per_sec_framed_chaos"] > 0
+
+
 def test_obslog_scenarios_run_standalone_via_cli():
     """`python bench.py obslog_report_throughput --smoke` prints one JSON
     line — the documented entry point for the data-plane scenarios."""

@@ -1,0 +1,524 @@
+"""High-throughput ingest plane (ISSUE 16): binary frame codec, the
+selectors-based ingest server, pooled persistent JSON connections, and the
+`ingest_framed` knob's on-vs-off byte identity.
+
+Covers the tentpole's three layers plus the satellites:
+
+- frame codec: property-style roundtrips over adversarial inputs (empty
+  batches, unicode metric names, NaN/inf/-0.0, max-length frames) and loud
+  rejection of truncated/torn/oversized/non-protocol frames;
+- timestamps survive wire transit bit-exactly in BOTH codecs (the
+  truncate-to-checkpoint recovery rule compares these floats);
+- mixed protocol: a JSON client and a framed client against ONE store,
+  rows bit-identical, duplicate drop shared across protocols;
+- reconnect/resend: at-least-once delivery through a server restart stays
+  effectively-once; auth rejections are immediate (never retried);
+- server-side coalescing: many frames, one group commit, cumulative ACK;
+- the pooled persistent JSON connection (reuse, restart recovery) and the
+  non-JSON error-body fallback in `HttpApiClient._post`;
+- the `report_metrics` ENV_INGEST_ADDR binding;
+- `ingest_framed` off => topology and a seeded sweep's rows identical to
+  the PR 15 JSON-only wire (the PR 14/15 on-vs-off precedent).
+"""
+
+import math
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from katib_tpu.db.store import InMemoryObservationStore, MetricLog
+from katib_tpu.service.httpapi import (
+    HttpApiClient,
+    HttpRemoteObservationStore,
+    RpcError,
+    serve_api,
+)
+from katib_tpu.service.ingest import (
+    MAX_FRAME_BYTES,
+    F_ACK,
+    F_DATA,
+    FrameError,
+    FramedIngestClient,
+    FramedObservationStore,
+    IngestServer,
+    decode_data_payload,
+    encode_ack,
+    encode_data_frame,
+    frames_from_buffer,
+)
+from katib_tpu.service.rpc import ApiServicer
+
+from test_control_plane import _is_done, _rows_by_x, _spec, _write_trial_module
+
+
+def _bits(ts: float) -> bytes:
+    return struct.pack("!d", ts)
+
+
+ADVERSARIAL_TIMESTAMPS = [
+    0.0,
+    -0.0,
+    0.1 + 0.2,                      # classic non-representable sum
+    1_700_000_000.123456789,
+    math.nextafter(1_700_000_000.0, math.inf),
+    math.nextafter(0.0, 1.0),       # smallest subnormal
+    1e-308,
+    float("inf"),
+    float("-inf"),
+]
+
+
+class TestFrameCodec:
+    def test_roundtrip_adversarial(self):
+        """Empty batches, unicode names, NaN/inf values and timestamps —
+        every row must come back bit-identical."""
+        cases = [
+            [],
+            [("t", [])],
+            [("trial-ü-β", [MetricLog(ts, f"mëtric_{i}", repr(ts))])
+             for i, ts in enumerate(ADVERSARIAL_TIMESTAMPS)],
+            [("t1", [MetricLog(float("nan"), "loss", "nan"),
+                     MetricLog(1.5, "acc", "inf"),
+                     MetricLog(-0.0, "zero", "-0.0")]),
+             ("t2", [MetricLog(2.0, "läss" * 100, "x" * 1000)])],
+        ]
+        for seq, entries in enumerate(cases, start=1):
+            buf = bytearray(encode_data_frame(entries, seq))
+            frames = list(frames_from_buffer(buf))
+            assert len(frames) == 1 and not buf
+            ftype, payload = frames[0]
+            assert ftype == F_DATA
+            got_seq, got = decode_data_payload(payload)
+            assert got_seq == seq
+            assert len(got) == len(entries)
+            for (want_t, want_rows), (got_t, got_rows) in zip(entries, got):
+                assert want_t == got_t
+                assert len(want_rows) == len(got_rows)
+                for w, g in zip(want_rows, got_rows):
+                    assert _bits(w.timestamp) == _bits(g.timestamp)
+                    assert w.metric_name == g.metric_name
+                    assert w.value == g.value
+
+    def test_oversized_frame_rejected(self):
+        rows = [MetricLog(1.0, "m", "v" * 0xFFFF) for _ in range(140)]
+        with pytest.raises(FrameError, match="bound"):
+            encode_data_frame([("t", rows)], 1)
+
+    def test_truncated_and_torn_rejected_loudly(self):
+        frame = encode_data_frame(
+            [("trial", [MetricLog(1.5, "loss", "0.25")])], 9
+        )
+        _, payload = next(iter(frames_from_buffer(bytearray(frame))))
+        # torn payload: every strict prefix must refuse to land rows
+        for cut in (1, len(payload) // 2, len(payload) - 1):
+            with pytest.raises(FrameError, match="torn"):
+                decode_data_payload(payload[:cut])
+        # trailing garbage is just as loud (a framing bug, not padding)
+        with pytest.raises(FrameError, match="trailing"):
+            decode_data_payload(payload + b"\x00")
+        # non-protocol bytes at the stream head
+        with pytest.raises(FrameError, match="magic"):
+            list(frames_from_buffer(bytearray(b"POST /rpc HTTP/1.1\r\n")))
+        # wrong version
+        bad = bytearray(frame)
+        bad[2] = 99
+        with pytest.raises(FrameError, match="version"):
+            list(frames_from_buffer(bad))
+        # declared length beyond the bound: rejected from the header alone
+        huge = struct.pack("!2sBBI", b"KF", 1, F_DATA, MAX_FRAME_BYTES + 1)
+        with pytest.raises(FrameError, match="bound"):
+            list(frames_from_buffer(bytearray(huge)))
+
+    def test_incomplete_buffer_waits_without_consuming(self):
+        frame = encode_data_frame([("t", [MetricLog(1.0, "m", "1")])], 1)
+        buf = bytearray(frame[:-3])
+        assert list(frames_from_buffer(buf)) == []
+        assert bytes(buf) == frame[:-3]  # nothing consumed: wait for more
+        buf += frame[-3:]
+        assert len(list(frames_from_buffer(buf))) == 1 and not buf
+
+
+class TestTimestampBitExactness:
+    """Satellite: the truncate-to-checkpoint recovery rule compares row
+    timestamps as floats — both codecs must ship them bit-exactly."""
+
+    FINITE = [ts for ts in ADVERSARIAL_TIMESTAMPS if math.isfinite(ts)]
+
+    def test_framed_wire_bit_exact(self):
+        store = InMemoryObservationStore()
+        srv = IngestServer(store)
+        cli = FramedIngestClient(srv.address)
+        try:
+            rows = [
+                MetricLog(ts, "m", repr(i)) for i, ts in enumerate(self.FINITE)
+            ]
+            cli.report_many([("t", rows)])
+            back = store.get_observation_log("t")
+            # reads come back time-ordered; compare the raw IEEE-754 bits
+            # as multisets (−0.0 and 0.0 are order-equal but bit-distinct)
+            assert sorted(_bits(r.timestamp) for r in back) == sorted(
+                _bits(ts) for ts in self.FINITE
+            )
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_json_wire_bit_exact(self):
+        srv = serve_api(ApiServicer(store=InMemoryObservationStore()))
+        remote = HttpRemoteObservationStore(srv.base_url)
+        try:
+            rows = [
+                MetricLog(ts, "m", repr(i)) for i, ts in enumerate(self.FINITE)
+            ]
+            remote.report_many([("t", rows)])
+            back = remote.get_observation_log("t")
+            assert sorted(_bits(r.timestamp) for r in back) == sorted(
+                _bits(ts) for ts in self.FINITE
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestIngestServer:
+    def test_mixed_protocol_rows_bit_identical(self):
+        """JSON client and framed client against ONE store: the same
+        logical rows land bit-identically, and the idempotent duplicate
+        drop is shared across protocols (a framed resend of a JSON-landed
+        row is a no-op)."""
+        store = InMemoryObservationStore()
+        http_srv = serve_api(ApiServicer(store=store))
+        ingest_srv = IngestServer(store)
+        remote = HttpRemoteObservationStore(http_srv.base_url)
+        framed = FramedIngestClient(ingest_srv.address)
+        try:
+            rows = [
+                MetricLog(1_700_000_000.0 + i, "score", repr(0.1 * i))
+                for i in range(5)
+            ]
+            remote.report_observation_log("via-json", rows)
+            framed.report_many([("via-framed", rows)])
+            a = store.get_observation_log("via-json")
+            b = store.get_observation_log("via-framed")
+            assert [
+                (_bits(r.timestamp), r.metric_name, r.value) for r in a
+            ] == [
+                (_bits(r.timestamp), r.metric_name, r.value) for r in b
+            ]
+            # cross-protocol duplicate drop: same trial, same triples
+            remote.report_observation_log("shared", rows)
+            framed.report_many([("shared", rows)])
+            assert len(store.get_observation_log("shared")) == len(rows)
+        finally:
+            framed.close()
+            remote.close()
+            ingest_srv.close()
+            http_srv.shutdown()
+            http_srv.server_close()
+
+    def test_reconnect_resend_stays_effectively_once(self):
+        """At-least-once through a server restart on the same port: the
+        client redials with backoff and resends; dedup keeps one copy."""
+        store = InMemoryObservationStore()
+        srv1 = IngestServer(store)
+        port = srv1.bound_port
+        cli = FramedIngestClient(f"127.0.0.1:{port}", retries=8)
+        try:
+            first = [MetricLog(1.0, "m", "a")]
+            cli.report_many([("t", first)])
+            srv1.close()
+
+            second = [MetricLog(2.0, "m", "b")]
+            sender = threading.Thread(
+                target=cli.report_many, args=([("t", first + second)],)
+            )
+            sender.start()  # dials a dead port -> capped-backoff reconnect
+            time.sleep(0.3)
+            srv2 = IngestServer(store, port=port)
+            try:
+                sender.join(timeout=30)
+                assert not sender.is_alive(), "client never reconnected"
+                back = store.get_observation_log("t")
+                assert [(r.timestamp, r.metric_name, r.value) for r in back] == [
+                    (1.0, "m", "a"), (2.0, "m", "b"),
+                ], "resend after reconnect must dedup, not duplicate"
+            finally:
+                srv2.close()
+        finally:
+            cli.close()
+
+    def test_auth_rejection_is_immediate(self):
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, auth_token="sekrit")
+        try:
+            bad = FramedIngestClient(srv.address, token="wrong", retries=10)
+            t0 = time.monotonic()
+            with pytest.raises(RpcError) as err:
+                bad.report_many([("t", [MetricLog(1.0, "m", "1")])])
+            # the 4xx rule: rejected on the first round trip, not after
+            # 10 backoff attempts
+            assert time.monotonic() - t0 < 2.0
+            assert err.value.code == 403
+            bad.close()
+            good = FramedIngestClient(srv.address, token="sekrit")
+            good.report_many([("t", [MetricLog(1.0, "m", "1")])])
+            assert len(store.get_observation_log("t")) == 1
+            good.close()
+        finally:
+            srv.close()
+
+    def test_frames_coalesce_into_one_group_commit(self):
+        """Back-to-back DATA frames on one connection land as fewer drains
+        than frames, acknowledged by ONE cumulative ACK."""
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, coalesce_window_s=0.5, coalesce_rows=4096)
+        sock = socket.create_connection(("127.0.0.1", srv.bound_port), timeout=10)
+        try:
+            blob = b"".join(
+                encode_data_frame(
+                    [(f"t{i}", [MetricLog(float(i), "m", str(i))])], i
+                )
+                for i in range(1, 4)
+            )
+            sock.sendall(blob)
+            buf = bytearray()
+            deadline = time.monotonic() + 10
+            acked = 0
+            while acked < 3 and time.monotonic() < deadline:
+                sock.settimeout(max(0.01, deadline - time.monotonic()))
+                buf += sock.recv(4096)
+                for ftype, payload in frames_from_buffer(buf):
+                    assert ftype == F_ACK
+                    acked = max(acked, struct.unpack("!Q", payload)[0])
+            assert acked == 3, "cumulative ACK for the whole burst expected"
+            for i in range(1, 4):
+                assert len(store.get_observation_log(f"t{i}")) == 1
+            assert srv.stats["frames_total"] == 3
+            assert srv.stats["drains_total"] < 3, (
+                "a back-to-back burst must coalesce into fewer group commits"
+            )
+        finally:
+            sock.close()
+            srv.close()
+
+    def test_ingest_metrics_exposed(self):
+        from katib_tpu.controller.events import MetricsRegistry
+
+        registry = MetricsRegistry()
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, metrics=registry)
+        cli = FramedIngestClient(srv.address)
+        try:
+            cli.report_many([("t", [MetricLog(1.0, "m", "1")])])
+            text = registry.render()
+            assert "katib_ingest_frames_total" in text
+            assert "katib_ingest_batch_rows" in text
+            assert "katib_ingest_coalesce_depth" in text
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_report_metrics_env_binding(self, monkeypatch):
+        """ENV_INGEST_ADDR wins over the RPC url for writes: report_metrics
+        in a subprocess-shaped env streams frames, and the row is readable
+        back through the JSON plane (the framed store's control path)."""
+        from katib_tpu.runtime import metrics as rmetrics
+
+        store = InMemoryObservationStore()
+        http_srv = serve_api(ApiServicer(store=store))
+        ingest_srv = IngestServer(store)
+        try:
+            monkeypatch.setenv(rmetrics.ENV_TRIAL_NAME, "env-trial")
+            monkeypatch.setenv(rmetrics.ENV_INGEST_ADDR, ingest_srv.address)
+            monkeypatch.setenv(rmetrics.ENV_RPC_URL, http_srv.base_url)
+            monkeypatch.delenv(rmetrics.ENV_DB_PATH, raising=False)
+            monkeypatch.setattr(rmetrics, "_current_reporter", type(
+                rmetrics._current_reporter)("t", default=None))
+            rmetrics.report_metrics(loss=0.5)
+            rows = store.get_observation_log("env-trial")
+            assert [(r.metric_name, r.value) for r in rows] == [("loss", "0.5")]
+            bound = rmetrics._env_stores.get(
+                (os.getpid(), ingest_srv.address)
+            )
+            assert isinstance(bound, FramedObservationStore)
+            # reads ride the JSON control plane of the same bound store
+            back = bound.get_observation_log("env-trial")
+            assert [(r.metric_name, r.value) for r in back] == [("loss", "0.5")]
+        finally:
+            rmetrics._close_env_stores()
+            ingest_srv.close()
+            http_srv.shutdown()
+            http_srv.server_close()
+
+
+class TestPooledHttpClient:
+    def test_persistent_connection_reused_across_calls(self):
+        from katib_tpu.service import httpapi
+
+        store = InMemoryObservationStore()
+        srv = serve_api(ApiServicer(store=store))
+        client = HttpApiClient(srv.base_url)
+        try:
+            key = (os.getpid(), client._netloc)
+            httpapi._POOL.pop(key, None)
+            client.call("ReportObservationLog", {
+                "trialName": "t",
+                "metricLogs": [
+                    {"timestamp": 1.0, "metricName": "m", "value": "1"}
+                ],
+            })
+            pooled = httpapi._POOL.get(key)
+            assert pooled and len(pooled) == 1, "connection must return to pool"
+            first = pooled[0]
+            out = client.call("GetObservationLog", {"trialName": "t"})
+            assert len(out["metricLogs"]) == 1
+            assert httpapi._POOL[key][0] is first, (
+                "second call must reuse the pooled connection, not redial"
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_pooled_client_survives_server_restart(self):
+        store = InMemoryObservationStore()
+        srv1 = serve_api(ApiServicer(store=store))
+        port = srv1.bound_port
+        client = HttpApiClient(srv1.base_url)
+        payload = {
+            "trialName": "t",
+            "metricLogs": [{"timestamp": 1.0, "metricName": "m", "value": "1"}],
+        }
+        client.call("ReportObservationLog", payload)
+        srv1.shutdown()
+        srv1.server_close()
+        srv2 = serve_api(ApiServicer(store=store), port=port)
+        try:
+            # the pooled socket is dead; the client must drop it and redial
+            out = client.call("GetObservationLog", {"trialName": "t"})
+            assert len(out["metricLogs"]) == 1
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+
+    def test_non_json_error_body_surfaces_raw_text(self):
+        """Satellite: a 4xx with a non-JSON body (a proxy's HTML page, a
+        bare traceback) must raise RpcError carrying the raw text — not a
+        JSONDecodeError masking the real status."""
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class PlainTextError(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = b"<html>502 boom from the proxy</html>"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), PlainTextError)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            client = HttpApiClient(
+                f"http://127.0.0.1:{httpd.server_address[1]}", retries=3
+            )
+            t0 = time.monotonic()
+            with pytest.raises(RpcError) as err:
+                client.call("GetObservationLog", {"trialName": "t"})
+            assert time.monotonic() - t0 < 2.0, "4xx must not be retried"
+            assert err.value.code == 404
+            assert "502 boom from the proxy" in str(err.value)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestIngestOnVsOffByteIdentity:
+    def test_framed_knob_off_is_byte_identical_to_json_wire(self, tmp_path):
+        """Acceptance: `ingest_framed` off keeps the PR 15 JSON-only
+        topology (no ingest listener, no registry `ingest` field, no
+        katib_ingest_* series) and a seeded sweep's rows are identical to
+        the framed run's — the PR 14/15 on-vs-off precedent extended to
+        this knob."""
+        from katib_tpu.client.katib_client import ReplicaRouter
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.replica import ReplicaServer
+
+        def drive(root, framed):
+            _write_trial_module(root, epochs=2, dwell=0.01)
+            import sys as _sys
+
+            _sys.path.insert(0, root)
+            try:
+                cfg = KatibConfig()
+                cfg.runtime.replicas = 1
+                cfg.runtime.telemetry = False
+                cfg.runtime.compile_service = False
+                cfg.runtime.tracing = False
+                cfg.runtime.placement_lease_seconds = 5.0
+                cfg.runtime.ingest_framed = framed
+                srv = ReplicaServer(
+                    root_dir=root, replica_id="r0", devices=[0, 1],
+                    config=cfg, export_rpc_env=False,
+                ).start()
+                try:
+                    router = ReplicaRouter(root)
+                    deadline = time.time() + 60
+                    while not router.live_replicas():
+                        assert time.time() < deadline
+                        time.sleep(0.1)
+                    router.create_experiment(_spec("seeded"))
+                    while not _is_done(router.experiment_status("seeded")):
+                        assert time.time() < deadline, "sweep never completed"
+                        time.sleep(0.2)
+                    record = next(
+                        r for r in router.table()["replicas"]
+                        if r.get("replica") == "r0"
+                    )
+                    if framed:
+                        # the plane is LIVE: one framed write round-trips
+                        cli = FramedIngestClient(srv.ingest_addr)
+                        cli.report_many(
+                            [("probe", [MetricLog(1.0, "m", "1")])]
+                        )
+                        cli.close()
+                    import urllib.request
+
+                    with urllib.request.urlopen(
+                        srv.url + "/metrics", timeout=10
+                    ) as resp:
+                        exposition = resp.read().decode()
+                    return record, exposition
+                finally:
+                    srv.stop()
+            finally:
+                _sys.path.remove(root)
+
+        off_root = str(tmp_path / "off")
+        on_root = str(tmp_path / "on")
+        os.makedirs(off_root)
+        os.makedirs(on_root)
+
+        off_record, off_metrics = drive(off_root, framed=False)
+        on_record, on_metrics = drive(on_root, framed=True)
+
+        # off: JSON-only wire — no ingest endpoint anywhere
+        assert "ingest" not in off_record
+        assert "katib_ingest" not in off_metrics
+        # on: the sibling plane is registered and counted
+        assert on_record.get("ingest")
+        assert "katib_ingest_frames_total" in on_metrics
+
+        _, off_scores = _rows_by_x(off_root, ["seeded"])
+        _, on_scores = _rows_by_x(on_root, ["seeded"])
+        assert off_scores == on_scores and off_scores, (
+            "ingest_framed on-vs-off rows diverged for the seeded sweep"
+        )

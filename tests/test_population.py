@@ -244,6 +244,55 @@ class TestSweepCheckpoint:
         assert done == 2 and reported == 1
         assert np.array_equal(pending["score"], ys["score"])
 
+    def test_torn_staging_file_never_counts_as_checkpoint_instant(self, tmp_path):
+        """A SIGKILL mid-save leaves a half-written staging file behind; its
+        mtime is NOT a durability instant. If recovery's cutoff scan counted
+        it, rows newer than the real carry would survive truncation and the
+        resumed sweep would double-report them (the 28-rows-instead-of-24
+        flake in test_resume's fused crash test)."""
+        from katib_tpu.controller.recovery import latest_checkpoint_time
+
+        prog = _toy_program()
+        carry, _ = pop.run_generations(prog, 2)
+        pop.save_sweep_checkpoint(str(tmp_path), carry, 2)
+        durable = latest_checkpoint_time(str(tmp_path))
+        assert durable is not None
+        # both staging spellings: the current dot-prefixed one and the
+        # pre-fix name that DID match the population_carry* glob
+        future = durable + 60.0
+        for torn in (".population_carry.npz.tmp", "population_carry.npz.tmp.npz",
+                     "population_carry.json.tmp"):
+            p = tmp_path / torn
+            p.write_bytes(b"half-written garbage")
+            os.utime(p, (future, future))
+        assert latest_checkpoint_time(str(tmp_path)) == durable
+
+    def test_meta_rides_inside_npz_and_wins_over_stale_sidecar(self, tmp_path):
+        """Carry arrays + progress counters commit in ONE os.replace: a kill
+        between the npz and json writes must not pair new arrays with a stale
+        generation counter (the double-report torn window). The sidecar json
+        is a mirror for watchers; the embedded copy is authoritative — and
+        sufficient when the sidecar is missing entirely."""
+        import json as _json
+
+        prog = _toy_program()
+        carry, ys = pop.run_generations(prog, 8)
+        pop.save_sweep_checkpoint(str(tmp_path), carry, 8, pending_ys=ys)
+        # simulate the torn pair: sidecar still shows the PREVIOUS boundary
+        stale = {"generationDone": 4, "reported": 0, "pendingKeys": [],
+                 "leaves": 0}
+        (tmp_path / pop.CARRY_META_FILE).write_text(_json.dumps(stale))
+        loaded = pop.load_sweep_checkpoint(str(tmp_path), prog)
+        assert loaded is not None
+        _, done, pending, reported = loaded
+        assert done == 8 and reported == 0
+        assert np.array_equal(pending["score"], ys["score"])
+        # sidecar gone altogether: the embedded meta still restores
+        os.unlink(tmp_path / pop.CARRY_META_FILE)
+        loaded = pop.load_sweep_checkpoint(str(tmp_path), prog)
+        assert loaded is not None
+        assert loaded[1] == 8
+
 
 # ---------------------------------------------------------------------------
 # Controller path: one fused gang unit, AOT prewarm, legacy fallback
