@@ -2757,6 +2757,385 @@ def _bench_control_plane_scaling(smoke: bool = False):
     }
 
 
+def _bench_multi_tenant_scaling(smoke: bool = False):
+    """Multi-tenant service tier under load (ISSUE 17): N tenants drive the
+    same aggregate workload through REAL replica subprocesses with the
+    tenancy plane armed — per-tenant scoped tokens, namespaced experiments,
+    replica-shared admission buckets. Three phases:
+
+    A. tenancy OFF, same replicas/workload — the PR 16 throughput baseline;
+    B. tenancy ON, one router per tenant — aggregate trials/sec must hold
+       >= 0.9x the baseline (isolation is not allowed to cost the plane),
+       then a fairness probe hammers per-tenant admissions (no tenant may
+       exceed its admission share by >10%; the starved low-quota tenant
+       still progresses) and an adversarial probe fires every cross-tenant
+       verb expecting 403s — zero leaks;
+    C. tenancy ON + mid-run replica SIGKILL — failover with ZERO lost
+       observations (every epoch curve continuous) and score rows
+       bit-identical to phase B.
+
+    Scale knobs: BENCH_MT_TENANTS / BENCH_MT_EXPERIMENTS (per tenant) /
+    BENCH_MT_TRIALS / BENCH_MT_EPOCHS / BENCH_MT_DWELL / BENCH_MT_REPLICAS.
+    Ambient KATIB_TPU_* env passes through, so the framed ingest plane can
+    be armed underneath (`KATIB_TPU_INGEST_FRAMED=1`)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from katib_tpu.client.katib_client import ReplicaRouter
+    from katib_tpu.db.state import ExperimentStateStore
+    from katib_tpu.db.store import SqliteObservationStore
+    from katib_tpu.service.httpapi import HttpApiClient, RpcError
+    from katib_tpu.service.tenancy import SCOPE_ADMIN, TenantRegistry
+
+    n_tenants = int(os.environ.get("BENCH_MT_TENANTS", "4" if smoke else "8"))
+    exps_per_tenant = int(os.environ.get("BENCH_MT_EXPERIMENTS", "1" if smoke else "2"))
+    n_trials = int(os.environ.get("BENCH_MT_TRIALS", "2" if smoke else "3"))
+    epochs = int(os.environ.get("BENCH_MT_EPOCHS", "2" if smoke else "3"))
+    dwell = float(os.environ.get("BENCH_MT_DWELL", "0.15" if smoke else "0.35"))
+    n_replicas = int(os.environ.get("BENCH_MT_REPLICAS", "2" if smoke else "3"))
+    devices_per_replica = 4 if smoke else 8
+    parallel = 2
+    lease_ttl = 8.0
+    probe_attempts = 6 if smoke else 10
+    root_token = "bench-root-token"
+    tenants = [f"ten{i}" for i in range(n_tenants)]
+    starved = tenants[0]
+    # the starved tenant's bucket barely covers its main workload (burst
+    # max(1, Q/6)); everyone else is effectively unlimited for the run
+    quotas = {t: (12.0 if t == starved else 600.0) for t in tenants}
+    n_exps_total = n_tenants * exps_per_tenant
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def spec_for(name):
+        step = 0.9 / max(n_trials - 1, 1)
+        return {
+            "name": name,
+            "parameters": [{
+                "name": "x", "parameterType": "double",
+                "feasibleSpace": {"min": "0.1", "max": "1.0", "step": repr(step)},
+            }],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "grid"},
+            "trialTemplate": {
+                "entryPoint": "cp_trial:run_trial",
+                "trialParameters": [{"name": "x", "reference": "x"}],
+            },
+            "maxTrialCount": n_trials,
+            "parallelTrialCount": parallel,
+            "resumePolicy": "FromVolume",
+        }
+
+    def is_done(status_doc):
+        if not status_doc:
+            return False
+        return any(
+            c.get("type") in ("Succeeded", "Failed") and c.get("status")
+            for c in status_doc.get("status", {}).get("conditions", [])
+        )
+
+    def rows_by_key(root, names):
+        state = ExperimentStateStore(os.path.join(root, "state"))
+        store = SqliteObservationStore(os.path.join(root, "observations.db"))
+        epochs_by, scores_by = {}, {}
+        try:
+            for name in names:
+                state.load(name)
+                for t in state.list_trials(name):
+                    key = (name, t.assignments_dict()["x"])
+                    epochs_by[key] = [
+                        int(float(r.value))
+                        for r in store.get_observation_log(t.name, metric_name="epoch")
+                    ]
+                    scores_by[key] = [
+                        r.value
+                        for r in store.get_observation_log(t.name, metric_name="score")
+                    ]
+        finally:
+            store.close()
+        return epochs_by, scores_by
+
+    def run_phase(tenancy, kill=False, probe=False, phase_timeout=420.0):
+        root = tempfile.mkdtemp(prefix="bench-mt-")
+        phase_dwell = max(dwell, 0.4) if kill else dwell
+        with open(os.path.join(root, "cp_trial.py"), "w") as f:
+            f.write(_CP_TRIAL_MODULE.format(epochs=epochs, dwell=phase_dwell))
+        tokens = {}
+        if tenancy:
+            reg = TenantRegistry(root)
+            for t in tenants:
+                rec = reg.create(t, admission_per_minute=quotas[t])
+                tokens[t] = rec.tokens[SCOPE_ADMIN]
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": (
+                repo + os.pathsep + root + os.pathsep + env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep),
+            "KATIB_TPU_REPLICAS": str(n_replicas),
+            "KATIB_TPU_REPLICA_CAPACITY": str(
+                n_exps_total + n_tenants * probe_attempts + 8
+            ),
+            "KATIB_TPU_PLACEMENT_LEASE_SECONDS": str(lease_ttl),
+            "KATIB_TPU_TENANCY": "1" if tenancy else "0",
+            "KATIB_TPU_TELEMETRY": "0",
+            "KATIB_TPU_COMPILE_SERVICE": "0",
+            "KATIB_TPU_TRACING": "0",
+            "KATIB_TPU_OBSLOG_BUFFERED": "0",
+        })
+        env.pop("KATIB_TPU_CHAOS", None)
+        procs = {}
+        logs = []
+        deadline = time.time() + phase_timeout
+        try:
+            for i in range(n_replicas):
+                rid = f"r{i}"
+                out = open(os.path.join(root, f"{rid}.log"), "w+")
+                logs.append(out)
+                cmd = [sys.executable, "-m", "katib_tpu.controller.replica",
+                       "--root", root, "--replica-id", rid,
+                       "--devices", str(devices_per_replica)]
+                if tenancy:
+                    # the global token stays the break-glass admin: trial
+                    # subprocesses inherit it and write via the open path
+                    cmd += ["--token", root_token]
+                procs[rid] = subprocess.Popen(
+                    cmd, env=env, stdout=out, stderr=out, text=True
+                )
+            t_start = time.time()
+            admin_router = ReplicaRouter(
+                root, token=root_token if tenancy else None
+            )
+            while len(admin_router.live_replicas()) < n_replicas:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"replicas never registered; see {root}/r*.log"
+                    )
+                time.sleep(0.2)
+            routers = {
+                t: ReplicaRouter(root, token=tokens[t]) for t in tenants
+            } if tenancy else {}
+            # warmup: pay first-trial import/compile costs off the clock
+            warmups = []
+            for i in range(n_replicas):
+                wname = f"warm{i}"
+                w = dict(spec_for(wname))
+                w["maxTrialCount"] = 1
+                w["parallelTrialCount"] = 1
+                created = admin_router.create_experiment(w)
+                warmups.append(created.get("created", wname))
+            while not all(
+                is_done(admin_router.experiment_status(w)) for w in warmups
+            ):
+                if time.time() > deadline:
+                    raise TimeoutError("warmup experiments never completed")
+                time.sleep(0.3)
+
+            # the measured window: every tenant submits its batch (bare
+            # names — the wire namespaces them under the caller's tenant)
+            created_names = {}  # tenant -> [namespaced names]
+            t0 = time.time()
+            if tenancy:
+                for t in tenants:
+                    created_names[t] = []
+                    for i in range(exps_per_tenant):
+                        got = routers[t].create_experiment(spec_for(f"mt{i}"))
+                        created_names[t].append(got["created"])
+            else:
+                created_names[""] = []
+                for i in range(n_exps_total):
+                    got = admin_router.create_experiment(spec_for(f"mt{i:03d}"))
+                    created_names[""].append(got.get("created", f"mt{i:03d}"))
+            names = [n for ns in created_names.values() for n in ns]
+            pending = set(names)
+            kill_time, victim, victim_claims = None, None, set()
+            while pending:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} experiment(s) never completed: "
+                        f"{sorted(pending)[:4]}; see {root}/r*.log"
+                    )
+                for name in list(pending):
+                    if is_done(admin_router.experiment_status(name)):
+                        pending.discard(name)
+                if kill and kill_time is None and time.time() - t0 > 0.6:
+                    counts = {}
+                    rows = admin_router.table()["leases"]
+                    for row in rows:
+                        if (
+                            row.get("state") == "active"
+                            and row.get("replica") in procs
+                            and row.get("experiment") in pending
+                        ):
+                            counts[row["replica"]] = counts.get(row["replica"], 0) + 1
+                    if counts:
+                        victim = max(counts, key=counts.get)
+                        victim_claims = {
+                            row["experiment"] for row in rows
+                            if row.get("replica") == victim
+                            and row.get("state") == "active"
+                            and row.get("experiment") in pending
+                        }
+                        procs[victim].send_signal(_signal.SIGKILL)
+                        procs[victim].wait()
+                        kill_time = time.time()
+                time.sleep(0.25)
+            wall = time.time() - t0
+            if kill:
+                assert kill_time is not None, "kill trigger never fired"
+
+            grants, leaks = {}, []
+            if probe and tenancy:
+                # fairness probe: every tenant hammers more creates than its
+                # bucket can hold; grants are bounded by the quota share
+                for t in tenants:
+                    grants[t] = 0
+                    for j in range(probe_attempts):
+                        p = dict(spec_for(f"pr{j}"))
+                        p["maxTrialCount"] = 1
+                        p["parallelTrialCount"] = 1
+                        try:
+                            routers[t].create_experiment(p)
+                            grants[t] += 1
+                        except (RpcError, RuntimeError):
+                            pass
+                probe_elapsed = time.time() - t_start
+                for t in tenants:
+                    burst = max(1.0, quotas[t] / 6.0)
+                    share = burst + quotas[t] * probe_elapsed / 60.0
+                    # main-workload creates already drew from the bucket, so
+                    # this bound is conservative; >10% over it is a leak
+                    assert grants[t] + exps_per_tenant <= 1.1 * share + 1, (
+                        f"tenant {t} exceeded its admission share: "
+                        f"{grants[t]} probe grants + {exps_per_tenant} creates "
+                        f"vs share {share:.1f} over {probe_elapsed:.0f}s"
+                    )
+                assert grants[starved] < probe_attempts, (
+                    f"starved tenant {starved} was never refused "
+                    f"({grants[starved]}/{probe_attempts} probes admitted)"
+                )
+                # adversarial probe: tenant[1]'s token against tenant[2]'s
+                # namespace on EVERY replica — each non-403 is a leak
+                attacker, target = tenants[1], tenants[2]
+                target_exp = created_names[target][0]
+                row = {"timestamp": 1.0, "metricName": "score", "value": "1"}
+                rpc_probes = [
+                    ("GetObservationLog", {"trialName": f"{target_exp}-t0"}),
+                    ("ReportObservationLog",
+                     {"trialName": f"{target_exp}-t0", "metricLogs": [row]}),
+                    ("TruncateObservationLog",
+                     {"trialName": f"{target_exp}-t0", "afterTime": 0.0}),
+                    ("DeleteObservationLog", {"trialName": f"{target_exp}-t0"}),
+                    ("GetSuggestions",
+                     {"experiment": {"name": target_exp},
+                      "currentRequestNumber": 1}),
+                ]
+                for rep in admin_router.live_replicas():
+                    cli = HttpApiClient(
+                        rep["url"], token=tokens[attacker], retries=1
+                    )
+                    for method, payload in rpc_probes:
+                        try:
+                            cli.call(method, payload)
+                            leaks.append(f"{rep['replica']}:{method}")
+                        except RpcError as e:
+                            if e.code != 403:
+                                leaks.append(
+                                    f"{rep['replica']}:{method}:HTTP{e.code}"
+                                )
+                    try:
+                        if cli.experiment_status(target_exp) is not None:
+                            leaks.append(f"{rep['replica']}:experiment_status")
+                    except RpcError as e:
+                        if e.code != 403:
+                            leaks.append(
+                                f"{rep['replica']}:experiment_status:HTTP{e.code}"
+                            )
+                    status = cli.replica_status()
+                    foreign = [
+                        n for n in (status or {}).get("claimed", [])
+                        if not n.startswith(f"{attacker}--")
+                    ]
+                    if foreign:
+                        leaks.append(f"{rep['replica']}:claimed:{foreign}")
+                assert not leaks, f"cross-tenant probe leaked: {leaks}"
+
+            epochs_by, scores_by = rows_by_key(root, names)
+            return {
+                "root": root,
+                "wall": wall,
+                "trials_per_sec": (n_exps_total * n_trials) / wall,
+                "epochs_by": epochs_by,
+                "scores_by": scores_by,
+                "victim": victim,
+                "victim_claims": sorted(victim_claims),
+                "grants": grants,
+                "leaks": leaks,
+            }
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs.values():
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            for out in logs:
+                out.close()
+
+    timeout_s = 300.0 if smoke else 480.0
+    # phase A: tenancy OFF — the PR 16 baseline this plane must not tax
+    base = run_phase(tenancy=False, phase_timeout=timeout_s)
+    # phase B: the tenant fleet + fairness/adversarial probes
+    tenant = run_phase(tenancy=True, probe=True, phase_timeout=timeout_s)
+    ratio = tenant["trials_per_sec"] / base["trials_per_sec"]
+    if not smoke:
+        assert ratio >= 0.9, (
+            f"tenancy plane costs too much: {ratio:.2f}x of the baseline "
+            f"({base['trials_per_sec']:.2f} -> {tenant['trials_per_sec']:.2f} "
+            "trials/s; >= 0.9x required)"
+        )
+    starved_trials = sum(
+        1 for (name, _x) in tenant["epochs_by"] if name.startswith(f"{starved}--")
+    )
+    assert starved_trials > 0, f"starved tenant {starved} made no progress"
+
+    # phase C: the tenant fleet through a mid-run replica SIGKILL
+    chaos = run_phase(tenancy=True, kill=True, phase_timeout=timeout_s)
+    lost = {
+        k: v for k, v in chaos["epochs_by"].items()
+        if v != list(range(1, epochs + 1))
+    }
+    assert not lost, f"lost/duplicated observations after failover: {lost}"
+    assert chaos["scores_by"] == tenant["scores_by"], (
+        "failed-over tenant rows are not bit-identical to the fault-free run"
+    )
+    for phase in (base, tenant, chaos):
+        shutil.rmtree(phase["root"], ignore_errors=True)
+    return {
+        "tenants": n_tenants,
+        "experiments_per_tenant": exps_per_tenant,
+        "trials_per_experiment": n_trials,
+        "epochs": epochs,
+        "replicas": n_replicas,
+        "trials_per_sec_baseline": round(base["trials_per_sec"], 3),
+        "trials_per_sec_tenancy": round(tenant["trials_per_sec"], 3),
+        "throughput_ratio": round(ratio, 3),
+        "throughput_floor": 0.9 if not smoke else None,
+        "starved_tenant": starved,
+        "starved_tenant_trials": starved_trials,
+        "probe_grants": tenant["grants"],
+        "cross_tenant_leaks": len(tenant["leaks"]),
+        "sigkill_victim": chaos["victim"],
+        "victim_experiments": len(chaos["victim_claims"]),
+        "lost_observations": len(lost),
+        "bit_identical": chaos["scores_by"] == tenant["scores_by"],
+        "smoke": smoke,
+    }
+
+
 def _bench_ingest_throughput(smoke: bool = False):
     """The thousands-of-concurrent-experiments ingest regime (ISSUE 16):
     thousands of experiments' streaming trials push observation rows at
@@ -4024,6 +4403,7 @@ OBSLOG_SCENARIOS = {
     "device_chaos_recovery": _bench_device_chaos_recovery,
     "controller_kill_recovery": _bench_controller_kill_recovery,
     "control_plane_scaling": _bench_control_plane_scaling,
+    "multi_tenant_scaling": _bench_multi_tenant_scaling,
     "ingest_throughput": _bench_ingest_throughput,
 }
 

@@ -772,6 +772,50 @@ def cmd_replicas(args) -> int:
     return 0
 
 
+def cmd_tenants(args) -> int:
+    """Offline tenant registry table (ISSUE 17): scoped tokens, quotas and
+    currently-claimed experiments, read straight from ``<root>/tenants/``
+    and ``<root>/placement/`` — no controller constructed (the `replicas`
+    CLI shape), so it works against a live multi-replica deployment."""
+    from .service.tenancy import TenantRegistry, claimed_experiments
+
+    reg = TenantRegistry(args.root)
+    records = reg.records()
+    if args.format == "json":
+        doc = []
+        for rec in records:
+            d = rec.to_doc()
+            if not args.show_tokens:
+                d["tokens"] = {s: "***" for s in d.get("tokens", {})}
+            d["claimedExperiments"] = claimed_experiments(args.root, rec.name)
+            doc.append(d)
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if not records:
+        print(f"no tenants registered under {args.root}/tenants "
+              "(create one with the TenantRegistry API)")
+        return 0
+    print(f"tenants ({len(records)}):")
+    _table(
+        ["TENANT", "SCOPES", "ADMIT/MIN", "MAX-EXP", "DEVICES", "WEIGHT",
+         "CLAIMED", "HISTORY"],
+        [
+            (
+                rec.name,
+                ",".join(sorted(rec.tokens)),
+                f"{rec.admission_per_minute:g}" if rec.admission_per_minute else "-",
+                rec.max_experiments or "-",
+                rec.device_quota if rec.device_quota is not None else "-",
+                f"{rec.fair_share_weight:g}",
+                len(claimed_experiments(args.root, rec.name)),
+                "shared" if rec.shared_history else "scoped",
+            )
+            for rec in records
+        ],
+    )
+    return 0
+
+
 def cmd_metrics(args) -> int:
     import os
 
@@ -1135,6 +1179,17 @@ def main(argv=None) -> int:
     )
     rp.add_argument("--format", choices=("text", "json"), default="text")
     rp.set_defaults(fn=cmd_replicas)
+
+    tp = sub.add_parser(
+        "tenants",
+        help="multi-tenant registry table (scopes, quotas, claimed "
+        "experiments), offline from <root>/tenants/",
+    )
+    tp.add_argument("--format", choices=("text", "json"), default="text")
+    tp.add_argument("--show-tokens", action="store_true",
+                    help="print raw token values in --format json "
+                    "(default: redacted)")
+    tp.set_defaults(fn=cmd_tenants)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -204,6 +204,16 @@ class RuntimeConfig:
     ingest_coalesce_window_seconds: float = 0.005
     # row-count bound that forces a drain regardless of the window
     ingest_coalesce_rows: int = 4096
+    # -- tenancy plane (service/tenancy.py, ISSUE 17): when True each
+    # replica binds a TenantRegistry (<root>/tenants/) and both wire
+    # planes resolve every request/HELLO to a tenant identity, enforce
+    # namespace isolation and per-tenant quotas. False (default) is
+    # byte-identical to the single-tenant plane.
+    tenancy: bool = False
+    # Postgres DSN for the pluggable observation store (db/dialects.py);
+    # unset keeps the SQLite dialect. Requires a Postgres driver
+    # (psycopg2/pg8000) in the environment.
+    pg_dsn: Optional[str] = None
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -266,6 +276,8 @@ ENV_OVERRIDES: Dict[str, str] = {
     "device_lease_seconds": "KATIB_TPU_DEVICE_LEASE_SECONDS",
     "device_heartbeat_timeout_seconds": "KATIB_TPU_DEVICE_HEARTBEAT_TIMEOUT_SECONDS",
     "device_failover": "KATIB_TPU_DEVICE_FAILOVER",
+    "tenancy": "KATIB_TPU_TENANCY",
+    "pg_dsn": "KATIB_TPU_PG_DSN",
 }
 
 _FALSY = ("0", "false", "off")

@@ -387,6 +387,11 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_ingest_frames_total": "Binary observation DATA frames accepted by the framed ingest plane.",
     "katib_ingest_batch_rows": "Observation rows landed per coalesced ingest group commit.",
     "katib_ingest_coalesce_depth": "Frames merged into the most recent coalesced ingest drain.",
+    # tenancy plane (ISSUE 17, service/tenancy.py) — per-tenant identity,
+    # isolation and quota enforcement on both wire planes
+    "katib_tenant_requests_total": "Wire requests admitted under a resolved tenant identity, by tenant.",
+    "katib_tenant_denied_total": "Cross-tenant or unauthorized wire requests rejected (403 / ERR frame), by tenant and plane.",
+    "katib_tenant_quota_refusals_total": "Experiment admissions refused with a tenant-tagged 429 (admission rate or max-experiments quota).",
 }
 
 
@@ -459,4 +464,7 @@ EVENT_CATALOG: Dict[str, str] = {
     # sharded control plane (ISSUE 15, controller/placement.py)
     "ReplicaJoined": "A controller replica registered with the shared root's placement plane and began claiming experiments.",
     "ReplicaFailedOver": "A replica took over a dead or expired peer's experiment placement (fence bumped) and recovered it from the shared root.",
+    # multi-tenant service tier (ISSUE 17, service/tenancy.py)
+    "AuthDisabled": "Server started with no auth token configured: every wire request is accepted as the break-glass admin identity.",
+    "TenantQuotaRefused": "An experiment admission was refused with a tenant-tagged 429 (admission rate or max-experiments quota exceeded).",
 }

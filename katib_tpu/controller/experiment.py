@@ -38,6 +38,7 @@ from ..db.state import ExperimentStateStore
 from ..db.store import (
     BufferedObservationStore,
     ObservationStore,
+    SqlObservationStore,
     SqliteObservationStore,
     observation_available,
     open_store,
@@ -155,7 +156,9 @@ class ExperimentController:
                 ).acquire()
                 self.journal = RecoveryJournal(journal_dir(root_dir))
         store: ObservationStore = open_store(db_path, backend=rt.obslog_backend)
-        if rt.obslog_buffered and isinstance(store, SqliteObservationStore):
+        # SqlObservationStore covers every dialect behind the ISSUE 17 seam
+        # (SQLite and Postgres alike): the write-behind sits ABOVE the seam
+        if rt.obslog_buffered and isinstance(store, SqlObservationStore):
             # group-commit write-behind pipeline (docs/data-plane.md): the
             # in-process hot path enqueues instead of paying a per-report
             # commit. Subprocess env bindings and the native engine keep
@@ -168,6 +171,14 @@ class ExperimentController:
             )
         self.obs_store: ObservationStore = store
         self.db_path = db_path
+        # Tenancy plane (service/tenancy.py, ISSUE 17): the registry is only
+        # constructed when the knob is on, so every enforcement site reduces
+        # to `registry is None` and tenancy-off stays byte-identical.
+        self.tenants = None
+        if rt.tenancy and root_dir:
+            from ..service.tenancy import TenantRegistry
+
+            self.tenants = TenantRegistry(root_dir)
         from ..tracing import Tracer
 
         self.tracer = Tracer(
@@ -195,6 +206,7 @@ class ExperimentController:
             config=self.config,
             metrics=self.metrics,
             events=self.events,
+            tenants=self.tenants,
         )
         # add_collector, not set_collector: the telemetry sampler registered
         # its own gauge hook on the same registry

@@ -77,6 +77,19 @@ class ReplicaServer:
         )
         rt = self.config.runtime
         servicer = ApiServicer(store=self.controller.obs_store)
+        # tenancy plane (service/tenancy.py, ISSUE 17): the controller
+        # constructed the registry iff runtime.tenancy is on; both wire
+        # planes below resolve identities against it
+        tenants = self.controller.tenants
+        if self.auth_token is None:
+            # open deployment: every peer is the break-glass admin. Silent
+            # before ISSUE 17 — now a cataloged warning in the event stream.
+            self.controller.events.event(
+                "", "Replica", self.replica_id, "AuthDisabled",
+                f"replica {self.replica_id} serving without an auth token: "
+                "all wire requests are accepted as the break-glass admin",
+                warning=True,
+            )
         if rt.ingest_framed:
             # the framed ingest plane (ISSUE 16): a sibling binary port for
             # the hot observation-streaming path; the JSON server below
@@ -91,6 +104,7 @@ class ReplicaServer:
                 metrics=self.controller.metrics,
                 coalesce_window_s=rt.ingest_coalesce_window_seconds,
                 coalesce_rows=rt.ingest_coalesce_rows,
+                tenants=tenants,
             )
         self.manager = ReplicaManager(
             self.controller,
@@ -107,6 +121,7 @@ class ReplicaServer:
             replica_manager=self.manager,
             metrics=self.controller.metrics,
             auth_token=self.auth_token,
+            tenants=tenants,
         )
         self.manager.rpc_url = self.httpd.base_url
         if self.export_rpc_env:

@@ -170,12 +170,17 @@ class SuggestionService:
         config=None,
         metrics=None,
         events=None,
+        tenants=None,
     ):
         self.state = state
         self.obs_store = obs_store
         self.config = config  # KatibConfig; per-algorithm overrides (types.go)
         self.metrics = metrics
         self.events = events
+        # TenantRegistry (service/tenancy.py, ISSUE 17) or None: scopes the
+        # warm-start signature per tenant so transfer HPO can never cross a
+        # namespace (shared_history tenants opt into the global pool)
+        self.tenants = tenants
         # RLock: the consult/commit path holds it across suggester_for and
         # the search-end mark; the prefetch worker only takes it for buffer
         # swaps — never while computing — so inline fallbacks cannot
@@ -621,7 +626,7 @@ class SuggestionService:
 
             limit = int(getattr(rt, "warm_start_max_points", 256))
             rows = self.obs_store.matching_history(
-                warm_start_signature(exp.spec),
+                self._history_signature(exp),
                 exclude_experiment=exp.name,
                 limit=limit,
             )
@@ -651,6 +656,17 @@ class SuggestionService:
                 )
         return data
 
+    def _history_signature(self, exp: Experiment) -> str:
+        """The experiment's transfer-HPO index key: the PR 7 search-space
+        signature, tenant-scoped when a registry is bound (tenancy off or
+        an un-namespaced experiment keeps the plain signature, so the
+        single-tenant index stays byte-identical)."""
+        from ..service.tenancy import scoped_history_signature
+
+        return scoped_history_signature(
+            self.tenants, exp.name, warm_start_signature(exp.spec)
+        )
+
     def index_completed_history(self, exp: Experiment) -> None:
         """Write this experiment's completed observations into the
         transfer-HPO index (db/store.py experiment_history) keyed by
@@ -671,7 +687,7 @@ class SuggestionService:
                 x = space.encode(t.assignments)
                 points.append(([float(v) for v in x], float(t.objective)))
             self.obs_store.replace_experiment_history(
-                exp.name, warm_start_signature(exp.spec), points
+                exp.name, self._history_signature(exp), points
             )
         except Exception:
             log.debug("history indexing failed for %s", exp.name, exc_info=True)

@@ -1,0 +1,322 @@
+"""SQL dialect seam for the observation store (ISSUE 17).
+
+Upstream Katib fronts MySQL/Postgres behind the db-manager's
+``common/kdb.go`` interface; this module is the same seam one level
+lower: everything engine-specific about ``db/store.py`` — placeholder
+style, schema DDL, session setup, transaction begin, upsert spelling,
+and the busy/retry policy — lives behind :class:`SqlDialect`, so the
+group-commit write-behind (PR 3), the fold index, and the framed-ingest
+coalescing (PR 16) sit *above* the seam and never change per engine.
+
+Registered dialects:
+
+- ``sqlite`` — the default; byte-identical to the pre-seam store
+  (same pragmas, same DDL strings, same busy/retry behavior).
+- ``postgres`` — activated by ``KATIB_TPU_PG_DSN``; requires a driver
+  (psycopg2 or pg8000) already present in the environment — this repo
+  never installs one, so the dialect import-gates and raises a clear
+  error when the driver is missing. Conformance tests auto-skip.
+- ``fakepg`` — an in-process conformance double: ``format`` (%s)
+  paramstyle over a real SQLite file. Its connection REJECTS any
+  statement still containing ``?``, proving the store routes every
+  query through :meth:`SqlDialect.sql` rather than assuming qmark.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Callable, Dict, List, Optional, Sequence
+
+SQLITE_BUSY_TIMEOUT_MS = 10_000
+
+# the pre-seam schema, verbatim — SqliteDialect must keep emitting these
+# exact statements so existing observation.db files open unchanged
+_SQLITE_SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS observation_logs ("
+    " trial_name TEXT NOT NULL,"
+    " time REAL NOT NULL,"
+    " metric_name TEXT NOT NULL,"
+    " value TEXT NOT NULL)",
+    "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs(trial_name, time)",
+    # metric-filtered reads (medianstop's first-k objective rows, the
+    # CLI --metric tail) hit this instead of scanning the trial range
+    "CREATE INDEX IF NOT EXISTS idx_obs_trial_metric"
+    " ON observation_logs(trial_name, metric_name, time)",
+    # transfer-HPO index (ISSUE 10): completed observations keyed by
+    # search-space signature; x is the JSON unit-cube encoding
+    "CREATE TABLE IF NOT EXISTS experiment_history ("
+    " experiment TEXT NOT NULL,"
+    " signature TEXT NOT NULL,"
+    " time REAL NOT NULL,"
+    " x TEXT NOT NULL,"
+    " y REAL NOT NULL)",
+    "CREATE INDEX IF NOT EXISTS idx_hist_signature"
+    " ON experiment_history(signature, time)",
+)
+
+_POSTGRES_SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS observation_logs ("
+    " trial_name TEXT NOT NULL,"
+    " time DOUBLE PRECISION NOT NULL,"
+    " metric_name TEXT NOT NULL,"
+    " value TEXT NOT NULL)",
+    "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs(trial_name, time)",
+    "CREATE INDEX IF NOT EXISTS idx_obs_trial_metric"
+    " ON observation_logs(trial_name, metric_name, time)",
+    # seq replaces SQLite's implicit rowid as the deterministic
+    # matching_history tiebreaker
+    "CREATE TABLE IF NOT EXISTS experiment_history ("
+    " seq BIGSERIAL,"
+    " experiment TEXT NOT NULL,"
+    " signature TEXT NOT NULL,"
+    " time DOUBLE PRECISION NOT NULL,"
+    " x TEXT NOT NULL,"
+    " y DOUBLE PRECISION NOT NULL)",
+    "CREATE INDEX IF NOT EXISTS idx_hist_signature"
+    " ON experiment_history(signature, time)",
+)
+
+
+class SqlDialect:
+    """Everything the row store needs to know about one SQL engine.
+
+    The store writes every query in canonical qmark (``?``) style and
+    passes it through :meth:`sql` before execution; connections returned
+    by :meth:`connect` expose the sqlite3-style convenience surface
+    (``execute`` / ``executemany`` / ``commit`` / ``rollback`` /
+    ``close``) so the store body stays engine-free.
+    """
+
+    name: str = ""
+    paramstyle: str = "qmark"
+    # column expression breaking ORDER BY time ties deterministically in
+    # matching_history (insertion order)
+    history_tiebreaker: str = "rowid"
+
+    busy_retries: int = 5
+    busy_retry_sleep_s: float = 0.05
+
+    def connect(self):
+        raise NotImplementedError
+
+    def on_connect(self, conn) -> None:
+        """Per-connection session setup (pragmas, isolation)."""
+
+    def schema(self) -> Sequence[str]:
+        raise NotImplementedError
+
+    def sql(self, query: str) -> str:
+        """Translate a canonical qmark query to this engine's paramstyle."""
+        if self.paramstyle == "qmark":
+            return query
+        return query.replace("?", "%s")
+
+    def begin(self, conn) -> None:
+        """Open an explicit transaction for a group commit."""
+        conn.execute(self.sql("BEGIN"))
+
+    def is_busy(self, exc: BaseException) -> bool:
+        """True when the statement should be retried (writer contention)."""
+        return False
+
+    def upsert(self, table: str, cols: Sequence[str], key_cols: Sequence[str]) -> str:
+        """Canonical-qmark INSERT ... ON CONFLICT upsert for this engine
+        (both registered engines speak the ON CONFLICT spelling; a MySQL
+        dialect would override with ON DUPLICATE KEY UPDATE)."""
+        updates = ", ".join(
+            f"{c} = excluded.{c}" for c in cols if c not in key_cols
+        )
+        return (
+            f"INSERT INTO {table} ({', '.join(cols)})"
+            f" VALUES ({', '.join('?' for _ in cols)})"
+            f" ON CONFLICT ({', '.join(key_cols)}) DO UPDATE SET {updates}"
+        )
+
+
+class SqliteDialect(SqlDialect):
+    """The default engine — byte-identical to the pre-seam store."""
+
+    name = "sqlite"
+    paramstyle = "qmark"
+    history_tiebreaker = "rowid"
+
+    def __init__(self, path: str, busy_timeout_ms: Optional[int] = None):
+        self.path = path
+        self.busy_timeout_ms = busy_timeout_ms or SQLITE_BUSY_TIMEOUT_MS
+
+    def connect(self):
+        return sqlite3.connect(
+            self.path,
+            check_same_thread=False,
+            timeout=self.busy_timeout_ms / 1000.0,
+        )
+
+    def on_connect(self, conn) -> None:
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute(f"PRAGMA busy_timeout={self.busy_timeout_ms}")
+
+    def schema(self) -> Sequence[str]:
+        return _SQLITE_SCHEMA
+
+    def is_busy(self, exc: BaseException) -> bool:
+        if not isinstance(exc, sqlite3.OperationalError):
+            return False
+        msg = str(exc).lower()
+        return "locked" in msg or "busy" in msg
+
+
+class _TranslatingConnection:
+    """fakepg's connection: accepts ``format`` (%s) statements, executes
+    them on SQLite — and refuses qmark leftovers, so a store statement
+    that skipped ``dialect.sql()`` fails the conformance suite loudly."""
+
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+
+    def _translate(self, query: str) -> str:
+        if "?" in query:
+            raise AssertionError(
+                f"qmark placeholder reached a format-paramstyle dialect: {query!r}"
+            )
+        return query.replace("%s", "?")
+
+    def execute(self, query: str, args: Sequence = ()):
+        return self._conn.execute(self._translate(query), args)
+
+    def executemany(self, query: str, rows: Sequence[Sequence]):
+        return self._conn.executemany(self._translate(query), rows)
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def rollback(self) -> None:
+        self._conn.rollback()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class FakePostgresDialect(SqliteDialect):
+    """Conformance double: a ``format``-paramstyle engine over SQLite.
+
+    Exists so the dialect matrix exercises placeholder translation and
+    the seam contract in-process on every CI run, even where no real
+    Postgres (or driver) is available.
+    """
+
+    name = "fakepg"
+    paramstyle = "format"
+
+    def connect(self):
+        return _TranslatingConnection(super().connect())
+
+    def on_connect(self, conn) -> None:
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute(f"PRAGMA busy_timeout={self.busy_timeout_ms}")
+
+
+class _PgConnectionAdapter:
+    """DBAPI cursor-per-statement adapter giving psycopg2/pg8000
+    connections the sqlite3 convenience surface the store uses."""
+
+    def __init__(self, conn):
+        self._conn = conn
+
+    def execute(self, query: str, args: Sequence = ()):
+        cur = self._conn.cursor()
+        cur.execute(query, tuple(args))
+        return cur
+
+    def executemany(self, query: str, rows: Sequence[Sequence]):
+        cur = self._conn.cursor()
+        cur.executemany(query, [tuple(r) for r in rows])
+        return cur
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def rollback(self) -> None:
+        self._conn.rollback()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class PostgresDialect(SqlDialect):
+    """Postgres over an already-installed driver (psycopg2 or pg8000).
+
+    Activated by ``KATIB_TPU_PG_DSN``. The driver is import-gated: this
+    repo never installs dependencies, so a missing driver raises a
+    RuntimeError naming the knob instead of an ImportError at call depth.
+    """
+
+    name = "postgres"
+    paramstyle = "format"
+    history_tiebreaker = "seq"
+
+    def __init__(self, dsn: str):
+        self.dsn = dsn
+
+    @staticmethod
+    def driver():
+        try:
+            import psycopg2  # type: ignore
+
+            return "psycopg2", psycopg2
+        except ImportError:
+            pass
+        try:
+            import pg8000.dbapi  # type: ignore
+
+            return "pg8000", pg8000.dbapi
+        except ImportError:
+            return None, None
+
+    def connect(self):
+        name, mod = self.driver()
+        if mod is None:
+            raise RuntimeError(
+                "KATIB_TPU_PG_DSN is set but no Postgres driver "
+                "(psycopg2 or pg8000) is importable in this environment"
+            )
+        if name == "psycopg2":
+            return _PgConnectionAdapter(mod.connect(self.dsn))
+        # pg8000 takes keyword args; accept "key=value ..." DSNs
+        kwargs = {}
+        for part in self.dsn.split():
+            k, _, v = part.partition("=")
+            if k and v:
+                kwargs[{"dbname": "database"}.get(k, k)] = v
+        return _PgConnectionAdapter(mod.connect(**kwargs))
+
+    def on_connect(self, conn) -> None:
+        pass
+
+    def begin(self, conn) -> None:
+        # DBAPI connections open a transaction implicitly on first statement
+        pass
+
+    def schema(self) -> Sequence[str]:
+        return _POSTGRES_SCHEMA
+
+    def is_busy(self, exc: BaseException) -> bool:
+        text = f"{type(exc).__name__}: {exc}".lower()
+        return any(
+            key in text
+            for key in ("deadlock", "serialization", "lock timeout", "could not obtain lock")
+        )
+
+
+# -- registry ----------------------------------------------------------------
+
+DIALECTS: Dict[str, Callable[..., SqlDialect]] = {
+    "sqlite": SqliteDialect,
+    "fakepg": FakePostgresDialect,
+    "postgres": PostgresDialect,
+}
+
+
+def registered_dialects() -> List[str]:
+    return sorted(DIALECTS)

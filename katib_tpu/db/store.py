@@ -218,105 +218,77 @@ class InMemoryObservationStore(ObservationStore):
             self._history.pop(experiment, None)
 
 
-class SqliteObservationStore(ObservationStore):
-    """SQLite-WAL store; schema mirrors mysql.go observation_logs.
+class SqlObservationStore(ObservationStore):
+    """Row store over one :class:`~katib_tpu.db.dialects.SqlDialect`.
 
-    Hardened for CROSS-PROCESS multi-writer access (the sharded control
-    plane: N replica processes + their trial subprocesses share one db
-    file, each with its own connection — the "per-replica connection"
-    topology):
+    The store body is engine-free: every query is written in canonical
+    qmark style and routed through ``dialect.sql()``; schema DDL, session
+    setup, transaction begin, and the busy/retry predicate come from the
+    dialect (ISSUE 17's pluggable-store seam). Hardened for CROSS-PROCESS
+    multi-writer access (the sharded control plane: N replica processes +
+    their trial subprocesses share one engine, each with its own
+    connection):
 
-    - ``busy_timeout`` on every connection, so a write that lands while
-      another process holds the WAL write lock parks in SQLite's own busy
-      handler instead of raising ``SQLITE_BUSY`` instantly;
-    - a bounded retry loop (:meth:`_retry`) around every statement batch —
-      a genuinely saturated writer (or a reader holding the file past the
-      busy window) surfaces as a few jittered retries, not an exception
-      thrown through the BufferedObservationStore durability barrier.
+    - engine-side parking first (SQLite ``busy_timeout``, Postgres lock
+      waits), so a write that lands while another process holds the write
+      lock waits instead of failing instantly;
+    - a bounded retry loop (:meth:`_retry_locked`) around every statement
+      batch — a genuinely saturated writer surfaces as a few jittered
+      retries, not an exception thrown through the
+      BufferedObservationStore durability barrier.
     """
 
     BUSY_TIMEOUT_MS = 10_000
     BUSY_RETRIES = 5
     BUSY_RETRY_SLEEP_S = 0.05
 
-    def __init__(self, path: str, busy_timeout_ms: Optional[int] = None) -> None:
-        self.path = path
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
+    def __init__(self, dialect) -> None:
+        self.dialect = dialect
+        self.path = getattr(dialect, "path", None)
+        if self.path:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(
-            path,
-            check_same_thread=False,
-            timeout=(busy_timeout_ms or self.BUSY_TIMEOUT_MS) / 1000.0,
-        )
+        self._conn = dialect.connect()
         with self._lock:
-            self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.execute("PRAGMA synchronous=NORMAL")
-            self._conn.execute(
-                f"PRAGMA busy_timeout={busy_timeout_ms or self.BUSY_TIMEOUT_MS}"
-            )
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS observation_logs ("
-                " trial_name TEXT NOT NULL,"
-                " time REAL NOT NULL,"
-                " metric_name TEXT NOT NULL,"
-                " value TEXT NOT NULL)"
-            )
-            self._conn.execute(
-                "CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs(trial_name, time)"
-            )
-            # metric-filtered reads (medianstop's first-k objective rows, the
-            # CLI --metric tail) hit this instead of scanning the trial range
-            self._conn.execute(
-                "CREATE INDEX IF NOT EXISTS idx_obs_trial_metric"
-                " ON observation_logs(trial_name, metric_name, time)"
-            )
-            # transfer-HPO index (ISSUE 10): completed observations keyed by
-            # search-space signature; x is the JSON unit-cube encoding
-            self._conn.execute(
-                "CREATE TABLE IF NOT EXISTS experiment_history ("
-                " experiment TEXT NOT NULL,"
-                " signature TEXT NOT NULL,"
-                " time REAL NOT NULL,"
-                " x TEXT NOT NULL,"
-                " y REAL NOT NULL)"
-            )
-            self._conn.execute(
-                "CREATE INDEX IF NOT EXISTS idx_hist_signature"
-                " ON experiment_history(signature, time)"
-            )
+            self.dialect.on_connect(self._conn)
+            for stmt in self.dialect.schema():
+                self._conn.execute(stmt)
             self._conn.commit()
 
+    def _sql(self, query: str) -> str:
+        return self.dialect.sql(query)
+
     def _retry_locked(self, fn):
-        """Run one statement batch, retrying SQLITE_BUSY/locked errors with
-        linear backoff (caller holds ``self._lock``; the contention being
-        absorbed is CROSS-process — another replica's write transaction or
-        an external reader pinning the WAL). Anything else raises through."""
+        """Run one statement batch, retrying engine-busy errors
+        (``dialect.is_busy``) with linear backoff (caller holds
+        ``self._lock``; the contention being absorbed is CROSS-process —
+        another replica's write transaction or an external reader pinning
+        the engine). Anything else raises through."""
         last: Optional[BaseException] = None
         for attempt in range(self.BUSY_RETRIES):
             try:
                 return fn()
-            except sqlite3.OperationalError as e:
-                msg = str(e).lower()
-                if "locked" not in msg and "busy" not in msg:
+            except Exception as e:
+                if not self.dialect.is_busy(e):
                     raise
                 last = e
                 try:
                     self._conn.rollback()
-                except sqlite3.Error:
+                except Exception:
                     pass
                 time.sleep(self.BUSY_RETRY_SLEEP_S * (attempt + 1))
         raise last
 
     def report_observation_log(self, trial_name: str, logs: Sequence[MetricLog]) -> None:
         rows = [(trial_name, l.timestamp, l.metric_name, l.value) for l in logs]
+        q = self._sql(
+            "INSERT INTO observation_logs(trial_name, time, metric_name, value) VALUES (?,?,?,?)"
+        )
 
         def _write():
-            self._conn.executemany(
-                "INSERT INTO observation_logs(trial_name, time, metric_name, value) VALUES (?,?,?,?)",
-                rows,
-            )
+            self._conn.executemany(q, rows)
             self._conn.commit()
 
         with self._lock:
@@ -325,9 +297,9 @@ class SqliteObservationStore(ObservationStore):
     def report_many(self, entries: Sequence[Tuple[str, Sequence[MetricLog]]]) -> None:
         """Group commit: every trial's rows in ONE explicit transaction —
         one fsync for the whole drained batch instead of one per report.
-        SQLITE_BUSY (a concurrent replica's writer, an external reader)
-        retries the whole transaction rather than raising through the
-        buffered store's durability barrier."""
+        An engine-busy error (a concurrent replica's writer, an external
+        reader) retries the whole transaction rather than raising through
+        the buffered store's durability barrier."""
         rows = [
             (trial_name, l.timestamp, l.metric_name, l.value)
             for trial_name, logs in entries
@@ -335,15 +307,15 @@ class SqliteObservationStore(ObservationStore):
         ]
         if not rows:
             return
+        q = self._sql(
+            "INSERT INTO observation_logs(trial_name, time, metric_name, value)"
+            " VALUES (?,?,?,?)"
+        )
 
         def _write():
-            self._conn.execute("BEGIN")
+            self.dialect.begin(self._conn)
             try:
-                self._conn.executemany(
-                    "INSERT INTO observation_logs(trial_name, time, metric_name, value)"
-                    " VALUES (?,?,?,?)",
-                    rows,
-                )
+                self._conn.executemany(q, rows)
                 self._conn.commit()
             except BaseException:
                 self._conn.rollback()
@@ -376,25 +348,24 @@ class SqliteObservationStore(ObservationStore):
             q += " LIMIT ?"
             args.append(int(limit))
         with self._lock:
-            rows = self._conn.execute(q, args).fetchall()
+            rows = self._conn.execute(self._sql(q), args).fetchall()
         return [MetricLog(timestamp=r[0], metric_name=r[1], value=r[2]) for r in rows]
 
     def delete_observation_log(self, trial_name: str) -> None:
+        q = self._sql("DELETE FROM observation_logs WHERE trial_name = ?")
+
         def _write():
-            self._conn.execute(
-                "DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,)
-            )
+            self._conn.execute(q, (trial_name,))
             self._conn.commit()
 
         with self._lock:
             self._retry_locked(_write)
 
     def truncate_observation_log(self, trial_name: str, after_time: float) -> int:
+        q = self._sql("DELETE FROM observation_logs WHERE trial_name = ? AND time > ?")
+
         def _write():
-            cur = self._conn.execute(
-                "DELETE FROM observation_logs WHERE trial_name = ? AND time > ?",
-                (trial_name, after_time),
-            )
+            cur = self._conn.execute(q, (trial_name, after_time))
             self._conn.commit()
             return int(cur.rowcount or 0)
 
@@ -411,12 +382,15 @@ class SqliteObservationStore(ObservationStore):
         ]
         with self._lock:
             self._conn.execute(
-                "DELETE FROM experiment_history WHERE experiment = ?", (experiment,)
+                self._sql("DELETE FROM experiment_history WHERE experiment = ?"),
+                (experiment,),
             )
             if rows:
                 self._conn.executemany(
-                    "INSERT INTO experiment_history(experiment, signature, time, x, y)"
-                    " VALUES (?,?,?,?,?)",
+                    self._sql(
+                        "INSERT INTO experiment_history(experiment, signature, time, x, y)"
+                        " VALUES (?,?,?,?,?)"
+                    ),
                     rows,
                 )
             self._conn.commit()
@@ -429,12 +403,12 @@ class SqliteObservationStore(ObservationStore):
         if exclude_experiment is not None:
             q += " AND experiment != ?"
             args.append(exclude_experiment)
-        q += " ORDER BY time ASC, rowid ASC"
+        q += f" ORDER BY time ASC, {self.dialect.history_tiebreaker} ASC"
         if limit is not None:
             q += " LIMIT ?"
             args.append(int(limit))
         with self._lock:
-            rows = self._conn.execute(q, args).fetchall()
+            rows = self._conn.execute(self._sql(q), args).fetchall()
         return [
             HistoryPoint(experiment=r[0], x=[float(v) for v in _json.loads(r[1])], y=r[2])
             for r in rows
@@ -443,13 +417,27 @@ class SqliteObservationStore(ObservationStore):
     def delete_experiment_history(self, experiment: str) -> None:
         with self._lock:
             self._conn.execute(
-                "DELETE FROM experiment_history WHERE experiment = ?", (experiment,)
+                self._sql("DELETE FROM experiment_history WHERE experiment = ?"),
+                (experiment,),
             )
             self._conn.commit()
 
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+class SqliteObservationStore(SqlObservationStore):
+    """SQLite-WAL store; schema mirrors mysql.go observation_logs.
+
+    The historical default engine, now a one-line binding of
+    :class:`SqlObservationStore` to the SQLite dialect — same pragmas,
+    same DDL strings, same busy/retry behavior as before the seam."""
+
+    def __init__(self, path: str, busy_timeout_ms: Optional[int] = None) -> None:
+        from .dialects import SqliteDialect
+
+        super().__init__(SqliteDialect(path, busy_timeout_ms=busy_timeout_ms))
 
 
 class _FoldEntry:
@@ -863,12 +851,14 @@ def obs_db_path(root: Optional[str]) -> Optional[str]:
 def open_store(path: Optional[str], backend: str = "auto") -> ObservationStore:
     """Factory, reference pkg/db/v1beta1/db.go (driver selection by env).
 
-    backend: 'auto' (sqlite, or $KATIB_TPU_OBSLOG_BACKEND override),
-    'sqlite', 'memory', or 'native' (C++ engine, katib_tpu/native/obslog.cc —
+    backend: 'auto' (sqlite, or $KATIB_TPU_OBSLOG_BACKEND override;
+    $KATIB_TPU_PG_DSN promotes auto/sqlite to 'postgres'), 'sqlite',
+    'postgres' (db/dialects.py seam — requires an installed driver),
+    'memory', or 'native' (C++ engine, katib_tpu/native/obslog.cc —
     single-writer-process; subprocess trials must push via gRPC or stdout
     rather than opening the same file).
 
-    The controller wraps the SQLite store in BufferedObservationStore
+    The controller wraps the SQL store in BufferedObservationStore
     (ExperimentController, config runtime.obslog_buffered); subprocess env
     bindings and the native engine keep their direct-write paths.
     """
@@ -876,6 +866,11 @@ def open_store(path: Optional[str], backend: str = "auto") -> ObservationStore:
 
     if backend == "auto":
         backend = os.environ.get("KATIB_TPU_OBSLOG_BACKEND", "sqlite")
+    pg_dsn = os.environ.get("KATIB_TPU_PG_DSN", "")
+    if backend == "postgres" or (backend in ("auto", "sqlite") and pg_dsn):
+        from .dialects import PostgresDialect
+
+        return SqlObservationStore(PostgresDialect(pg_dsn))
     if path is None or backend == "memory":
         return InMemoryObservationStore()
     if backend == "native":

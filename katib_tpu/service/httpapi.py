@@ -52,6 +52,37 @@ log = logging.getLogger("katib_tpu.httpapi")
 ENV_RPC_URL = "KATIB_TPU_RPC_URL"
 ENV_RPC_TOKEN = "KATIB_TPU_RPC_TOKEN"
 
+# rpc methods a trial-writer scoped token may call (service/tenancy.py):
+# the observation report/read verbs a trial process needs. Everything
+# else — suggestions, early stopping, truncate/delete — is admin-scoped.
+_WRITER_METHODS = frozenset(
+    (
+        "ReportObservationLog",
+        "ReportManyObservationLogs",
+        "GetObservationLog",
+        "GetFoldedObservation",
+    )
+)
+
+
+def _rpc_resources(method: str, payload: Dict) -> List[str]:
+    """The tenant-owned resource names a method touches — trial names carry
+    their experiment's tenant prefix (suggest/base.py trial naming), so
+    ownership of every row reduces to a name check."""
+    if method == "ReportManyObservationLogs":
+        return [
+            str(e.get("trialName", ""))
+            for e in payload.get("entries", [])
+            if isinstance(e, dict)
+        ]
+    if "trialName" in payload:
+        return [str(payload["trialName"])]
+    exp = payload.get("experiment")
+    if isinstance(exp, dict) and exp.get("name"):
+        return [str(exp["name"])]
+    return []
+
+
 # api.proto service attribution for the {service=} metric labels
 _METHOD_SERVICE: Dict[str, str] = {
     "GetSuggestions": "Suggestion",
@@ -82,6 +113,8 @@ class _ApiHandler(BaseHTTPRequestHandler):
     replica_manager = None              # optional: claim/run hooks
     metrics = None                      # optional MetricsRegistry
     auth_token: Optional[str] = None    # None disables auth entirely
+    tenants = None                      # TenantRegistry; None = tenancy off
+    admission = None                    # AdmissionLimiter (set with tenants)
 
     # HTTP/1.1 => persistent connections: a trial process's pooled client
     # reuses one socket per replica instead of paying a TCP handshake per
@@ -101,18 +134,40 @@ class _ApiHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _peer_token(self) -> str:
+        supplied = self.headers.get("X-Katib-Token", "")
+        auth = self.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            supplied = auth[len("Bearer "):]
+        return supplied
+
     def _authorized(self) -> bool:
         if self.auth_token is None:
             return True
         import secrets
 
-        supplied = self.headers.get("X-Katib-Token", "")
-        auth = self.headers.get("Authorization", "")
-        if auth.startswith("Bearer "):
-            supplied = auth[len("Bearer "):]
         return secrets.compare_digest(
-            supplied.encode("utf-8", "replace"), self.auth_token.encode()
+            self._peer_token().encode("utf-8", "replace"), self.auth_token.encode()
         )
+
+    def _identity(self):
+        """Tenancy-mode identity resolution (service/tenancy.py): the
+        global token is the break-glass admin, tenant tokens resolve at
+        their minted scope, no-token is break-glass only when no global
+        token is configured. None = reject. Only consulted when a
+        TenantRegistry is bound."""
+        from .tenancy import resolve_wire_identity
+
+        return resolve_wire_identity(
+            self.tenants, self.auth_token, self._peer_token()
+        )
+
+    def _deny_tenant(self, tenant: Optional[str], plane: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "katib_tenant_denied_total",
+                tenant=tenant or "(unresolved)", plane=plane,
+            )
 
     def _record(self, service: str, method: str, t0: float, code: int) -> None:
         if self.metrics is None:
@@ -148,11 +203,24 @@ class _ApiHandler(BaseHTTPRequestHandler):
         if fn is None:
             self._record(service, method, t0, 404)
             return self._send({"error": f"unknown method {method!r}"}, code=404)
-        if not self._authorized():
-            self._record(service, method, t0, 403)
-            return self._send({"error": "missing or invalid auth token"}, code=403)
+        ident = None
+        if self.tenants is None:
+            if not self._authorized():
+                self._record(service, method, t0, 403)
+                return self._send({"error": "missing or invalid auth token"}, code=403)
+        else:
+            ident = self._identity()
+            if ident is None:
+                self._deny_tenant(None, "json")
+                self._record(service, method, t0, 403)
+                return self._send({"error": "missing or invalid auth token"}, code=403)
         try:
             payload = json.loads(body) if body else {}
+            if ident is not None:
+                err = self._tenant_gate(ident, method, payload)
+                if err is not None:
+                    self._record(service, method, t0, 403)
+                    return self._send(err, code=403)
             reply = fn(self.servicer, payload)
         except (ValueError, KeyError) as e:
             self._record(service, method, t0, 400)
@@ -163,13 +231,121 @@ class _ApiHandler(BaseHTTPRequestHandler):
         self._record(service, method, t0, 200)
         return self._send(reply)
 
+    def _tenant_gate(self, ident, method: str, payload: Dict) -> Optional[Dict]:
+        """Scope + namespace enforcement for one tenancy-mode rpc: an error
+        envelope (sent as 403) or None when admitted. Every resource the
+        method touches must live inside the caller's namespace — including
+        each entry of a ReportManyObservationLogs batch."""
+        from .tenancy import SCOPE_ADMIN
+
+        if method not in _WRITER_METHODS and not ident.allows(SCOPE_ADMIN):
+            self._deny_tenant(ident.tenant, "json")
+            return {
+                "error": f"scope {ident.scope!r} cannot call {method}",
+                "tenant": ident.tenant,
+            }
+        for name in _rpc_resources(method, payload):
+            if name and not ident.owns(name):
+                self._deny_tenant(ident.tenant, "json")
+                return {
+                    "error": (
+                        f"tenant {ident.tenant!r} does not own {name!r}"
+                        if ident.tenant
+                        else f"token does not grant access to {name!r}"
+                    ),
+                    "tenant": ident.tenant,
+                }
+        if self.metrics is not None and ident.tenant:
+            self.metrics.inc("katib_tenant_requests_total", tenant=ident.tenant)
+        return None
+
     # -- replica plane -------------------------------------------------------
+
+    def _quota_refused(self, tenant: str, name: str, why: str):
+        if self.metrics is not None:
+            self.metrics.inc("katib_tenant_quota_refusals_total", tenant=tenant)
+        ctrl = self.controller
+        if ctrl is not None and getattr(ctrl, "events", None) is not None:
+            ctrl.events.event(
+                name, "Tenant", tenant, "TenantQuotaRefused",
+                f"tenant {tenant} refused admission for {name}: {why}",
+                warning=True,
+            )
+        return {
+            "error": f"tenant {tenant!r} quota refused for {name!r}: {why}",
+            "tenant": tenant,
+        }, 429
+
+    def _tenant_admit_spec(self, ident, spec):
+        """Tenancy-mode admission for one experiment spec: namespace the
+        name under the caller's tenant, refuse quota overruns with a
+        tenant-tagged 429, and compile the tenant's quota envelope down
+        onto the fair-share engine (``fair_share_weight``,
+        ``device_quota`` — PR 2) before the replica claims capacity
+        (PR 15). Returns (error_payload, http_code) or None to admit;
+        break-glass admins pass through untouched."""
+        from . import tenancy as tn
+
+        if not ident.allows(tn.SCOPE_ADMIN):
+            self._deny_tenant(ident.tenant, "json")
+            return {
+                "error": f"scope {ident.scope!r} cannot create experiments",
+                "tenant": ident.tenant,
+            }, 403
+        if ident.tenant is None:
+            return None
+        owner = tn.tenant_of(spec.name)
+        if owner is None:
+            spec.name = tn.namespaced(ident.tenant, spec.name)
+        elif owner != ident.tenant:
+            self._deny_tenant(ident.tenant, "json")
+            return {
+                "error": f"tenant {ident.tenant!r} cannot create {spec.name!r} "
+                         f"(namespace owned by {owner!r})",
+                "tenant": ident.tenant,
+            }, 403
+        rec = self.tenants.load(ident.tenant)
+        if rec is None:
+            return None
+        if self.admission is not None and not self.admission.allow(
+            ident.tenant, rec.admission_per_minute
+        ):
+            return self._quota_refused(
+                ident.tenant, spec.name,
+                f"admission rate {rec.admission_per_minute:g}/min exceeded",
+            )
+        if rec.max_experiments > 0:
+            live = tn.claimed_experiments(self.tenants.root_dir, ident.tenant)
+            if spec.name not in live and len(live) >= rec.max_experiments:
+                return self._quota_refused(
+                    ident.tenant, spec.name,
+                    f"{len(live)}/{rec.max_experiments} concurrent experiments "
+                    "already placed",
+                )
+        if rec.fair_share_weight != 1.0:
+            spec.fair_share_weight = rec.fair_share_weight
+        if rec.device_quota is not None:
+            res = getattr(spec.trial_template, "resources", None)
+            if res is not None:
+                dq = getattr(res, "device_quota", None)
+                res.device_quota = (
+                    rec.device_quota if dq is None else min(dq, rec.device_quota)
+                )
+        return None
 
     def _create_experiment(self, body: str) -> None:
         t0 = time.perf_counter()
-        if not self._authorized():
-            self._record("Replica", "CreateExperiment", t0, 403)
-            return self._send({"error": "missing or invalid auth token"}, code=403)
+        ident = None
+        if self.tenants is None:
+            if not self._authorized():
+                self._record("Replica", "CreateExperiment", t0, 403)
+                return self._send({"error": "missing or invalid auth token"}, code=403)
+        else:
+            ident = self._identity()
+            if ident is None:
+                self._deny_tenant(None, "json")
+                self._record("Replica", "CreateExperiment", t0, 403)
+                return self._send({"error": "missing or invalid auth token"}, code=403)
         ctrl, mgr = self.controller, self.replica_manager
         if ctrl is None or mgr is None:
             self._record("Replica", "CreateExperiment", t0, 404)
@@ -186,6 +362,12 @@ class _ApiHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._record("Replica", "CreateExperiment", t0, 400)
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=400)
+        if ident is not None:
+            refused = self._tenant_admit_spec(ident, spec)
+            if refused is not None:
+                err, code = refused
+                self._record("Replica", "CreateExperiment", t0, code)
+                return self._send(err, code=code)
         if not mgr.claim_new(spec.name):
             # at capacity (or the experiment is already placed elsewhere):
             # the client router retries against another replica
@@ -218,9 +400,25 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            ident = None
+            if self.tenants is not None and path.startswith("/replica/"):
+                # router views are tenant-scoped too: a tenant token sees
+                # only its own placements, never another namespace's names
+                ident = self._identity()
+                if ident is None:
+                    self._deny_tenant(None, "json")
+                    return self._send(
+                        {"error": "missing or invalid auth token"}, code=403
+                    )
             mgr = self.replica_manager
             if path == "/replica/status" and mgr is not None:
-                return self._send(mgr.status())
+                doc = mgr.status()
+                if ident is not None and ident.tenant is not None:
+                    doc = dict(doc)
+                    doc["claimed"] = [
+                        n for n in doc.get("claimed", []) if ident.owns(n)
+                    ]
+                return self._send(doc)
             parts = path.split("/")
             if (
                 len(parts) == 4
@@ -228,6 +426,16 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 and parts[2] == "experiments"
                 and self.controller is not None
             ):
+                if ident is not None and not ident.owns(parts[3]):
+                    self._deny_tenant(ident.tenant, "json")
+                    return self._send(
+                        {
+                            "error": f"tenant {ident.tenant!r} does not own "
+                                     f"{parts[3]!r}",
+                            "tenant": ident.tenant,
+                        },
+                        code=403,
+                    )
                 exp = self.controller.state.get_experiment(parts[3])
                 if exp is None:
                     return self._send(
@@ -289,10 +497,21 @@ def serve_api(
     replica_manager=None,
     metrics=None,
     auth_token: Optional[str] = None,
+    tenants=None,
     block: bool = False,
 ) -> ThreadingHTTPServer:
     """Start the HTTP/JSON api server; returns the ThreadingHTTPServer with
-    ``.bound_port`` and ``.base_url`` set (port=0 lets the OS pick)."""
+    ``.bound_port`` and ``.base_url`` set (port=0 lets the OS pick).
+    ``tenants`` (a TenantRegistry) switches the wire into tenancy mode:
+    every request resolves to an identity, namespaces are enforced, and
+    experiment admission honors per-tenant quotas."""
+    admission = None
+    if tenants is not None:
+        from .tenancy import AdmissionLimiter
+
+        # replica-shared bucket files under the tenants dir: a refusal on
+        # one replica cannot be laundered by retrying against another
+        admission = AdmissionLimiter(shared_dir=tenants.dir)
     handler = type(
         "BoundApiHandler",
         (_ApiHandler,),
@@ -302,6 +521,8 @@ def serve_api(
             "replica_manager": replica_manager,
             "metrics": metrics,
             "auth_token": auth_token,
+            "tenants": tenants,
+            "admission": admission,
         },
     )
     httpd = _KeepAliveHTTPServer((host, port), handler)
@@ -465,6 +686,8 @@ class HttpApiClient:
         req = urllib.request.Request(
             f"{self.base_url}/replica/experiments/{name}", method="GET"
         )
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode())
@@ -477,6 +700,8 @@ class HttpApiClient:
 
     def replica_status(self) -> Optional[Dict]:
         req = urllib.request.Request(f"{self.base_url}/replica/status", method="GET")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read().decode())

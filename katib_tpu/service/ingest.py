@@ -206,7 +206,7 @@ def frames_from_buffer(buf: bytearray):
 
 
 class _Conn:
-    __slots__ = ("sock", "rbuf", "wbuf", "authed", "peer", "closing")
+    __slots__ = ("sock", "rbuf", "wbuf", "authed", "peer", "closing", "ident")
 
     def __init__(self, sock: socket.socket, peer) -> None:
         self.sock = sock
@@ -215,6 +215,7 @@ class _Conn:
         self.authed = False
         self.peer = peer
         self.closing = False  # flush wbuf, then close
+        self.ident = None     # tenancy mode: Identity resolved at HELLO
 
 
 class IngestServer:
@@ -245,9 +246,11 @@ class IngestServer:
         metrics=None,
         coalesce_window_s: float = 0.005,
         coalesce_rows: int = 4096,
+        tenants=None,
     ) -> None:
         self.store = store
         self.auth_token = auth_token
+        self.tenants = tenants  # TenantRegistry; None = tenancy off
         self.metrics = metrics
         self.coalesce_window_s = max(0.0, float(coalesce_window_s))
         self.coalesce_rows = max(1, int(coalesce_rows))
@@ -418,9 +421,32 @@ class IngestServer:
             conn.closing = True
             self._send(conn, encode_err(ERR_FRAME, str(e)))
 
+    def _deny_tenant(self, tenant: Optional[str]) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "katib_tenant_denied_total",
+                tenant=tenant or "(unresolved)", plane="framed",
+            )
+
     def _frame(self, conn: _Conn, ftype: int, payload: bytes) -> None:
         if ftype == F_HELLO:
-            if self.auth_token is not None:
+            if self.tenants is not None:
+                # tenancy mode (service/tenancy.py): the HELLO token resolves
+                # to an identity whose namespace every DATA entry must honor
+                from .tenancy import resolve_wire_identity
+
+                ident = resolve_wire_identity(
+                    self.tenants, self.auth_token, str(payload, "utf-8", "replace")
+                )
+                if ident is None:
+                    self._deny_tenant(None)
+                    conn.closing = True
+                    self._send(
+                        conn, encode_err(ERR_AUTH, "missing or invalid auth token")
+                    )
+                    return
+                conn.ident = ident
+            elif self.auth_token is not None:
                 import secrets
 
                 if not secrets.compare_digest(payload, self.auth_token.encode()):
@@ -437,7 +463,32 @@ class IngestServer:
                 conn.closing = True
                 self._send(conn, encode_err(ERR_AUTH, "HELLO with token required"))
                 return
+            if self.tenants is not None and conn.ident is None:
+                # no HELLO yet: resolve as an anonymous peer (break-glass
+                # only when no global token is configured)
+                from .tenancy import resolve_wire_identity
+
+                conn.ident = resolve_wire_identity(self.tenants, self.auth_token, "")
+                if conn.ident is None:
+                    self._deny_tenant(None)
+                    conn.closing = True
+                    self._send(conn, encode_err(ERR_AUTH, "HELLO with token required"))
+                    return
             seq, entries = decode_data_payload(payload)
+            if conn.ident is not None and conn.ident.tenant is not None:
+                for trial_name, _rows in entries:
+                    if not conn.ident.owns(trial_name):
+                        self._deny_tenant(conn.ident.tenant)
+                        conn.closing = True
+                        self._send(
+                            conn,
+                            encode_err(
+                                ERR_AUTH,
+                                f"tenant {conn.ident.tenant!r} does not own "
+                                f"{trial_name!r}",
+                            ),
+                        )
+                        return
             n_rows = sum(len(rows) for _, rows in entries)
             self._pending.append((conn, seq, entries, n_rows))
             self._pending_rows += n_rows

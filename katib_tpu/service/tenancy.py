@@ -1,0 +1,400 @@
+"""Tenancy plane — identity, scoped tokens, namespaces, and quotas.
+
+ISSUE 17 turns the single-credential control plane into a multi-tenant
+service tier. The pieces, in dependency order:
+
+- **TenantRegistry** — atomic JSON records under ``<root>/tenants/``,
+  shared by every replica the same way the placement registry is
+  (``controller/placement.py``): one file per tenant, written tmp +
+  ``os.replace`` so a crash mid-write never corrupts a record. Each
+  record mints one bearer token per scope (``admin``: every verb inside
+  the tenant's namespace, including create/delete/truncate; ``writer``:
+  report/read observation verbs only — the credential a trial process
+  carries). The single global ``auth_token`` stays as a *break-glass*
+  admin credential resolving to an unrestricted identity.
+
+- **Namespaces** — tenant ``acme`` owns every experiment named
+  ``acme--<rest>``. Tenant names are ``[a-z][a-z0-9]*`` (no dashes), so
+  the ``--`` separator is unambiguous under the experiment-name grammar
+  (``api/validation.py`` NAME_RE). Trial names derive from experiment
+  names (``suggest/base.py``), so observation-log rows and the
+  ``experiment_history`` warm-start index are namespaced transitively —
+  ownership of any resource reduces to a prefix check on its name.
+
+- **Quotas** — per-tenant admission rate (token bucket, refused with a
+  tenant-tagged 429, never silently queued) and concurrency/device caps
+  compiled down onto the existing engines: ``max_experiments`` is
+  checked against the tenant's live placement claims (PR 15) and
+  ``device_quota`` / ``fair_share_weight`` are stamped onto the spec so
+  the PR 2 fair-share scheduler enforces them unchanged.
+
+``KATIB_TPU_TENANCY`` unset keeps every wire path byte-identical to the
+single-tenant plane: the registry is simply never constructed, and all
+enforcement hangs off ``registry is None``.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import os
+import re
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+log = logging.getLogger("katib_tpu.tenancy")
+
+ENV_TENANCY = "KATIB_TPU_TENANCY"
+
+SCOPE_ADMIN = "admin"
+SCOPE_WRITER = "writer"
+SCOPES = (SCOPE_ADMIN, SCOPE_WRITER)
+
+# scopes are ordered: admin may do everything writer may
+_SCOPE_RANK = {SCOPE_WRITER: 0, SCOPE_ADMIN: 1}
+
+SEP = "--"
+# no dashes in tenant names — keeps "<tenant>--<experiment>" unambiguous
+TENANT_RE = re.compile(r"^[a-z][a-z0-9]*$")
+
+TENANTS_DIRNAME = "tenants"
+
+
+def namespaced(tenant: str, name: str) -> str:
+    """The canonical resource name for ``name`` inside ``tenant``."""
+    return f"{tenant}{SEP}{name}"
+
+
+def tenant_of(name: str) -> Optional[str]:
+    """The owning tenant encoded in a resource name, or None for names
+    outside any tenant namespace (single-tenant / pre-tenancy rows)."""
+    head, sep, rest = name.partition(SEP)
+    if not sep or not rest:
+        return None
+    return head if TENANT_RE.match(head) else None
+
+
+@dataclass(frozen=True)
+class Identity:
+    """A resolved caller. ``tenant=None`` is the break-glass admin (the
+    global ``auth_token``, or an open deployment with auth disabled)."""
+
+    tenant: Optional[str]
+    scope: str = SCOPE_ADMIN
+
+    def owns(self, name: str) -> bool:
+        if self.tenant is None:
+            return True
+        return tenant_of(name) == self.tenant
+
+    def allows(self, scope: str) -> bool:
+        return _SCOPE_RANK.get(self.scope, -1) >= _SCOPE_RANK.get(scope, 1)
+
+
+BREAK_GLASS = Identity(tenant=None, scope=SCOPE_ADMIN)
+
+
+@dataclass
+class TenantRecord:
+    """One tenant: scoped tokens plus its quota envelope. ``0`` /
+    ``None`` quota fields mean unlimited."""
+
+    name: str
+    tokens: Dict[str, str] = field(default_factory=dict)  # scope -> token
+    admission_per_minute: float = 0.0
+    max_experiments: int = 0
+    device_quota: Optional[int] = None
+    fair_share_weight: float = 1.0
+    shared_history: bool = False
+    created_at: float = 0.0
+
+    def to_doc(self) -> dict:
+        doc = {
+            "name": self.name,
+            "tokens": dict(self.tokens),
+            "quota": {
+                "admissionPerMinute": self.admission_per_minute,
+                "maxExperiments": self.max_experiments,
+                "fairShareWeight": self.fair_share_weight,
+            },
+            "sharedHistory": self.shared_history,
+            "createdAt": self.created_at,
+        }
+        if self.device_quota is not None:
+            doc["quota"]["deviceQuota"] = self.device_quota
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "TenantRecord":
+        quota = doc.get("quota") or {}
+        return cls(
+            name=doc["name"],
+            tokens=dict(doc.get("tokens") or {}),
+            admission_per_minute=float(quota.get("admissionPerMinute", 0.0)),
+            max_experiments=int(quota.get("maxExperiments", 0)),
+            device_quota=(
+                int(quota["deviceQuota"]) if "deviceQuota" in quota else None
+            ),
+            fair_share_weight=float(quota.get("fairShareWeight", 1.0)),
+            shared_history=bool(doc.get("sharedHistory", False)),
+            created_at=float(doc.get("createdAt", 0.0)),
+        )
+
+
+class TenantRegistry:
+    """Replica-shared tenant records under ``<root>/tenants/``.
+
+    Reads are mtime-cached per file so the hot wire path (every RPC
+    resolves a token) stays cheap; writes go through tmp + os.replace so
+    concurrent replicas always see a whole record.
+    """
+
+    def __init__(self, root_dir: str):
+        self.root_dir = root_dir
+        self.dir = os.path.join(root_dir, TENANTS_DIRNAME)
+        self._lock = threading.Lock()
+        self._cache: Dict[str, tuple] = {}  # name -> (mtime, record)
+
+    # -- persistence ---------------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, f"{name}.json")
+
+    def save(self, rec: TenantRecord) -> TenantRecord:
+        os.makedirs(self.dir, exist_ok=True)
+        path = self._path(rec.name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rec.to_doc(), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        with self._lock:
+            self._cache.pop(rec.name, None)
+        return rec
+
+    def create(
+        self,
+        name: str,
+        *,
+        admission_per_minute: float = 0.0,
+        max_experiments: int = 0,
+        device_quota: Optional[int] = None,
+        fair_share_weight: float = 1.0,
+        shared_history: bool = False,
+    ) -> TenantRecord:
+        if not TENANT_RE.match(name):
+            raise ValueError(
+                f"invalid tenant name {name!r}: must match {TENANT_RE.pattern}"
+            )
+        if os.path.exists(self._path(name)):
+            raise ValueError(f"tenant {name!r} already exists")
+        rec = TenantRecord(
+            name=name,
+            tokens={scope: secrets.token_hex(16) for scope in SCOPES},
+            admission_per_minute=admission_per_minute,
+            max_experiments=max_experiments,
+            device_quota=device_quota,
+            fair_share_weight=fair_share_weight,
+            shared_history=shared_history,
+            created_at=time.time(),
+        )
+        return self.save(rec)
+
+    def load(self, name: str) -> Optional[TenantRecord]:
+        path = self._path(name)
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return None
+        with self._lock:
+            hit = self._cache.get(name)
+            if hit is not None and hit[0] == mtime:
+                return hit[1]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                rec = TenantRecord.from_doc(json.load(f))
+        except (OSError, ValueError, KeyError):
+            log.warning("unreadable tenant record %s", path, exc_info=True)
+            return None
+        with self._lock:
+            self._cache[name] = (mtime, rec)
+        return rec
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            self._cache.pop(name, None)
+        try:
+            os.remove(self._path(name))
+            return True
+        except OSError:
+            return False
+
+    def names(self) -> List[str]:
+        try:
+            entries = os.listdir(self.dir)
+        except OSError:
+            return []
+        return sorted(
+            e[: -len(".json")] for e in entries if e.endswith(".json")
+        )
+
+    def records(self) -> List[TenantRecord]:
+        return [r for r in (self.load(n) for n in self.names()) if r is not None]
+
+    # -- identity ------------------------------------------------------------
+
+    def resolve(self, token: str) -> Optional[Identity]:
+        """Map a presented bearer token to a tenant identity. Constant-time
+        comparison per token; the registry is small (one file per tenant),
+        and reads are mtime-cached."""
+        if not token:
+            return None
+        for rec in self.records():
+            for scope, minted in rec.tokens.items():
+                if minted and hmac.compare_digest(token, minted):
+                    if scope not in _SCOPE_RANK:
+                        continue
+                    return Identity(tenant=rec.name, scope=scope)
+        return None
+
+
+def resolve_wire_identity(
+    registry: Optional[TenantRegistry],
+    auth_token: Optional[str],
+    presented: Optional[str],
+) -> Optional[Identity]:
+    """Shared identity resolution for both wire planes (httpapi JSON and
+    the framed ingest HELLO) when tenancy is on.
+
+    - global ``auth_token`` match -> break-glass admin
+    - tenant token match -> that tenant's identity at the token's scope
+    - no token presented and no global token configured -> break-glass
+      (an open deployment is already fully open; the ``AuthDisabled``
+      startup event makes that visible)
+    - anything else -> None (reject)
+    """
+    if presented:
+        if auth_token and hmac.compare_digest(presented, auth_token):
+            return BREAK_GLASS
+        if registry is not None:
+            return registry.resolve(presented)
+        return None
+    if auth_token:
+        return None
+    return BREAK_GLASS
+
+
+class AdmissionLimiter:
+    """Per-tenant token bucket over ``admission_per_minute``. Burst is a
+    sixth of the per-minute rate (>= 1) so a tenant can land a small
+    batch instantly but cannot front-load its whole minute.
+
+    With ``shared_dir`` set (the tenants directory) the bucket state
+    lives in one flock-serialized file per tenant, so N replicas share
+    ONE budget — a client whose create was refused on replica A cannot
+    launder the refusal by retrying against replica B. Without it the
+    bucket is in-process (unit tests, single-replica controllers)."""
+
+    def __init__(self, shared_dir: Optional[str] = None, clock=time.monotonic):
+        self._dir = shared_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, List[float]] = {}  # tenant -> [tokens, at]
+
+    @staticmethod
+    def _refill(tokens: float, at: float, now: float, per_minute: float):
+        rate = per_minute / 60.0
+        burst = max(1.0, per_minute / 6.0)
+        return min(burst, tokens + (now - at) * rate)
+
+    def allow(self, tenant: str, per_minute: float) -> bool:
+        if per_minute <= 0:
+            return True
+        if self._dir is not None:
+            return self._allow_shared(tenant, per_minute)
+        now = self._clock()
+        burst = max(1.0, per_minute / 6.0)
+        with self._lock:
+            tokens, at = self._buckets.get(tenant, (burst, now))
+            tokens = self._refill(tokens, at, now, per_minute)
+            if tokens < 1.0:
+                self._buckets[tenant] = [tokens, now]
+                return False
+            self._buckets[tenant] = [tokens - 1.0, now]
+            return True
+
+    def _allow_shared(self, tenant: str, per_minute: float) -> bool:
+        import fcntl
+
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(self._dir, f"{tenant}.bucket")
+        burst = max(1.0, per_minute / 6.0)
+        # wall clock, not monotonic: the bucket is shared across processes
+        now = time.time()
+        with open(path, "a+", encoding="utf-8") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            f.seek(0)
+            try:
+                doc = json.loads(f.read() or "{}")
+            except ValueError:
+                doc = {}  # torn write: reset — a quota bucket, not a ledger
+            tokens = self._refill(
+                float(doc.get("tokens", burst)),
+                float(doc.get("at", now)),
+                now,
+                per_minute,
+            )
+            ok = tokens >= 1.0
+            if ok:
+                tokens -= 1.0
+            f.seek(0)
+            f.truncate()
+            f.write(json.dumps({"tokens": tokens, "at": now}))
+            return ok
+
+
+def claimed_experiments(root_dir: str, tenant: str) -> List[str]:
+    """The tenant's experiments currently holding a placement lease —
+    the PR 15 claim surface its ``max_experiments`` quota counts
+    against. Completed experiments release their slot."""
+    from ..controller import placement
+
+    try:
+        table = placement.placement_table(root_dir)
+    except Exception:
+        return []
+    out: List[str] = []
+    for lease in table.get("leases", []):
+        name = lease.get("experiment", "")
+        if tenant_of(name) != tenant:
+            continue
+        if lease.get("completed"):
+            continue
+        out.append(name)
+    return sorted(out)
+
+
+def scoped_history_signature(
+    registry: Optional[TenantRegistry], experiment_name: str, signature: str
+) -> str:
+    """Tenant-scope a warm-start signature (``controller/suggestion.py``).
+
+    With tenancy off (no registry) or for un-namespaced experiments the
+    signature passes through untouched — byte-identical single-tenant
+    behavior. A namespaced experiment reads/writes a tenant-prefixed
+    signature, so ``matching_history`` can never return another tenant's
+    rows; a tenant with ``shared_history`` opts into the global pool by
+    keeping the plain signature.
+    """
+    if registry is None:
+        return signature
+    tenant = tenant_of(experiment_name)
+    if tenant is None:
+        return signature
+    rec = registry.load(tenant)
+    if rec is not None and rec.shared_history:
+        return signature
+    return f"tenant:{tenant}:{signature}"

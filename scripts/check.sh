@@ -64,6 +64,14 @@ echo "== framed control-plane smoke (the same failover phases on the binary inge
 JAX_PLATFORMS=cpu KATIB_TPU_INGEST_FRAMED=1 python bench.py control_plane_scaling --smoke
 
 echo
+echo "== tenancy control-plane smoke (KATIB_TPU_TENANCY=1 armed under the failover phases: open deployment) =="
+JAX_PLATFORMS=cpu KATIB_TPU_TENANCY=1 python bench.py control_plane_scaling --smoke
+
+echo
+echo "== multi-tenant scaling smoke (per-tenant tokens/quotas, adversarial probe, SIGKILL zero-loss) =="
+JAX_PLATFORMS=cpu python bench.py multi_tenant_scaling --smoke
+
+echo
 echo "== ingest-throughput smoke (streamed observation rows: JSON wire vs framed plane + mid-stream SIGKILL) =="
 JAX_PLATFORMS=cpu python bench.py ingest_throughput --smoke
 

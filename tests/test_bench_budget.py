@@ -776,6 +776,29 @@ def test_control_plane_scaling_smoke_integrity(bench):
     assert out["speedup"] > 0
 
 
+def test_multi_tenant_scaling_smoke_integrity(bench):
+    """--smoke mode of the multi_tenant_scaling scenario (ISSUE 17): four
+    tenants drive namespaced experiments through 2 REAL replica
+    subprocesses with the tenancy plane armed — per-tenant tokens, shared
+    admission buckets, an adversarial cross-tenant probe (zero leaks), the
+    starved low-quota tenant still progressing, and a mid-run SIGKILL with
+    zero lost observations. The >= 0.9x throughput-vs-baseline assertion
+    belongs to the full-size (3-replica, 8-tenant) run; smoke pins the
+    wiring and the isolation invariants."""
+    out = bench._bench_multi_tenant_scaling(smoke=True)
+    assert out["smoke"] is True
+    assert out["replicas"] == 2
+    assert out["cross_tenant_leaks"] == 0
+    assert out["lost_observations"] == 0
+    assert out["bit_identical"] is True
+    assert out["starved_tenant_trials"] > 0
+    assert out["probe_grants"][out["starved_tenant"]] < max(
+        out["probe_grants"].values()
+    )
+    assert out["sigkill_victim"]
+    assert out["throughput_ratio"] > 0
+
+
 def test_ingest_throughput_smoke_integrity(bench):
     """--smoke mode of the ingest_throughput scenario (ISSUE 16): the same
     streaming workload lands once over the HTTP/JSON wire and once over

@@ -1,0 +1,628 @@
+"""Multi-tenant service tier (ISSUE 17): scoped tokens, namespace
+isolation, per-tenant quotas, and the tenancy-off byte-identity guarantee.
+
+Covers the tentpole's four layers plus the satellites:
+
+- the tenant registry (atomic JSON under ``<root>/tenants/``) minting
+  per-tenant scoped tokens, and ``resolve_wire_identity`` mapping every
+  presented token to an :class:`Identity` (break-glass admin included);
+- namespace enforcement at BOTH wire planes: the adversarial cross-tenant
+  suite — tenant A's token probing every B-owned verb over the HTTP/JSON
+  rpc surface (403) and the framed ingest plane (ERR_AUTH frame);
+- per-tenant quotas compiled onto the fair-share engine: admission-rate
+  and concurrent-experiment refusals as tenant-tagged 429s;
+- the mixed-writer dedup window: two tenants retrying identical batches
+  interleaved must each stay exactly-once without cross-talk;
+- ``KATIB_TPU_TENANCY`` off stays byte-identical to the PR 16 behavior
+  (seeded on-vs-off sweep);
+- the ``AuthDisabled`` warning event and the ``katib-tpu tenants`` CLI.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from katib_tpu.db.store import InMemoryObservationStore, MetricLog
+from katib_tpu.service import tenancy as tn
+
+TRIAL_MODULE = """\
+import time
+
+def run_trial(assignments, ctx):
+    x = float(assignments["x"])
+    for epoch in range(1, {epochs} + 1):
+        time.sleep({dwell})
+        ctx.report(score=x * (1.0 - 0.8 ** epoch), epoch=epoch)
+"""
+
+
+def _write_trial_module(root, epochs=2, dwell=0.02, name="ten_trial"):
+    with open(os.path.join(root, f"{name}.py"), "w") as f:
+        f.write(TRIAL_MODULE.format(epochs=epochs, dwell=dwell))
+
+
+def _spec(name, n_trials=2, parallel=2, module="ten_trial"):
+    step = 0.9 / max(n_trials - 1, 1)
+    return {
+        "name": name,
+        "parameters": [{
+            "name": "x", "parameterType": "double",
+            "feasibleSpace": {"min": "0.1", "max": "1.0", "step": repr(step)},
+        }],
+        "objective": {"type": "maximize", "objectiveMetricName": "score"},
+        "algorithm": {"algorithmName": "grid"},
+        "trialTemplate": {
+            "entryPoint": f"{module}:run_trial",
+            "trialParameters": [{"name": "x", "reference": "x"}],
+        },
+        "maxTrialCount": n_trials,
+        "parallelTrialCount": parallel,
+        "resumePolicy": "FromVolume",
+    }
+
+
+def _is_done(status_doc):
+    if not status_doc:
+        return False
+    return any(
+        c.get("type") in ("Succeeded", "Failed") and c.get("status")
+        for c in status_doc.get("status", {}).get("conditions", [])
+    )
+
+
+# -- namespace + identity -----------------------------------------------------
+
+
+class TestNamespace:
+    def test_namespaced_roundtrip(self):
+        assert tn.namespaced("acme", "exp1") == "acme--exp1"
+        assert tn.tenant_of("acme--exp1") == "acme"
+        assert tn.tenant_of("acme--exp1-trial-3") == "acme"
+        assert tn.tenant_of("plain-exp") is None
+        assert tn.tenant_of("") is None
+
+    def test_separator_is_unambiguous(self):
+        # tenant names admit no dashes, so the FIRST "--" always splits:
+        # experiment names with dashes cannot forge a namespace
+        assert tn.tenant_of("acme--a--b") == "acme"
+        assert tn.tenant_of("my-exp--x") is None  # "my-exp" is no tenant
+
+    def test_identity_owns_and_allows(self):
+        a = tn.Identity("acme", tn.SCOPE_WRITER)
+        assert a.owns("acme--e1") and a.owns("acme--e1-t0")
+        assert not a.owns("globex--e1") and not a.owns("plain")
+        assert a.allows(tn.SCOPE_WRITER) and not a.allows(tn.SCOPE_ADMIN)
+        root = tn.BREAK_GLASS
+        assert root.owns("globex--e1") and root.owns("plain")
+        assert root.allows(tn.SCOPE_ADMIN)
+
+
+class TestRegistry:
+    def test_create_resolve_delete(self, tmp_path):
+        reg = tn.TenantRegistry(str(tmp_path))
+        rec = reg.create("acme", admission_per_minute=30, max_experiments=2)
+        assert set(rec.tokens) == {tn.SCOPE_ADMIN, tn.SCOPE_WRITER}
+        ident = reg.resolve(rec.tokens[tn.SCOPE_WRITER])
+        assert ident == tn.Identity("acme", tn.SCOPE_WRITER)
+        assert reg.resolve("no-such-token") is None
+        # a second registry over the same root sees the record (shared file)
+        reg2 = tn.TenantRegistry(str(tmp_path))
+        assert reg2.load("acme").max_experiments == 2
+        assert reg.delete("acme") and reg2.load("acme") is None
+
+    def test_invalid_and_duplicate_names(self, tmp_path):
+        reg = tn.TenantRegistry(str(tmp_path))
+        for bad in ("Acme", "1abc", "", "a-b", "a--b", "a_b"):
+            with pytest.raises(ValueError):
+                reg.create(bad)
+        reg.create("acme")
+        with pytest.raises(ValueError):
+            reg.create("acme")
+
+    def test_resolve_wire_identity_matrix(self, tmp_path):
+        reg = tn.TenantRegistry(str(tmp_path))
+        rec = reg.create("acme")
+        tok = rec.tokens[tn.SCOPE_ADMIN]
+        # global break-glass token wins over everything
+        assert tn.resolve_wire_identity(reg, "root", "root") is tn.BREAK_GLASS
+        # tenant token -> tenant identity
+        assert tn.resolve_wire_identity(reg, "root", tok).tenant == "acme"
+        # unknown token -> rejected
+        assert tn.resolve_wire_identity(reg, "root", "bogus") is None
+        # no token while a global token is configured -> rejected
+        assert tn.resolve_wire_identity(reg, "root", "") is None
+        # open deployment (no global token): anonymous IS the admin
+        assert tn.resolve_wire_identity(reg, None, "") is tn.BREAK_GLASS
+
+
+class TestAdmissionLimiter:
+    def test_token_bucket_rate(self):
+        now = [0.0]
+        lim = tn.AdmissionLimiter(clock=lambda: now[0])
+        # 60/min -> burst 10, refill 1/s
+        grants = sum(lim.allow("acme", 60.0) for _ in range(12))
+        assert grants == 10
+        now[0] += 2.0
+        assert lim.allow("acme", 60.0) and lim.allow("acme", 60.0)
+        assert not lim.allow("acme", 60.0)
+
+    def test_zero_rate_means_unlimited(self):
+        lim = tn.AdmissionLimiter(clock=lambda: 0.0)
+        assert all(lim.allow("acme", 0.0) for _ in range(100))
+
+    def test_shared_dir_is_one_budget_across_limiters(self, tmp_path):
+        # two limiters (two replicas) over one bucket dir: the budget is
+        # shared, so a refusal cannot be laundered by retrying elsewhere
+        a = tn.AdmissionLimiter(shared_dir=str(tmp_path))
+        b = tn.AdmissionLimiter(shared_dir=str(tmp_path))
+        assert a.allow("acme", 0.5)  # burst 1, refill 1/120s
+        assert not b.allow("acme", 0.5)
+        assert not a.allow("acme", 0.5)
+
+
+class TestScopedHistory:
+    def test_signature_scoping(self, tmp_path):
+        reg = tn.TenantRegistry(str(tmp_path))
+        reg.create("acme")
+        reg.create("globex", shared_history=True)
+        sig = "algo:grid|params:x"
+        # no registry / un-namespaced experiment: the plain signature
+        assert tn.scoped_history_signature(None, "acme--e1", sig) == sig
+        assert tn.scoped_history_signature(reg, "plain-e1", sig) == sig
+        # namespaced experiment: tenant-scoped (no cross-tenant warm starts)
+        assert (
+            tn.scoped_history_signature(reg, "acme--e1", sig)
+            == f"tenant:acme:{sig}"
+        )
+        # a tenant may opt INTO the shared pool
+        assert tn.scoped_history_signature(reg, "globex--e1", sig) == sig
+
+
+# -- adversarial cross-tenant suite: HTTP/JSON wire ---------------------------
+
+
+class TestJsonWireTenancy:
+    def _serve(self, tmp_path, auth_token="root-secret", metrics=None):
+        from katib_tpu.service.httpapi import serve_api
+        from katib_tpu.service.rpc import ApiServicer
+
+        reg = tn.TenantRegistry(str(tmp_path))
+        acme = reg.create("acme")
+        globex = reg.create("globex")
+        store = InMemoryObservationStore()
+        store.report_observation_log(
+            "globex--e1-t0", [MetricLog(1.0, "score", "0.5")]
+        )
+        store.report_observation_log(
+            "acme--e1-t0", [MetricLog(1.0, "score", "0.4")]
+        )
+        srv = serve_api(
+            ApiServicer(store=store),
+            auth_token=auth_token,
+            metrics=metrics,
+            tenants=reg,
+        )
+        return srv, store, acme, globex
+
+    def test_every_b_owned_verb_is_403_for_tenant_a(self, tmp_path):
+        """The adversarial probe: tenant A's ADMIN token against every
+        DBManager/Suggestion verb that names a B-owned resource."""
+        from katib_tpu.service.httpapi import HttpApiClient, RpcError
+
+        srv, store, acme, _ = self._serve(tmp_path)
+        try:
+            cli = HttpApiClient(
+                srv.base_url, token=acme.tokens[tn.SCOPE_ADMIN], retries=1
+            )
+            row = {"timestamp": 2.0, "metricName": "score", "value": "0.9"}
+            probes = [
+                ("GetObservationLog", {"trialName": "globex--e1-t0"}),
+                ("GetFoldedObservation",
+                 {"trialName": "globex--e1-t0", "metricNames": ["score"]}),
+                ("ReportObservationLog",
+                 {"trialName": "globex--e1-t0", "metricLogs": [row]}),
+                ("TruncateObservationLog",
+                 {"trialName": "globex--e1-t0", "afterTime": 0.0}),
+                ("DeleteObservationLog", {"trialName": "globex--e1-t0"}),
+                ("GetSuggestions",
+                 {"experiment": {"name": "globex--e1"}, "currentRequestNumber": 1}),
+            ]
+            for method, payload in probes:
+                with pytest.raises(RpcError) as ei:
+                    cli.call(method, payload)
+                assert ei.value.code == 403, method
+                assert "globex" in str(ei.value), method
+            # a mixed ReportMany batch smuggling ONE foreign row: the whole
+            # batch is refused, nothing lands (no partial cross-tenant write)
+            with pytest.raises(RpcError) as ei:
+                cli.call("ReportManyObservationLogs", {"entries": [
+                    {"trialName": "acme--e1-t1", "metricLogs": [row]},
+                    {"trialName": "globex--e1-t0", "metricLogs": [row]},
+                ]})
+            assert ei.value.code == 403
+            assert store.get_observation_log("acme--e1-t1") == []
+            assert len(store.get_observation_log("globex--e1-t0")) == 1
+            # B's rows survived every probe untouched
+            rows = store.get_observation_log("globex--e1-t0")
+            assert [(r.timestamp, r.value) for r in rows] == [(1.0, "0.5")]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_writer_scope_is_report_read_only(self, tmp_path):
+        from katib_tpu.service.httpapi import HttpApiClient, RpcError
+
+        srv, store, acme, _ = self._serve(tmp_path)
+        try:
+            cli = HttpApiClient(
+                srv.base_url, token=acme.tokens[tn.SCOPE_WRITER], retries=1
+            )
+            row = {"timestamp": 2.0, "metricName": "score", "value": "0.9"}
+            cli.call("ReportObservationLog",
+                     {"trialName": "acme--e1-t0", "metricLogs": [row]})
+            assert len(cli.call("GetObservationLog",
+                                {"trialName": "acme--e1-t0"})["metricLogs"]) == 2
+            # admin-only verbs refuse the writer scope even on OWN rows
+            for method, payload in [
+                ("TruncateObservationLog",
+                 {"trialName": "acme--e1-t0", "afterTime": 0.0}),
+                ("DeleteObservationLog", {"trialName": "acme--e1-t0"}),
+                ("GetSuggestions",
+                 {"experiment": {"name": "acme--e1"}, "currentRequestNumber": 1}),
+            ]:
+                with pytest.raises(RpcError) as ei:
+                    cli.call(method, payload)
+                assert ei.value.code == 403, method
+                assert "scope" in str(ei.value), method
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_token_resolution_and_break_glass(self, tmp_path):
+        from katib_tpu.controller.events import MetricsRegistry
+        from katib_tpu.service.httpapi import HttpApiClient, RpcError
+
+        metrics = MetricsRegistry()
+        srv, _, _, _ = self._serve(tmp_path, metrics=metrics)
+        try:
+            for bad_token in ("wrong", None):
+                bad = HttpApiClient(srv.base_url, token=bad_token, retries=1)
+                with pytest.raises(RpcError) as ei:
+                    bad.call("GetObservationLog", {"trialName": "acme--e1-t0"})
+                assert ei.value.code == 403
+            # the configured global token stays the break-glass admin:
+            # cross-tenant reads allowed (operator surface)
+            root = HttpApiClient(srv.base_url, token="root-secret", retries=1)
+            for trial in ("acme--e1-t0", "globex--e1-t0"):
+                logs = root.call("GetObservationLog", {"trialName": trial})
+                assert len(logs["metricLogs"]) == 1
+            rendered = metrics.render()
+            assert "katib_tenant_denied_total" in rendered
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_open_deployment_anonymous_is_admin(self, tmp_path):
+        # no global token configured: tenancy mode must not lock out the
+        # anonymous single-operator deployment (AuthDisabled makes it loud)
+        from katib_tpu.service.httpapi import HttpApiClient
+
+        srv, _, _, _ = self._serve(tmp_path, auth_token=None)
+        try:
+            anon = HttpApiClient(srv.base_url, retries=1)
+            logs = anon.call("GetObservationLog", {"trialName": "globex--e1-t0"})
+            assert len(logs["metricLogs"]) == 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_mixed_writer_dedup_window_stays_per_tenant(self, tmp_path):
+        """Two tenants retrying IDENTICAL-shaped batches interleaved: the
+        at-least-once duplicate drop must key per-trial, so each tenant
+        lands exactly-once and neither retry suppresses the other's rows."""
+        from katib_tpu.service.httpapi import HttpApiClient
+
+        srv, store, acme, globex = self._serve(tmp_path)
+        try:
+            a = HttpApiClient(srv.base_url, token=acme.tokens[tn.SCOPE_WRITER])
+            g = HttpApiClient(srv.base_url, token=globex.tokens[tn.SCOPE_WRITER])
+            rows = [{"timestamp": 5.0, "metricName": "score", "value": "0.7"},
+                    {"timestamp": 6.0, "metricName": "score", "value": "0.8"}]
+            a_batch = {"entries": [{"trialName": "acme--e2-t0",
+                                    "metricLogs": rows}]}
+            g_batch = {"entries": [{"trialName": "globex--e2-t0",
+                                    "metricLogs": rows}]}
+            # interleave first sends and retries of byte-identical batches
+            a.call("ReportManyObservationLogs", a_batch)
+            g.call("ReportManyObservationLogs", g_batch)
+            a.call("ReportManyObservationLogs", a_batch)  # A's retry
+            g.call("ReportManyObservationLogs", g_batch)  # G's retry
+            for trial in ("acme--e2-t0", "globex--e2-t0"):
+                got = store.get_observation_log(trial)
+                assert [(r.timestamp, r.value) for r in got] == [
+                    (5.0, "0.7"), (6.0, "0.8")
+                ], trial
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# -- adversarial cross-tenant suite: framed ingest plane ----------------------
+
+
+class TestFramedIngestTenancy:
+    def _serve(self, tmp_path, auth_token="root-secret"):
+        from katib_tpu.service.ingest import IngestServer
+
+        reg = tn.TenantRegistry(str(tmp_path))
+        acme = reg.create("acme")
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, auth_token=auth_token, tenants=reg)
+        return srv, store, acme
+
+    def test_cross_tenant_frame_is_err_auth(self, tmp_path):
+        from katib_tpu.service.ingest import FramedIngestClient, RpcError
+
+        srv, store, acme = self._serve(tmp_path)
+        try:
+            cli = FramedIngestClient(
+                srv.address, token=acme.tokens[tn.SCOPE_WRITER], retries=2
+            )
+            cli.report_many([("acme--e1-t0", [MetricLog(1.0, "m", "1")])])
+            with pytest.raises(RpcError) as ei:
+                cli.report_many([
+                    ("acme--e1-t1", [MetricLog(1.0, "m", "1")]),
+                    ("globex--e1-t0", [MetricLog(1.0, "m", "1")]),
+                ])
+            assert ei.value.code == 403
+            assert "globex--e1-t0" in str(ei.value)
+            # the refused frame landed NOTHING — not even its own-tenant rows
+            assert store.get_observation_log("acme--e1-t1") == []
+            assert store.get_observation_log("globex--e1-t0") == []
+            assert len(store.get_observation_log("acme--e1-t0")) == 1
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_bad_hello_token_rejected_immediately(self, tmp_path):
+        from katib_tpu.service.ingest import FramedIngestClient, RpcError
+
+        srv, store, _ = self._serve(tmp_path)
+        try:
+            bad = FramedIngestClient(srv.address, token="wrong", retries=8)
+            t0 = time.monotonic()
+            with pytest.raises(RpcError) as ei:
+                bad.report_many([("t", [MetricLog(1.0, "m", "1")])])
+            assert time.monotonic() - t0 < 2.0  # no backoff burn on 403
+            assert ei.value.code == 403
+            bad.close()
+            # the global token stays the break-glass writer
+            root = FramedIngestClient(srv.address, token="root-secret")
+            root.report_many([("globex--e1-t0", [MetricLog(1.0, "m", "1")])])
+            assert len(store.get_observation_log("globex--e1-t0")) == 1
+            root.close()
+        finally:
+            srv.close()
+
+    def test_open_deployment_anonymous_framed_writer(self, tmp_path):
+        from katib_tpu.service.ingest import FramedIngestClient
+
+        srv, store, _ = self._serve(tmp_path, auth_token=None)
+        try:
+            anon = FramedIngestClient(srv.address)
+            anon.report_many([("acme--e1-t0", [MetricLog(1.0, "m", "1")])])
+            assert len(store.get_observation_log("acme--e1-t0")) == 1
+            anon.close()
+        finally:
+            srv.close()
+
+
+# -- replica plane: quotas, router views, AuthDisabled ------------------------
+
+
+@pytest.mark.slow
+class TestReplicaTenancy:
+    def _config(self):
+        from katib_tpu.config import KatibConfig
+
+        cfg = KatibConfig()
+        cfg.runtime.replicas = 1
+        cfg.runtime.tenancy = True
+        cfg.runtime.telemetry = False
+        cfg.runtime.compile_service = False
+        cfg.runtime.tracing = False
+        cfg.runtime.placement_lease_seconds = 5.0
+        return cfg
+
+    def test_quotas_views_and_namespacing_end_to_end(self, tmp_path):
+        from katib_tpu.controller.replica import ReplicaServer
+        from katib_tpu.service.httpapi import HttpApiClient, RpcError
+
+        root = str(tmp_path)
+        _write_trial_module(root, epochs=3, dwell=0.25)
+        reg = tn.TenantRegistry(root)
+        acme = reg.create("acme", max_experiments=1)
+        globex = reg.create("globex", admission_per_minute=0.5)  # burst 1
+        sys.path.insert(0, root)
+        srv = ReplicaServer(
+            root_dir=root, replica_id="r0", devices=[0, 1],
+            auth_token="root-secret", config=self._config(),
+            export_rpc_env=False,
+        ).start()
+        try:
+            a = HttpApiClient(
+                srv.url, token=acme.tokens[tn.SCOPE_ADMIN], retries=1
+            )
+            g = HttpApiClient(
+                srv.url, token=globex.tokens[tn.SCOPE_ADMIN], retries=1
+            )
+            # bare names are auto-namespaced under the caller's tenant
+            created = a.create_experiment(_spec("wave", n_trials=2))
+            assert created["created"] == "acme--wave"
+            # concurrent-experiment quota: acme holds 1/1 placements
+            with pytest.raises(RpcError) as ei:
+                a.create_experiment(_spec("wave2", n_trials=2))
+            assert ei.value.code == 429
+            assert "tenant" in str(ei.value) and "acme" in str(ei.value)
+            # a writer-scoped token can never create experiments
+            w = HttpApiClient(
+                srv.url, token=acme.tokens[tn.SCOPE_WRITER], retries=1
+            )
+            with pytest.raises(RpcError) as ei:
+                w.create_experiment(_spec("wave3", n_trials=2))
+            assert ei.value.code == 403
+            # creating INTO a foreign namespace is refused outright
+            with pytest.raises(RpcError) as ei:
+                g.create_experiment(_spec("acme--intruder", n_trials=2))
+            assert ei.value.code == 403
+            # admission-rate quota: globex's bucket admits 1 then refuses
+            g.create_experiment(_spec("gwave", n_trials=2))
+            with pytest.raises(RpcError) as ei:
+                g.create_experiment(_spec("gwave2", n_trials=2))
+            assert ei.value.code == 429
+            assert "admission rate" in str(ei.value)
+            # router views are tenant-filtered: globex's status view never
+            # shows acme's claims; the break-glass operator sees both
+            rootc = HttpApiClient(srv.url, token="root-secret", retries=1)
+            st = rootc.replica_status()
+            assert "acme--wave" in st["claimed"]
+            assert "globex--gwave" in st["claimed"]
+            st = g.replica_status()
+            assert "acme--wave" not in st["claimed"]
+            assert "globex--gwave" in st["claimed"]
+            with pytest.raises(RpcError) as ei:
+                g.experiment_status("acme--wave")
+            assert ei.value.code == 403
+            # both experiments run to completion under their own namespaces
+            deadline = time.time() + 90
+            for name, cli in (("acme--wave", a), ("globex--gwave", g)):
+                while not _is_done(cli.experiment_status(name)):
+                    assert time.time() < deadline, f"{name} never completed"
+                    time.sleep(0.2)
+            # warm-start history was indexed under the TENANT-scoped
+            # signature: no cross-tenant transfer through the history pool
+            import sqlite3
+
+            con = sqlite3.connect(os.path.join(root, "observations.db"))
+            try:
+                sigs = dict(con.execute(
+                    "SELECT experiment, signature FROM experiment_history "
+                    "GROUP BY experiment, signature"
+                ).fetchall())
+            finally:
+                con.close()
+            assert sigs["acme--wave"].startswith("tenant:acme:")
+            assert sigs["globex--gwave"].startswith("tenant:globex:")
+        finally:
+            sys.path.remove(root)
+            srv.stop()
+
+    def test_auth_disabled_event_on_open_start(self, tmp_path):
+        from katib_tpu.controller.replica import ReplicaServer
+
+        srv = ReplicaServer(
+            root_dir=str(tmp_path), replica_id="r0", devices=[0],
+            auth_token=None, config=self._config(), export_rpc_env=False,
+        ).start()
+        try:
+            reasons = [
+                e.reason
+                for e in srv.controller.events.list_all(warning_only=True)
+            ]
+            assert "AuthDisabled" in reasons
+        finally:
+            srv.stop()
+
+
+# -- tenancy off: byte-identical to the pre-tenancy controller ----------------
+
+
+class TestTenancyOffIdentity:
+    def _run(self, root, tenancy):
+        from katib_tpu.api.spec import experiment_spec_from_mapping
+        from katib_tpu.config import KatibConfig
+        from katib_tpu.controller.experiment import ExperimentController
+        from katib_tpu.db.store import SqliteObservationStore
+
+        os.makedirs(root, exist_ok=True)
+        _write_trial_module(root, epochs=2, dwell=0.01)
+        sys.path.insert(0, root)
+        try:
+            cfg = KatibConfig()
+            cfg.runtime.tenancy = tenancy
+            cfg.runtime.telemetry = False
+            cfg.runtime.compile_service = False
+            cfg.runtime.tracing = False
+            ctrl = ExperimentController(root_dir=root, devices=[0, 1], config=cfg)
+            try:
+                ctrl.create_experiment(
+                    experiment_spec_from_mapping(_spec("seeded", n_trials=3))
+                )
+                exp = ctrl.run("seeded", timeout=60)
+                assert exp.status.is_succeeded
+            finally:
+                ctrl.close()
+        finally:
+            sys.path.remove(root)
+        from katib_tpu.db.state import ExperimentStateStore
+
+        state = ExperimentStateStore(os.path.join(root, "state"))
+        state.load("seeded")
+        store = SqliteObservationStore(os.path.join(root, "observations.db"))
+        try:
+            rows = {}
+            for t in state.list_trials("seeded"):
+                rows[t.assignments_dict()["x"]] = [
+                    (r.metric_name, r.value)
+                    for r in store.get_observation_log(t.name)
+                ]
+            return rows
+        finally:
+            store.close()
+
+    def test_seeded_sweep_identical_with_tenancy_flag(self, tmp_path):
+        """KATIB_TPU_TENANCY off must stay byte-identical to PR 16; and
+        flipping it ON without registering tenants must not perturb a
+        single observation row (the flag only arms the wire planes)."""
+        off = self._run(str(tmp_path / "off"), tenancy=False)
+        on = self._run(str(tmp_path / "on"), tenancy=True)
+        assert off == on
+        assert off, "seeded sweep produced no rows"
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestTenantsCli:
+    def test_tenants_table_and_json(self, tmp_path, capsys):
+        from katib_tpu.cli import main
+
+        root = str(tmp_path)
+        reg = tn.TenantRegistry(root)
+        reg.create("acme", admission_per_minute=60, max_experiments=4,
+                   device_quota=2)
+        reg.create("globex", fair_share_weight=2.0, shared_history=True)
+        assert main(["--root", root, "tenants"]) == 0
+        out = capsys.readouterr().out
+        assert "acme" in out and "globex" in out and "shared" in out
+        assert main(["--root", root, "tenants", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        by_name = {d["name"]: d for d in doc}
+        assert by_name["acme"]["quota"]["maxExperiments"] == 4
+        # tokens are redacted unless --show-tokens
+        assert set(by_name["acme"]["tokens"].values()) == {"***"}
+        assert main(
+            ["--root", root, "tenants", "--format", "json", "--show-tokens"]
+        ) == 0
+        doc = json.loads(capsys.readouterr().out)
+        by_name = {d["name"]: d for d in doc}
+        assert all(len(v) == 32 for v in by_name["acme"]["tokens"].values())
+
+    def test_empty_registry_message(self, tmp_path, capsys):
+        from katib_tpu.cli import main
+
+        assert main(["--root", str(tmp_path), "tenants"]) == 0
+        assert "no tenants registered" in capsys.readouterr().out
