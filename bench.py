@@ -636,14 +636,18 @@ def _bench_obslog_fold_latency(smoke: bool = False):
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _bench_tracing_overhead(smoke: bool = False):
+def _bench_tracing_overhead(smoke: bool = False, distributed: bool = False):
     """Trial lifecycle tracing (katib_tpu/tracing.py): end-to-end trials/sec
     of an in-process experiment with ``runtime.tracing`` on vs off. The
     target is <3% overhead when on and ~0% when off (off IS the
     KATIB_TPU_TRACING=0 path: every instrumentation site reduces to one
     boolean check). Runs interleaved on/off passes and keeps each side's
     best to shed scheduler noise on shared CI boxes. ``smoke`` trims the
-    trial count for the tier-1 wiring test (tests/test_bench_budget.py)."""
+    trial count for the tier-1 wiring test (tests/test_bench_budget.py).
+    ``distributed`` (``--distributed``) switches to the 3-replica wire
+    measurement instead (ISSUE 19)."""
+    if distributed:
+        return _bench_tracing_overhead_distributed(smoke)
     from katib_tpu.api.spec import (
         AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
         ObjectiveType, ParameterSpec, ParameterType, TrialTemplate,
@@ -727,6 +731,170 @@ def _bench_tracing_overhead(smoke: bool = False):
         "on_s": round(on, 4),
         "off_trials_per_s": round(n_trials / off, 1),
         "on_trials_per_s": round(n_trials / on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "target_pct": 3.0,
+        "within_target": overhead_pct < 3.0,
+        "smoke": smoke,
+    }
+
+
+def _bench_tracing_overhead_distributed(smoke: bool = False):
+    """Distributed tracing cost (ISSUE 19): the same cheap-experiment batch
+    driven through THREE real replica subprocesses over the wire, with the
+    whole distributed plane armed (KATIB_TPU_WIRE_TRACING=1 +
+    KATIB_TPU_TRACING=1: traceparent headers on every RPC, server-side rpc
+    spans, per-tenant SLO histograms, the durable wire span sink) vs both
+    knobs off. Target: <3% aggregate trials/sec cost. Uses the
+    control_plane_scaling harness shape — replica subprocesses, the
+    client-side placement router, subprocess trials reporting over the
+    wire — so the measured path IS the production wire path."""
+    import shutil
+    import tempfile
+
+    from katib_tpu.client.katib_client import ReplicaRouter
+
+    replicas = 3
+    n_exps = int(os.environ.get("BENCH_TRO_EXPERIMENTS", "3" if smoke else "9"))
+    n_trials = 2 if smoke else 4
+    epochs = 3 if smoke else 6
+    dwell = 0.02 if smoke else 0.05
+    parallel = 2 if smoke else 4
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def spec_for(name):
+        step = 0.9 / max(n_trials - 1, 1)
+        return {
+            "name": name,
+            "parameters": [{
+                "name": "x", "parameterType": "double",
+                "feasibleSpace": {"min": "0.1", "max": "1.0", "step": repr(step)},
+            }],
+            "objective": {"type": "maximize", "objectiveMetricName": "score"},
+            "algorithm": {"algorithmName": "grid"},
+            "trialTemplate": {
+                "entryPoint": "cp_trial:run_trial",
+                "trialParameters": [{"name": "x", "reference": "x"}],
+            },
+            "maxTrialCount": n_trials,
+            "parallelTrialCount": parallel,
+            "resumePolicy": "FromVolume",
+        }
+
+    def is_done(status_doc):
+        if not status_doc:
+            return False
+        return any(
+            c.get("type") in ("Succeeded", "Failed") and c.get("status")
+            for c in status_doc.get("status", {}).get("conditions", [])
+        )
+
+    def run_once(wire_on: bool) -> float:
+        root = tempfile.mkdtemp(prefix="bench-trace-dist-")
+        with open(os.path.join(root, "cp_trial.py"), "w") as f:
+            f.write(_CP_TRIAL_MODULE.format(epochs=epochs, dwell=dwell))
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": (
+                repo + os.pathsep + root + os.pathsep + env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep),
+            "KATIB_TPU_REPLICAS": str(replicas),
+            "KATIB_TPU_REPLICA_CAPACITY": str(n_exps + 4),
+            "KATIB_TPU_PLACEMENT_LEASE_SECONDS": "8",
+            "KATIB_TPU_TELEMETRY": "0",
+            "KATIB_TPU_COMPILE_SERVICE": "0",
+            "KATIB_TPU_OBSLOG_BUFFERED": "0",
+            "KATIB_TPU_TRACING": "1" if wire_on else "0",
+            "KATIB_TPU_WIRE_TRACING": "1" if wire_on else "0",
+        })
+        env.pop("KATIB_TPU_CHAOS", None)
+        procs, logs = [], []
+        deadline = time.time() + 420.0
+        try:
+            for i in range(replicas):
+                out = open(os.path.join(root, f"r{i}.log"), "w+")
+                logs.append(out)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "katib_tpu.controller.replica",
+                     "--root", root, "--replica-id", f"r{i}", "--devices", "4"],
+                    env=env, stdout=out, stderr=out, text=True,
+                ))
+            router = ReplicaRouter(root)
+            while len(router.live_replicas()) < replicas:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"replicas never registered; see {root}/r*.log"
+                    )
+                time.sleep(0.2)
+            warm = []
+            for i in range(replicas):
+                w = dict(spec_for(f"trace-warm-{i}"))
+                w["maxTrialCount"] = 1
+                w["parallelTrialCount"] = 1
+                router.create_experiment(w)
+                warm.append(f"trace-warm-{i}")
+            while not all(is_done(router.experiment_status(w)) for w in warm):
+                if time.time() > deadline:
+                    raise TimeoutError("warmup experiments never completed")
+                time.sleep(0.2)
+            names = [f"trace-{i:02d}" for i in range(n_exps)]
+            t0 = time.time()
+            for name in names:
+                router.create_experiment(spec_for(name))
+            pending = set(names)
+            while pending:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} experiment(s) never completed; "
+                        f"see {root}/r*.log"
+                    )
+                for name in list(pending):
+                    if is_done(router.experiment_status(name)):
+                        pending.discard(name)
+                time.sleep(0.15)
+            wall = time.time() - t0
+            if wire_on:
+                # the on side must actually have traced across the wire —
+                # a silently-dark plane would "win" the comparison
+                wdir = os.path.join(root, "traces", "wire")
+                assert os.path.isdir(wdir) and os.listdir(wdir), (
+                    "wire tracing on but no wire spans persisted under "
+                    f"{wdir}"
+                )
+            return wall
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs:
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            for out in logs:
+                out.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    passes = 1 if smoke else 2
+    on_s, off_s = [], []
+    for _ in range(passes):
+        off_s.append(run_once(False))
+        on_s.append(run_once(True))
+    on, off = min(on_s), min(off_s)
+    total = n_exps * n_trials
+    overhead_pct = (on - off) / off * 100.0
+    return {
+        "distributed": True,
+        "replicas": replicas,
+        "experiments": n_exps,
+        "trials": total,
+        "epochs": epochs,
+        "passes": passes,
+        "off_s": round(off, 3),
+        "on_s": round(on, 3),
+        "off_trials_per_s": round(total / off, 2),
+        "on_trials_per_s": round(total / on, 2),
         "overhead_pct": round(overhead_pct, 2),
         "target_pct": 3.0,
         "within_target": overhead_pct < 3.0,
@@ -2468,6 +2636,13 @@ def _bench_control_plane_scaling(smoke: bool = False):
     from katib_tpu.client.katib_client import ReplicaRouter
     from katib_tpu.db.state import ExperimentStateStore
     from katib_tpu.db.store import SqliteObservationStore
+    from katib_tpu.tracing import wire_tracing_from_env
+
+    # distributed tracing plane (ISSUE 19): with KATIB_TPU_WIRE_TRACING=1
+    # (+ KATIB_TPU_TRACING=1) in the ambient env, every phase runs traced —
+    # the harness then also scrapes the fleet's /metrics and asserts the
+    # per-tenant SLO series and cross-replica merged traces below
+    wire_tracing = wire_tracing_from_env()
 
     # full-mode shape: every experiment dispatches as ONE round (trials ==
     # parallel), so experiment wall == trial wall and the throughput ratio
@@ -2554,10 +2729,13 @@ def _bench_control_plane_scaling(smoke: bool = False):
             "KATIB_TPU_PLACEMENT_LEASE_SECONDS": str(lease_ttl),
             # replicas run lean: no telemetry/tracing/compile service, and
             # DIRECT per-report SQLite commits (obslog_buffered=0) so every
-            # acknowledged row is durable when the SIGKILL lands
+            # acknowledged row is durable when the SIGKILL lands. Tracing is
+            # a pass-through default (not a pin) so the distributed-trace
+            # smoke (scripts/check.sh, ISSUE 19) can arm
+            # KATIB_TPU_TRACING=1 KATIB_TPU_WIRE_TRACING=1 across the fleet
             "KATIB_TPU_TELEMETRY": "0",
             "KATIB_TPU_COMPILE_SERVICE": "0",
-            "KATIB_TPU_TRACING": "0",
+            "KATIB_TPU_TRACING": os.environ.get("KATIB_TPU_TRACING", "0"),
             "KATIB_TPU_OBSLOG_BUFFERED": "0",
         })
         env.pop("KATIB_TPU_CHAOS", None)
@@ -2654,6 +2832,20 @@ def _bench_control_plane_scaling(smoke: bool = False):
                             failover_seen[name] = time.time() - kill_time
                 time.sleep(0.25)
             wall = time.time() - t0
+            metrics_text = ""
+            if wire_tracing:
+                import urllib.request
+
+                for rep in router.table()["replicas"]:
+                    if not rep.get("alive") or not rep.get("url"):
+                        continue
+                    try:
+                        with urllib.request.urlopen(
+                            rep["url"].rstrip("/") + "/metrics", timeout=10
+                        ) as resp:
+                            metrics_text += resp.read().decode("utf-8", "replace")
+                    except OSError:
+                        pass
             total_trials = n_exps * n_trials
             failovers = 0
             if kill:
@@ -2683,6 +2875,7 @@ def _bench_control_plane_scaling(smoke: bool = False):
                 "victim_claims": sorted(victim_claims),
                 "failover_seconds": sorted(failover_seen.values()),
                 "failovers": failovers,
+                "metrics_text": metrics_text,
             }
         finally:
             for proc in procs.values():
@@ -2734,6 +2927,40 @@ def _bench_control_plane_scaling(smoke: bool = False):
     assert max_failover < lease_ttl, (
         f"failover took {max_failover:.1f}s (>= placement lease ttl {lease_ttl}s)"
     )
+
+    # distributed-trace smoke assertions (ISSUE 19): only when the ambient
+    # env armed wire tracing — the knob-off run stays byte-for-byte PR 17
+    cross_replica_traces = 0
+    if wire_tracing:
+        from katib_tpu.tracing import experiment_traces
+
+        assert (
+            "katib_rpc_latency_seconds" in scaled["metrics_text"]
+            and 'tenant="' in scaled["metrics_text"]
+        ), "wire tracing on but no per-tenant rpc latency series on /metrics"
+        if os.environ.get("KATIB_TPU_SLO_OBJECTIVES"):
+            assert "katib_slo_violations_total" in scaled["metrics_text"], (
+                "SLO objectives configured but no violation counter on /metrics"
+            )
+        for name in exp_names():
+            traces = experiment_traces(chaos["root"], name)
+            assert traces, (
+                f"no merged trace for experiment {name} with wire tracing on"
+            )
+        for name in chaos["victim_claims"]:
+            for t in experiment_traces(chaos["root"], name):
+                reps = set(t.get("replicas") or [])
+                if chaos["victim"] in reps and any(
+                    r != chaos["victim"] for r in reps
+                ):
+                    cross_replica_traces += 1
+                    break
+        if chaos["victim_claims"]:
+            assert cross_replica_traces >= 1, (
+                f"victim {chaos['victim']} held {chaos['victim_claims']} but "
+                "no experiment's merged trace covers both the victim and a "
+                "survivor replica"
+            )
     for phase in (ref, scaled, chaos):
         shutil.rmtree(phase["root"], ignore_errors=True)
     return {
@@ -2753,6 +2980,8 @@ def _bench_control_plane_scaling(smoke: bool = False):
         "failover_bound_seconds": lease_ttl,
         "lost_observations": len(lost),
         "bit_identical": chaos["scores_by"] == ref["scores_by"],
+        "wire_tracing": wire_tracing,
+        "cross_replica_traces": cross_replica_traces,
         "smoke": smoke,
     }
 
@@ -4412,7 +4641,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         child_main(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] in OBSLOG_SCENARIOS:
-        result = OBSLOG_SCENARIOS[sys.argv[1]](smoke="--smoke" in sys.argv[2:])
+        kwargs = {"smoke": "--smoke" in sys.argv[2:]}
+        if "--distributed" in sys.argv[2:]:
+            kwargs["distributed"] = True  # tracing_overhead only (ISSUE 19)
+        result = OBSLOG_SCENARIOS[sys.argv[1]](**kwargs)
         print(json.dumps({"metric": sys.argv[1], **result}))
     else:
         main()

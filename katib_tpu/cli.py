@@ -276,15 +276,74 @@ def cmd_importance(args) -> int:
     return 0
 
 
+def _write_perfetto(spans, out_path: str, label: str) -> int:
+    """Dump spans as a Chrome trace_event file (openable in
+    ui.perfetto.dev) — tmp + os.replace, the repo persistence idiom."""
+    import os
+
+    from .tracing import to_perfetto
+
+    doc = to_perfetto(spans, trace_name=f"katib-tpu {label}")
+    tmp = f"{out_path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    print(f"wrote {len(spans)} spans to {out_path} (open in ui.perfetto.dev)")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Trial lifecycle span tree (ISSUE 4 tentpole): where did this trial's
     wall-clock go — queue wait, compile, steps, checkpointing, flush
     barriers, preemption. Live from a running controller's trace endpoint
-    when --url is given; otherwise from the trace persisted at trial end."""
+    when --url is given; otherwise from the trace persisted at trial end,
+    merged with any cross-replica spans under <root>/traces/wire/ (the
+    distributed plane, ISSUE 19). Omit the trial for the experiment-level
+    view: every trial's trace, worst-first by root-span duration."""
     import os
 
-    from .tracing import Span, render_tree
+    from .tracing import Span, experiment_traces, merge_trace, render_tree
 
+    if args.trial is None:
+        if args.url:
+            print(
+                "experiment-level traces are read offline from --root; "
+                "drop --url (per-trial live traces still take --url)",
+                file=sys.stderr,
+            )
+            return 1
+        traces = experiment_traces(args.root, args.experiment)
+        if not traces:
+            print(
+                f"no traces for experiment {args.experiment!r} under "
+                f"{args.root}/traces (did it run with tracing on?)",
+                file=sys.stderr,
+            )
+            return 1
+        rows = []
+        for t in traces:
+            dur = t.get("rootDurationSeconds")
+            rows.append((
+                t.get("trial") or "?",
+                (t.get("traceId") or "?")[:16],
+                f"{dur:.3f}" if dur is not None else "-",
+                len(t.get("spans", [])),
+                ",".join(t.get("replicas") or []) or "-",
+            ))
+        _table(["TRIAL", "TRACE", "ROOT-SECONDS", "SPANS", "REPLICAS"], rows)
+        all_spans = [
+            Span.from_dict(s) for t in traces for s in t.get("spans", [])
+        ]
+        if args.format == "perfetto":
+            out = args.output or f"{args.experiment}.perfetto.json"
+            return _write_perfetto(all_spans, out, args.experiment)
+        for t in traces:
+            spans = [Span.from_dict(s) for s in t.get("spans", [])]
+            print()
+            print(f"{t.get('trial') or '?'} — trace {t.get('traceId', '?')} "
+                  f"({len(spans)} spans)")
+            print(render_tree(spans))
+        return 0
     if args.url:
         import urllib.error
         import urllib.request
@@ -310,11 +369,83 @@ def cmd_trace(args) -> int:
             return 1
         with open(path) as f:
             trace = json.load(f)
+        trace = merge_trace(args.root, trace)
     spans = [Span.from_dict(s) for s in trace.get("spans", [])]
-    print(f"trace {trace.get('traceId', '?')} — "
-          f"{args.experiment}/{args.trial} ({len(spans)} spans)")
+    label = f"{args.experiment}/{args.trial}"
+    if args.format == "perfetto":
+        out = args.output or f"{args.experiment}_{args.trial}.perfetto.json"
+        return _write_perfetto(spans, out, label)
+    replicas = ",".join(trace.get("replicas") or [])
+    print(f"trace {trace.get('traceId', '?')} — {label} ({len(spans)} spans"
+          + (f", replicas: {replicas}" if replicas else "") + ")")
     print(render_tree(spans))
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """Fleet status plane (ISSUE 19): one table over every REGISTERED
+    replica — liveness, claims, failovers, rpc/ingest counters and
+    per-tenant SLO standing — by fanning out to the live replicas'
+    /metrics and status endpoints from the placement registry. Dead
+    replicas stay visible (alive=no): a fleet view that hides the corpse
+    hides the incident."""
+    import time as _time
+
+    from .service.httpapi import fleet_snapshot
+
+    while True:
+        snap = fleet_snapshot(args.root, token=args.token)
+        rows = []
+        for r in snap["replicas"]:
+            m = r.get("metrics") or {}
+            slo = m.get("sloViolations") or {}
+            depth = m.get("ingestCoalesceDepth")
+            rows.append((
+                r.get("replica") or "?",
+                "up" if r.get("alive") else "DOWN",
+                r.get("pid") if r.get("pid") is not None else "-",
+                len(r.get("claimed") or []),
+                r.get("capacity") if r.get("capacity") is not None else "-",
+                r.get("failovers") if r.get("failovers") is not None else "-",
+                int(m["rpcRequests"]) if "rpcRequests" in m else "-",
+                int(m["ingestFrames"]) if "ingestFrames" in m else "-",
+                f"{depth:g}" if depth is not None else "-",
+                int(sum(slo.values())) if slo else ("-" if not m else 0),
+            ))
+        _table(
+            ["REPLICA", "STATE", "PID", "CLAIMED", "CAP", "FAILOVERS",
+             "RPCS", "FRAMES", "DEPTH", "SLO-VIOL"],
+            rows,
+        )
+        if not rows:
+            print(
+                f"(no replicas registered under {args.root}/placement/"
+                "replicas — is this the shared state root?)"
+            )
+        tenants = snap.get("tenants") or []
+        if tenants:
+            print()
+            _table(
+                ["TENANT", "CLAIMED", "MAX-EXP", "ADMIT/MIN", "DEVICES",
+                 "WEIGHT"],
+                [
+                    (
+                        t["tenant"], t["claimed"],
+                        t["maxExperiments"] if t["maxExperiments"] else "-",
+                        t["admissionPerMinute"] if t["admissionPerMinute"] else "-",
+                        t["deviceQuota"] if t["deviceQuota"] else "-",
+                        t["fairShareWeight"],
+                    )
+                    for t in tenants
+                ],
+            )
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        print()
 
 
 def cmd_top(args) -> int:
@@ -1046,14 +1177,45 @@ def main(argv=None) -> int:
         help="trial lifecycle span tree (durations + %% of trial wall-clock)",
     )
     tc.add_argument("experiment")
-    tc.add_argument("trial")
+    tc.add_argument(
+        "trial", nargs="?", default=None,
+        help="omit for the experiment-level view: every trial's trace, "
+        "worst-first by root-span duration (offline from --root)",
+    )
     tc.add_argument(
         "--url",
         default=None,
         help="base URL of a running 'katib-tpu ui' server for the live "
         "trace (else reads the persisted trace under <root>/traces/)",
     )
+    tc.add_argument(
+        "--format", choices=("tree", "perfetto"), default="tree",
+        help="'perfetto' dumps a Chrome trace_event file (ui.perfetto.dev) "
+        "instead of rendering the tree",
+    )
+    tc.add_argument(
+        "--output", default=None,
+        help="perfetto dump path (default <experiment>[_<trial>]"
+        ".perfetto.json in the working directory)",
+    )
     tc.set_defaults(fn=cmd_trace)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet status: every registered replica's liveness, claims, "
+        "rpc/ingest counters and per-tenant SLO standing in one table",
+    )
+    fl.add_argument(
+        "--token", default=None,
+        help="bearer token for the replicas' status endpoints (tenancy "
+        "deployments need an admin-scoped token)",
+    )
+    fl.add_argument(
+        "--watch", action="store_true",
+        help="refresh the table every --interval seconds until interrupted",
+    )
+    fl.add_argument("--interval", type=float, default=5.0)
+    fl.set_defaults(fn=cmd_fleet)
 
     tp = sub.add_parser(
         "top",

@@ -335,9 +335,19 @@ class ReplicaRouter:
     plain files on the shared root, exactly what `katib-tpu replicas`
     renders."""
 
-    def __init__(self, root_dir: str, token: Optional[str] = None):
+    def __init__(
+        self,
+        root_dir: str,
+        token: Optional[str] = None,
+        wire_tracing: Optional[bool] = None,
+    ):
         self.root_dir = root_dir
         self.token = token
+        # distributed tracing plane (ISSUE 19): None defers to the
+        # $KATIB_TPU_WIRE_TRACING env default inside HttpApiClient, so a
+        # router in a traced process stamps X-Katib-Traceparent on every
+        # routed call without the caller threading the knob explicitly
+        self.wire_tracing = wire_tracing
 
     def table(self) -> Dict[str, Any]:
         from ..controller.placement import placement_table
@@ -385,7 +395,7 @@ class ReplicaRouter:
     def _client(self, url: str):
         from ..service.httpapi import HttpApiClient
 
-        return HttpApiClient(url, token=self.token)
+        return HttpApiClient(url, token=self.token, wire_tracing=self.wire_tracing)
 
     def create_experiment(self, spec_mapping: Dict[str, Any]) -> Dict[str, Any]:
         """Route a spec to the least-loaded live replica; a 429 (capacity)

@@ -210,6 +210,23 @@ class RuntimeConfig:
     # namespace isolation and per-tenant quotas. False (default) is
     # byte-identical to the single-tenant plane.
     tenancy: bool = False
+    # -- distributed tracing plane (tracing.py + both wire planes, ISSUE
+    # 19): when True, W3C-style traceparent rides every POST /rpc/<Method>
+    # (X-Katib-Traceparent header) and framed ingest DATA frame, server
+    # side opens rpc/ingest/placement spans, and every completed span is
+    # appended durably under <root>/traces/wire/ keyed by trace id so
+    # cross-replica trees merge even after a replica SIGKILL. False
+    # (default) is byte-identical wire bytes and span set to the PR 17
+    # plane (asserted by a seeded on-vs-off test).
+    wire_tracing: bool = False
+    # per-method RPC latency objectives for the per-tenant SLO counter
+    # (katib_slo_violations_total): "default=0.5,CreateExperiment=2.0"
+    # seconds; empty = no objectives, the counter never increments
+    slo_objectives: str = ""
+    # slow-RPC flight recorder: the worst N requests (by latency) kept with
+    # their span trees, dumpable via GET /api/fleet/slow and SIGUSR2.
+    # 0 = recorder off even when wire_tracing is on.
+    slow_rpc_ring: int = 32
     # Postgres DSN for the pluggable observation store (db/dialects.py);
     # unset keeps the SQLite dialect. Requires a Postgres driver
     # (psycopg2/pg8000) in the environment.
@@ -277,6 +294,9 @@ ENV_OVERRIDES: Dict[str, str] = {
     "device_heartbeat_timeout_seconds": "KATIB_TPU_DEVICE_HEARTBEAT_TIMEOUT_SECONDS",
     "device_failover": "KATIB_TPU_DEVICE_FAILOVER",
     "tenancy": "KATIB_TPU_TENANCY",
+    "wire_tracing": "KATIB_TPU_WIRE_TRACING",
+    "slo_objectives": "KATIB_TPU_SLO_OBJECTIVES",
+    "slow_rpc_ring": "KATIB_TPU_SLOW_RPC_RING",
     "pg_dsn": "KATIB_TPU_PG_DSN",
 }
 

@@ -380,7 +380,10 @@ _HELP_CATALOG: Dict[str, str] = {
     # service/httpapi.py) — the ReplicaJoined / ReplicaFailedOver events
     # pair with these series
     "katib_rpc_requests_total": "Wire-protocol requests served, by api.proto service, method and status code.",
-    "katib_rpc_latency_seconds": "Wire-protocol request latency, by api.proto service.",
+    "katib_rpc_latency_seconds": "Wire-protocol request latency, by api.proto service (plus tenant= and method= labels when runtime.wire_tracing is on).",
+    # distributed tracing & fleet plane (ISSUE 19, tracing.py + both wire
+    # planes) — the TraceContextInvalid warning event pairs with these
+    "katib_slo_violations_total": "Wire requests whose latency exceeded the configured per-method objective (runtime.slo_objectives), by tenant and method.",
     "katib_replica_experiments": "Experiments currently placed on each replica (placement leases held).",
     # framed ingest plane (ISSUE 16, service/ingest.py) — the binary
     # observation-streaming sibling of the JSON DBManager wire
@@ -467,4 +470,6 @@ EVENT_CATALOG: Dict[str, str] = {
     # multi-tenant service tier (ISSUE 17, service/tenancy.py)
     "AuthDisabled": "Server started with no auth token configured: every wire request is accepted as the break-glass admin identity.",
     "TenantQuotaRefused": "An experiment admission was refused with a tenant-tagged 429 (admission rate or max-experiments quota exceeded).",
+    # distributed tracing plane (ISSUE 19, tracing.py + both wire planes)
+    "TraceContextInvalid": "A wire request carried a malformed or oversized traceparent (header or frame field); the context was ignored and the request served without it.",
 }

@@ -187,6 +187,16 @@ class ExperimentController:
             ring_size=rt.trace_ring_spans,
             persist_dir=os.path.join(root_dir, "traces") if root_dir else None,
         )
+        if rt.wire_tracing and root_dir:
+            # distributed tracing plane (ISSUE 19): every ended span is also
+            # appended durably under the SHARED root keyed by trace id, so a
+            # cross-replica trace merges into one tree even after this
+            # replica is SIGKILLed mid-trial
+            from ..tracing import WireSpanSink
+
+            from .placement import replica_id
+
+            self.tracer.attach_wire_sink(WireSpanSink(root_dir, replica_id()))
         from ..telemetry import ResourceSampler
 
         self.telemetry = ResourceSampler(

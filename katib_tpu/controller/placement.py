@@ -167,10 +167,16 @@ class ReplicaManager:
         lease_seconds: float = 10.0,
         scan_interval: float = 1.0,
         ingest_addr: str = "",
+        wire_tracing: bool = False,
     ):
         self.controller = controller
         self.replica_id = replica_id
         self.rpc_url = rpc_url
+        # distributed tracing plane (ISSUE 19): when on, claims and
+        # failovers land placement spans in the controller tracer, and a
+        # taken-over experiment's later spans are annotated with the bumped
+        # fence token — off (default) keeps the span set knob-off identical
+        self.wire_tracing = bool(wire_tracing)
         # framed ingest address ("host:port", service/ingest.py) when this
         # replica streams observations on a sibling binary port; "" on the
         # JSON-only wire — surfaced through the registry and status so
@@ -289,7 +295,18 @@ class ReplicaManager:
             lease.release()
         self._register()
 
+    def _tracer(self):
+        """The controller tracer, only when the wire-tracing knob is on
+        (placement spans are part of the gated distributed span set)."""
+        if not self.wire_tracing:
+            return None
+        tracer = getattr(self.controller, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return None
+        return tracer
+
     def _claim(self, experiment: str) -> Optional[ControllerLease]:
+        t0 = time.time()
         lease = ControllerLease(
             self._pdir,
             ttl_seconds=self.lease_seconds,
@@ -315,6 +332,13 @@ class ReplicaManager:
         with self._lock:
             self._leases[experiment] = lease
         self._register()
+        tracer = self._tracer()
+        if tracer is not None:
+            tracer.record_span(
+                "placement.claim", experiment, tracer.new_trace_id(), None,
+                start=t0, end=time.time(),
+                replica=self.replica_id, fence=lease.fence,
+            )
         return lease
 
     # -- run threads ---------------------------------------------------------
@@ -382,11 +406,24 @@ class ReplicaManager:
                 pass
             elif view.state == "active" and not view.expired and view.holder_alive:
                 continue  # live owner
+            t0 = time.time()
             lease = self._claim(name)
             if lease is None:
                 continue
             free -= 1
             self.failovers += 1
+            tracer = self._tracer()
+            if tracer is not None:
+                # every span the resumed experiment records from here on
+                # carries the bumped fence token — the takeover is visible
+                # in the merged cross-replica tree, not just the event log
+                tracer.annotate(name, fence=lease.fence, failedOverTo=self.replica_id)
+                tracer.record_span(
+                    "placement.failover", name, tracer.new_trace_id(), None,
+                    start=t0, end=time.time(),
+                    replica=self.replica_id, fence=lease.fence,
+                    takenFrom=view.payload.get("replica") or "",
+                )
             self.controller.events.event(
                 name, "Replica", self.replica_id, "ReplicaFailedOver",
                 f"replica {self.replica_id} took over experiment {name} "

@@ -105,6 +105,10 @@ class ReplicaServer:
                 coalesce_window_s=rt.ingest_coalesce_window_seconds,
                 coalesce_rows=rt.ingest_coalesce_rows,
                 tenants=tenants,
+                # distributed tracing plane (ISSUE 19): TDATA frames rejoin
+                # the caller's trace in the controller tracer
+                tracer=self.controller.tracer if rt.wire_tracing else None,
+                events=self.controller.events if rt.wire_tracing else None,
             )
         self.manager = ReplicaManager(
             self.controller,
@@ -112,6 +116,7 @@ class ReplicaServer:
             capacity=rt.replica_capacity,
             lease_seconds=rt.placement_lease_seconds,
             ingest_addr=self.ingest.address if self.ingest is not None else "",
+            wire_tracing=rt.wire_tracing,
         )
         self.httpd = serve_api(
             servicer,
@@ -122,6 +127,11 @@ class ReplicaServer:
             metrics=self.controller.metrics,
             auth_token=self.auth_token,
             tenants=tenants,
+            wire_tracing=rt.wire_tracing,
+            slo_objectives=rt.slo_objectives,
+            slow_rpc_ring=rt.slow_rpc_ring,
+            root_dir=self.root_dir,
+            replica_name=self.replica_id,
         )
         self.manager.rpc_url = self.httpd.base_url
         if self.export_rpc_env:
@@ -191,8 +201,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     def _stop(signum, frame):
         done.set()
 
+    def _dump_slow(signum, frame):
+        # slow-RPC flight recorder dump (ISSUE 19): same payload as
+        # GET /api/fleet/slow, but reachable when the wire is wedged
+        flight = getattr(server.httpd, "flight", None)
+        rows = flight.dump() if flight is not None else []
+        print(
+            json.dumps({"replica": server.replica_id, "slow": rows}),
+            file=sys.stderr, flush=True,
+        )
+
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2, _dump_slow)
     done.wait()
     server.stop()
     return 0

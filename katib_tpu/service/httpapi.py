@@ -115,6 +115,13 @@ class _ApiHandler(BaseHTTPRequestHandler):
     auth_token: Optional[str] = None    # None disables auth entirely
     tenants = None                      # TenantRegistry; None = tenancy off
     admission = None                    # AdmissionLimiter (set with tenants)
+    # distributed tracing plane (ISSUE 19) — all off by default so the
+    # knob-off wire stays byte-identical to the PR 17 plane
+    wire_tracing: bool = False          # runtime.wire_tracing
+    slo: Dict[str, float] = {}          # method -> latency objective seconds
+    flight = None                       # FlightRecorder (slow-RPC ring)
+    root_dir: Optional[str] = None      # shared state root (fleet fan-out)
+    replica_name: str = ""              # span attr for server-side spans
 
     # HTTP/1.1 => persistent connections: a trial process's pooled client
     # reuses one socket per replica instead of paying a TCP handshake per
@@ -170,21 +177,102 @@ class _ApiHandler(BaseHTTPRequestHandler):
             )
 
     def _record(self, service: str, method: str, t0: float, code: int) -> None:
-        if self.metrics is None:
-            return
-        self.metrics.inc(
-            "katib_rpc_requests_total",
-            service=service, method=method, code=str(code),
+        dt = time.perf_counter() - t0
+        tenant = getattr(self, "_req_tenant", "") or "default"
+        if self.metrics is not None:
+            self.metrics.inc(
+                "katib_rpc_requests_total",
+                service=service, method=method, code=str(code),
+            )
+            if self.wire_tracing:
+                # per-tenant SLO series (ISSUE 19): the latency histogram
+                # grows tenant=/method= labels, and a configurable objective
+                # feeds the violation counter. Knob off keeps the PR 17
+                # exposition byte-identical.
+                self.metrics.observe(
+                    "katib_rpc_latency_seconds", dt,
+                    service=service, method=method, tenant=tenant,
+                )
+                objective = self.slo.get(method, self.slo.get("default"))
+                if objective is not None and dt > objective:
+                    self.metrics.inc(
+                        "katib_slo_violations_total",
+                        tenant=tenant, method=method,
+                    )
+            else:
+                self.metrics.observe(
+                    "katib_rpc_latency_seconds", dt, service=service,
+                )
+        span = getattr(self, "_req_span", None)
+        tracer = getattr(self, "_req_tracer", None)
+        if span is not None and tracer is not None:
+            tracer.end_span(span, code=code, tenant=tenant)
+        if self.flight is not None:
+            spans = []
+            if span is not None and tracer is not None:
+                spans = [
+                    s.to_dict()
+                    for s in tracer.trace_spans("_rpc", span.trace_id)
+                ]
+            self.flight.record(
+                method, dt, tenant=tenant,
+                trace_id=span.trace_id if span is not None else "",
+                code=code, spans=spans,
+            )
+
+    def _tracer(self):
+        """The controller's tracer (wire-sink attached) when bound, else the
+        process tracer — server-side rpc spans must not vanish on a
+        servicer-only deployment."""
+        ctrl = self.controller
+        if ctrl is not None and getattr(ctrl, "tracer", None) is not None:
+            return ctrl.tracer
+        from ..tracing import default_tracer
+
+        return default_tracer()
+
+    def _wire_trace_ctx(self) -> Optional[Tuple[str, str]]:
+        """(trace_id, parent_id) from X-Katib-Traceparent. Malformed,
+        oversized or garbage values are ignored LOUDLY — a warning event —
+        and the request is still served (never a 500)."""
+        from ..tracing import (
+            MAX_TRACEPARENT_LEN, WIRE_TRACEPARENT_HEADER, parse_traceparent,
         )
-        self.metrics.observe(
-            "katib_rpc_latency_seconds", time.perf_counter() - t0,
-            service=service,
-        )
+
+        raw = self.headers.get(WIRE_TRACEPARENT_HEADER)
+        if raw is None:
+            return None
+        if len(raw) > MAX_TRACEPARENT_LEN:
+            self._trace_ctx_warn(f"oversized ({len(raw)} bytes)")
+            return None
+        ctx = parse_traceparent(raw)
+        if ctx is None:
+            self._trace_ctx_warn(f"malformed {raw[:64]!r}")
+            return None
+        return ctx
+
+    def _trace_ctx_warn(self, why: str) -> None:
+        ctrl = self.controller
+        events = getattr(ctrl, "events", None) if ctrl is not None else None
+        if events is not None:
+            events.event(
+                "_wire", "Rpc", self.replica_name or "api",
+                "TraceContextInvalid",
+                f"ignoring invalid wire trace context: {why}",
+                warning=True,
+            )
+        else:
+            log.warning("ignoring invalid wire trace context: %s", why)
 
     # -- /rpc dispatch -------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
         path = unquote(urlparse(self.path).path).rstrip("/")
+        # per-request scratch consumed by _record (instance-per-connection,
+        # requests on one keep-alive socket are sequential)
+        self._req_tenant = ""
+        self._req_span = None
+        self._req_tracer = None
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length).decode() if length else ""
@@ -195,9 +283,26 @@ class _ApiHandler(BaseHTTPRequestHandler):
             return self._send({"error": "not found"}, code=404)
         except Exception as e:  # pragma: no cover - defensive
             return self._send({"error": f"{type(e).__name__}: {e}"}, code=500)
+        finally:
+            # an exception path that skipped _record must still close the span
+            if self._req_span is not None and self._req_tracer is not None:
+                self._req_tracer.end_span(self._req_span)
+            self._req_span = self._req_tracer = None
 
     def _rpc(self, method: str, body: str) -> None:
         t0 = time.perf_counter()
+        if self.wire_tracing:
+            # server-side rpc span, parented under the caller's wire context
+            # when the X-Katib-Traceparent header carries a valid one
+            tracer = self._tracer()
+            ctx = self._wire_trace_ctx()
+            if tracer is not None and tracer.enabled:
+                trace_id, parent_id = ctx if ctx else (tracer.new_trace_id(), None)
+                self._req_tracer = tracer
+                self._req_span = tracer.start_span(
+                    f"rpc.{method}", "_rpc", trace_id, parent_id,
+                    attrs={"method": method, "replica": self.replica_name},
+                )
         service = _METHOD_SERVICE.get(method, "Api")
         fn = ApiServicer.METHODS.get(method)
         if fn is None:
@@ -214,6 +319,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 self._deny_tenant(None, "json")
                 self._record(service, method, t0, 403)
                 return self._send({"error": "missing or invalid auth token"}, code=403)
+            self._req_tenant = ident.tenant or ""
         try:
             payload = json.loads(body) if body else {}
             if ident is not None:
@@ -346,6 +452,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 self._deny_tenant(None, "json")
                 self._record("Replica", "CreateExperiment", t0, 403)
                 return self._send({"error": "missing or invalid auth token"}, code=403)
+            self._req_tenant = ident.tenant or ""
         ctrl, mgr = self.controller, self.replica_manager
         if ctrl is None or mgr is None:
             self._record("Replica", "CreateExperiment", t0, 404)
@@ -400,6 +507,32 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if path == "/api/fleet/slow":
+                if self.flight is None:
+                    return self._send(
+                        {"error": "flight recorder off (runtime.wire_tracing "
+                                  "disabled or slow_rpc_ring=0)"}, code=404
+                    )
+                return self._send({"slow": self.flight.dump()})
+            if path == "/api/fleet":
+                root = self.root_dir or getattr(self.controller, "root_dir", None)
+                if not root:
+                    return self._send(
+                        {"error": "no shared state root bound"}, code=404
+                    )
+                if self.tenants is not None:
+                    from .tenancy import SCOPE_ADMIN
+
+                    ident = self._identity()
+                    if ident is None or not ident.allows(SCOPE_ADMIN):
+                        self._deny_tenant(
+                            ident.tenant if ident else None, "json"
+                        )
+                        return self._send(
+                            {"error": "fleet view requires an admin token"},
+                            code=403,
+                        )
+                return self._send(fleet_snapshot(root, token=self.auth_token))
             ident = None
             if self.tenants is not None and path.startswith("/replica/"):
                 # router views are tenant-scoped too: a tenant token sees
@@ -499,12 +632,20 @@ def serve_api(
     auth_token: Optional[str] = None,
     tenants=None,
     block: bool = False,
+    wire_tracing: bool = False,
+    slo_objectives: str = "",
+    slow_rpc_ring: int = 32,
+    root_dir: Optional[str] = None,
+    replica_name: str = "",
 ) -> ThreadingHTTPServer:
     """Start the HTTP/JSON api server; returns the ThreadingHTTPServer with
     ``.bound_port`` and ``.base_url`` set (port=0 lets the OS pick).
     ``tenants`` (a TenantRegistry) switches the wire into tenancy mode:
     every request resolves to an identity, namespaces are enforced, and
-    experiment admission honors per-tenant quotas."""
+    experiment admission honors per-tenant quotas. ``wire_tracing`` arms the
+    distributed tracing plane (ISSUE 19): server-side rpc spans from the
+    X-Katib-Traceparent header, per-tenant SLO series, and the slow-RPC
+    flight recorder (``slow_rpc_ring`` worst requests, GET /api/fleet/slow)."""
     admission = None
     if tenants is not None:
         from .tenancy import AdmissionLimiter
@@ -512,6 +653,14 @@ def serve_api(
         # replica-shared bucket files under the tenants dir: a refusal on
         # one replica cannot be laundered by retrying against another
         admission = AdmissionLimiter(shared_dir=tenants.dir)
+    flight = None
+    slo: Dict[str, float] = {}
+    if wire_tracing:
+        from ..tracing import FlightRecorder, parse_slo_objectives
+
+        slo = parse_slo_objectives(slo_objectives)
+        if slow_rpc_ring > 0:
+            flight = FlightRecorder(slow_rpc_ring)
     handler = type(
         "BoundApiHandler",
         (_ApiHandler,),
@@ -523,12 +672,18 @@ def serve_api(
             "auth_token": auth_token,
             "tenants": tenants,
             "admission": admission,
+            "wire_tracing": wire_tracing,
+            "slo": slo,
+            "flight": flight,
+            "root_dir": root_dir,
+            "replica_name": replica_name,
         },
     )
     httpd = _KeepAliveHTTPServer((host, port), handler)
     httpd.bound_port = httpd.server_address[1]
     httpd.base_url = f"http://{host}:{httpd.bound_port}"
     httpd.auth_token = auth_token
+    httpd.flight = flight
     if block:
         httpd.serve_forever()
     else:
@@ -537,6 +692,125 @@ def serve_api(
         )
         t.start()
     return httpd
+
+
+# -- fleet status plane (ISSUE 19) -------------------------------------------
+
+# the metric families the fleet table folds per replica: total rpc traffic,
+# ingest plane activity, and the per-tenant SLO standing
+_FLEET_COUNTER_FAMILIES = (
+    "katib_rpc_requests_total",
+    "katib_ingest_frames_total",
+)
+
+
+def _metrics_summary(text: str) -> Dict[str, Any]:
+    """Fold one replica's Prometheus exposition into the fleet row: summed
+    rpc/ingest counters, the last coalesce depth, and per-tenant SLO
+    violation counts. Tolerant of any families it doesn't know."""
+    sums: Dict[str, float] = {}
+    slo: Dict[str, float] = {}
+    depth: Optional[float] = None
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, raw = line.rpartition(" ")
+        if not head:
+            continue
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        name = head.split("{", 1)[0]
+        if name in _FLEET_COUNTER_FAMILIES:
+            sums[name] = sums.get(name, 0.0) + value
+        elif name == "katib_ingest_coalesce_depth":
+            depth = value
+        elif name == "katib_slo_violations_total":
+            tenant = "default"
+            if "{" in head:
+                for part in head[head.index("{") + 1:-1].split(","):
+                    k, _, v = part.partition("=")
+                    if k == "tenant":
+                        tenant = v.strip('"')
+            slo[tenant] = slo.get(tenant, 0.0) + value
+    return {
+        "rpcRequests": sums.get("katib_rpc_requests_total", 0.0),
+        "ingestFrames": sums.get("katib_ingest_frames_total", 0.0),
+        "ingestCoalesceDepth": depth,
+        "sloViolations": slo,
+    }
+
+
+def _fetch_metrics_text(base_url: str, timeout: float) -> Optional[str]:
+    try:
+        with urllib.request.urlopen(
+            base_url.rstrip("/") + "/metrics", timeout=timeout
+        ) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+        return None
+
+
+def fleet_snapshot(
+    root_dir: str, token: Optional[str] = None, timeout: float = 5.0
+) -> Dict[str, Any]:
+    """One fleet-wide view (GET /api/fleet, ``katib-tpu fleet``): fan out to
+    every registered replica (placement registry) and merge live status,
+    /metrics, ingest depth, lease/claim state and tenant quota standing.
+    Dead replicas stay in the table flagged ``alive: false`` — a fleet view
+    that hides the corpse hides the incident."""
+    from ..controller.placement import placement_table
+
+    table = placement_table(root_dir)
+    replicas: List[Dict[str, Any]] = []
+    for rep in table.get("replicas", []):
+        row: Dict[str, Any] = {
+            "replica": rep.get("replica"),
+            "alive": bool(rep.get("alive")),
+            "pid": rep.get("pid"),
+            "url": rep.get("url"),
+            "ingest": rep.get("ingest"),
+            "capacity": rep.get("capacity"),
+            "claimed": list(rep.get("claimed", [])),
+            "ageSeconds": rep.get("ageSeconds"),
+            "failovers": None,
+            "metrics": None,
+        }
+        if row["alive"] and row["url"]:
+            client = HttpApiClient(
+                row["url"], token=token, timeout=timeout, retries=1
+            )
+            st = client.replica_status()
+            if st is not None:
+                row["claimed"] = list(st.get("claimed", row["claimed"]))
+                row["failovers"] = st.get("failovers")
+                row["ingest"] = st.get("ingest", row["ingest"])
+            text = _fetch_metrics_text(row["url"], timeout)
+            if text is not None:
+                row["metrics"] = _metrics_summary(text)
+        replicas.append(row)
+    tenants: List[Dict[str, Any]] = []
+    if os.path.isdir(os.path.join(root_dir, "tenants")):
+        from .tenancy import TenantRegistry, claimed_experiments
+
+        for rec in TenantRegistry(root_dir).records():
+            tenants.append(
+                {
+                    "tenant": rec.name,
+                    "admissionPerMinute": rec.admission_per_minute,
+                    "maxExperiments": rec.max_experiments,
+                    "deviceQuota": rec.device_quota,
+                    "fairShareWeight": rec.fair_share_weight,
+                    "claimed": len(claimed_experiments(root_dir, rec.name)),
+                }
+            )
+    return {
+        "root": root_dir,
+        "replicas": replicas,
+        "leases": table.get("leases", []),
+        "tenants": tenants,
+    }
 
 
 # -- client ------------------------------------------------------------------
@@ -595,6 +869,7 @@ class HttpApiClient:
         retries: int = DEFAULT_HTTP_RETRIES,
         backoff_base: float = DEFAULT_BACKOFF_BASE_S,
         backoff_cap: float = DEFAULT_BACKOFF_CAP_S,
+        wire_tracing: Optional[bool] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
@@ -602,6 +877,11 @@ class HttpApiClient:
         self.retries = max(1, int(retries))
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        if wire_tracing is None:
+            from ..tracing import wire_tracing_from_env
+
+            wire_tracing = wire_tracing_from_env()
+        self.wire_tracing = bool(wire_tracing)
         parsed = urlparse(self.base_url)
         self._netloc = parsed.netloc
         self._path_prefix = parsed.path.rstrip("/")
@@ -626,6 +906,14 @@ class HttpApiClient:
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self.wire_tracing:
+            # W3C-style context on every rpc POST (ISSUE 19); knob off sends
+            # the exact PR 17 header set — byte-identical wire bytes
+            from ..tracing import WIRE_TRACEPARENT_HEADER, current_traceparent
+
+            tp = current_traceparent()
+            if tp:
+                headers[WIRE_TRACEPARENT_HEADER] = tp
         last: Optional[BaseException] = None
         for attempt in range(self.retries):
             conn = _pool_get(self._netloc)
@@ -749,8 +1037,19 @@ class HttpRemoteObservationStore(ObservationStore):
             for t, logs in entries
             if logs
         ]
-        if batch:
-            self.client.call("ReportManyObservationLogs", {"entries": batch})
+        if not batch:
+            return
+        payload: Dict[str, Any] = {"entries": batch}
+        if self.client.wire_tracing:
+            # batch-level context (ISSUE 19 — the group-commit path lost its
+            # spans before this): the servicer fans it into every entry that
+            # doesn't carry its own (rpc.report_many_observation_logs)
+            from ..tracing import current_traceparent
+
+            tp = current_traceparent()
+            if tp:
+                payload["traceparent"] = tp
+        self.client.call("ReportManyObservationLogs", payload)
 
     def get_observation_log(
         self, trial_name, metric_name=None, start_time=None, end_time=None, limit=None

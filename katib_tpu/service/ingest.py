@@ -70,6 +70,16 @@ ENV_INGEST_ADDR = "KATIB_TPU_INGEST_ADDR"
 #                      code 1 = auth rejected   (client must not retry)
 #                      code 2 = malformed frame (client must not retry)
 #                      code 3 = store write failed (client reconnects+resends)
+#   TDATA   payload:   !H traceparent_len + traceparent utf-8, then the DATA
+#                      payload verbatim — the traced variant of DATA
+#                      (runtime.wire_tracing, ISSUE 19). Clients with the
+#                      knob off never emit TDATA, so the knob-off wire stays
+#                      byte-identical to the untraced protocol; servers
+#                      always accept both. A traceparent whose length field
+#                      overruns the payload is a torn frame (ERR code 2);
+#                      one that is in-bounds but content-invalid (regex
+#                      fail, oversized) is warned about and IGNORED — the
+#                      batch still lands, trace context is best-effort.
 #
 # The magic is versioned so JSON and framed clients can interoperate on one
 # port if a future revision multiplexes them: a JSON POST starts "PO", never
@@ -77,7 +87,7 @@ ENV_INGEST_ADDR = "KATIB_TPU_INGEST_ADDR"
 
 MAGIC = b"KF"
 VERSION = 1
-F_HELLO, F_DATA, F_ACK, F_ERR = 1, 2, 3, 4
+F_HELLO, F_DATA, F_ACK, F_ERR, F_TDATA = 1, 2, 3, 4, 5
 ERR_AUTH, ERR_FRAME, ERR_WRITE = 1, 2, 3
 
 _HEADER = struct.Struct("!2sBBI")
@@ -85,6 +95,7 @@ _DATA_HEAD = struct.Struct("!QI")
 _ENTRY_HEAD = struct.Struct("!HI")
 _ROW_HEAD = struct.Struct("!dHH")
 _SEQ = struct.Struct("!Q")
+_TP_HEAD = struct.Struct("!H")
 
 MAX_FRAME_BYTES = 8 * 1024 * 1024  # one group-commit batch, bounded
 
@@ -117,10 +128,15 @@ def encode_err(code: int, message: str) -> bytes:
 
 
 def encode_data_frame(
-    entries: Sequence[Tuple[str, Sequence[MetricLog]]], seq: int
+    entries: Sequence[Tuple[str, Sequence[MetricLog]]],
+    seq: int,
+    traceparent: Optional[str] = None,
 ) -> bytes:
     """One observation batch -> one DATA frame. Timestamps travel as raw
-    IEEE-754 doubles (bit-exact, NaN payloads and -0.0 included)."""
+    IEEE-754 doubles (bit-exact, NaN payloads and -0.0 included). With a
+    ``traceparent`` the frame travels as TDATA — trace context prefixed,
+    rows encoded identically; without one the bytes are exactly the
+    untraced protocol's (the wire_tracing-off byte-identity contract)."""
     parts = [_DATA_HEAD.pack(seq, len(entries))]
     for trial_name, logs in entries:
         t = trial_name.encode("utf-8")
@@ -138,7 +154,29 @@ def encode_data_frame(
             parts.append(_ROW_HEAD.pack(row.timestamp, len(n), len(v)))
             parts.append(n)
             parts.append(v)
-    return _frame(F_DATA, b"".join(parts))
+    if traceparent is None:
+        return _frame(F_DATA, b"".join(parts))
+    tp = traceparent.encode("utf-8")
+    if len(tp) > 0xFFFF:
+        raise FrameError(f"traceparent too long ({len(tp)} bytes)")
+    return _frame(F_TDATA, _TP_HEAD.pack(len(tp)) + tp + b"".join(parts))
+
+
+def decode_tdata_payload(payload: bytes) -> Tuple[str, bytes]:
+    """Split a TDATA payload into (traceparent, data_payload). Only the
+    length prefix is validated here — an overrunning prefix is a torn frame
+    (:class:`FrameError`); whether the traceparent CONTENT is a usable
+    trace context is the receiver's call (warn + ignore, never reject)."""
+    if len(payload) < _TP_HEAD.size:
+        raise FrameError("torn tdata frame: missing traceparent length")
+    (tp_len,) = _TP_HEAD.unpack_from(payload, 0)
+    if _TP_HEAD.size + tp_len > len(payload):
+        raise FrameError(
+            f"torn tdata frame: traceparent length {tp_len} overruns the "
+            f"{len(payload)}-byte payload"
+        )
+    tp = str(payload[_TP_HEAD.size:_TP_HEAD.size + tp_len], "utf-8", "replace")
+    return tp, payload[_TP_HEAD.size + tp_len:]
 
 
 def decode_data_payload(
@@ -247,11 +285,21 @@ class IngestServer:
         coalesce_window_s: float = 0.005,
         coalesce_rows: int = 4096,
         tenants=None,
+        tracer=None,
+        events=None,
     ) -> None:
         self.store = store
         self.auth_token = auth_token
         self.tenants = tenants  # TenantRegistry; None = tenancy off
         self.metrics = metrics
+        # distributed tracing plane (ISSUE 19): a replica running with
+        # runtime.wire_tracing passes its controller Tracer here — TDATA
+        # frames then land the same `rpc.report_observation_log` span the
+        # JSON receiver records, plus one `ingest.group_commit` span per
+        # contributing trace per drain. No tracer (the default) = the
+        # PR 16 span set, which is what knob-off byte-identity asserts.
+        self.tracer = tracer
+        self.events = events
         self.coalesce_window_s = max(0.0, float(coalesce_window_s))
         self.coalesce_rows = max(1, int(coalesce_rows))
         self._lsock = socket.create_server((host, port))
@@ -265,7 +313,11 @@ class IngestServer:
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
-        self._pending: List[Tuple[_Conn, int, List[Tuple[str, List[MetricLog]]], int]] = []
+        # (conn, seq, entries, n_rows, trace_ctx) — trace_ctx is the parsed
+        # (trace_id, parent_span_id) of a TDATA frame, None for plain DATA
+        self._pending: List[
+            Tuple[_Conn, int, List[Tuple[str, List[MetricLog]]], int, Optional[Tuple[str, str]]]
+        ] = []
         self._pending_rows = 0
         self._pending_since: Optional[float] = None
         self._closed = False
@@ -428,6 +480,34 @@ class IngestServer:
                 tenant=tenant or "(unresolved)", plane="framed",
             )
 
+    def _trace_ctx(self, conn: _Conn, tp: str) -> Optional[Tuple[str, str]]:
+        """Validate a TDATA traceparent. Invalid content is dropped LOUDLY
+        (warning event) but never rejects the frame — observability context
+        must not cost data."""
+        from ..tracing import MAX_TRACEPARENT_LEN, parse_traceparent
+
+        if not tp:
+            return None
+        if len(tp) > MAX_TRACEPARENT_LEN:
+            self._trace_warn(conn, f"oversized traceparent ({len(tp)} chars)")
+            return None
+        ctx = parse_traceparent(tp)
+        if ctx is None:
+            self._trace_warn(conn, f"malformed traceparent {tp[:48]!r}")
+            return None
+        return ctx
+
+    def _trace_warn(self, conn: _Conn, why: str) -> None:
+        log.warning("ingest: ignoring %s from %s", why, conn.peer)
+        if self.events is not None:
+            try:
+                self.events.event(
+                    "_wire", "Ingest", str(conn.peer), "TraceContextInvalid",
+                    f"ignoring {why}; frame still served", warning=True,
+                )
+            except Exception:
+                pass  # event plumbing must never unwind the ingest loop
+
     def _frame(self, conn: _Conn, ftype: int, payload: bytes) -> None:
         if ftype == F_HELLO:
             if self.tenants is not None:
@@ -458,7 +538,7 @@ class IngestServer:
             conn.authed = True
             self._send(conn, encode_ack(0))
             return
-        if ftype == F_DATA:
+        if ftype in (F_DATA, F_TDATA):
             if self.auth_token is not None and not conn.authed:
                 conn.closing = True
                 self._send(conn, encode_err(ERR_AUTH, "HELLO with token required"))
@@ -474,6 +554,13 @@ class IngestServer:
                     conn.closing = True
                     self._send(conn, encode_err(ERR_AUTH, "HELLO with token required"))
                     return
+            ctx: Optional[Tuple[str, str]] = None
+            if ftype == F_TDATA:
+                # structural damage (overrunning length prefix) raises
+                # FrameError into the caller's reject path; content-invalid
+                # trace context is warned about and dropped, the rows land
+                tp, payload = decode_tdata_payload(payload)
+                ctx = self._trace_ctx(conn, tp)
             seq, entries = decode_data_payload(payload)
             if conn.ident is not None and conn.ident.tenant is not None:
                 for trial_name, _rows in entries:
@@ -490,7 +577,7 @@ class IngestServer:
                         )
                         return
             n_rows = sum(len(rows) for _, rows in entries)
-            self._pending.append((conn, seq, entries, n_rows))
+            self._pending.append((conn, seq, entries, n_rows, ctx))
             self._pending_rows += n_rows
             if self._pending_since is None:
                 self._pending_since = time.monotonic()
@@ -507,9 +594,10 @@ class IngestServer:
         rows_in = self._pending_rows
         self._pending_rows = 0
         self._pending_since = None
+        t0 = time.time()
         # merge all frames' entries per trial, preserving arrival order
         by_trial: Dict[str, List[MetricLog]] = {}
-        for _, _, entries, _ in batch:
+        for _, _, entries, _, _ in batch:
             for trial_name, rows in entries:
                 by_trial.setdefault(trial_name, []).extend(rows)
         fresh_entries: List[Tuple[str, List[MetricLog]]] = []
@@ -535,8 +623,9 @@ class IngestServer:
                 self.metrics.set_gauge(
                     "katib_ingest_coalesce_depth", float(len(batch))
                 )
+            self._record_drain_spans(batch, rows_in, t0)
         acks: Dict[_Conn, int] = {}
-        for conn, seq, _, _ in batch:
+        for conn, seq, _, _, _ in batch:
             acks[conn] = max(acks.get(conn, 0), seq)
         for conn, seq in acks.items():
             if err is not None:
@@ -544,6 +633,40 @@ class IngestServer:
                 self._send(conn, encode_err(ERR_WRITE, f"store write failed: {err}"))
             else:
                 self._send(conn, encode_ack(seq))
+
+    def _record_drain_spans(self, batch, rows_in: int, t0: float) -> None:
+        """Span parity with the JSON wire (ISSUE 19): every traced frame's
+        entries land a ``rpc.report_observation_log`` span in the caller's
+        trace (the exact span the JSON servicer records), and each
+        contributing trace gets one ``ingest.group_commit`` span for this
+        drain — all sharing a ``commitId`` attr plus the sibling trace ids,
+        so a merged tree shows which trials' writes were coalesced."""
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            return
+        end = time.time()
+        ctxs: Dict[str, Optional[str]] = {}  # trace_id -> parent span id
+        for _, _, entries, _, ctx in batch:
+            if ctx is None:
+                continue
+            trace_id, parent_id = ctx
+            ctxs.setdefault(trace_id, parent_id)
+            for trial_name, rows in entries:
+                tracer.record_span(
+                    "rpc.report_observation_log", "_rpc", trace_id, parent_id,
+                    start=t0, end=end, trial=trial_name, rows=len(rows),
+                )
+        if not ctxs:
+            return
+        commit_id = tracer.new_span_id()
+        linked = sorted(ctxs)
+        for trace_id, parent_id in ctxs.items():
+            tracer.record_span(
+                "ingest.group_commit", "_rpc", trace_id, parent_id,
+                start=t0, end=end, commitId=commit_id,
+                frames=len(batch), rows=rows_in,
+                linkedTraces=[t for t in linked if t != trace_id],
+            )
 
     def _dedup(self, trial_name: str, rows: List[MetricLog]) -> List[MetricLog]:
         """The JSON receiver's idempotent exact-duplicate drop, batched: one
@@ -601,6 +724,7 @@ class FramedIngestClient:
         retries: int = DEFAULT_HTTP_RETRIES,
         backoff_base: float = DEFAULT_BACKOFF_BASE_S,
         backoff_cap: float = DEFAULT_BACKOFF_CAP_S,
+        wire_tracing: Optional[bool] = None,
     ) -> None:
         host, _, port = address.rpartition(":")
         if not host or not port.isdigit():
@@ -613,6 +737,15 @@ class FramedIngestClient:
         self.retries = max(1, int(retries))
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        # wire_tracing on -> DATA frames travel as TDATA with the current
+        # traceparent; off (default) -> byte-identical untraced frames.
+        # None resolves from $KATIB_TPU_WIRE_TRACING, the only knob a trial
+        # subprocess has (no RuntimeConfig handle down here).
+        if wire_tracing is None:
+            from ..tracing import wire_tracing_from_env
+
+            wire_tracing = wire_tracing_from_env()
+        self.wire_tracing = wire_tracing
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._rbuf = bytearray()
@@ -678,9 +811,14 @@ class FramedIngestClient:
         batch = [(t, list(ls)) for t, ls in entries if ls]
         if not batch:
             return
+        tp = None
+        if self.wire_tracing:
+            from ..tracing import current_traceparent
+
+            tp = current_traceparent()
         with self._lock:
             self._seq += 1
-            frame = encode_data_frame(batch, self._seq)
+            frame = encode_data_frame(batch, self._seq, traceparent=tp)
             last: Optional[BaseException] = None
             for attempt in range(self.retries):
                 try:
@@ -724,9 +862,11 @@ class FramedObservationStore(ObservationStore):
         token: Optional[str] = None,
         timeout: float = 30.0,
         retries: int = DEFAULT_HTTP_RETRIES,
+        wire_tracing: Optional[bool] = None,
     ) -> None:
         self.ingest = FramedIngestClient(
-            ingest_addr, token=token, timeout=timeout, retries=retries
+            ingest_addr, token=token, timeout=timeout, retries=retries,
+            wire_tracing=wire_tracing,
         )
         self._http: Optional[HttpRemoteObservationStore] = (
             HttpRemoteObservationStore(

@@ -47,6 +47,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 ENV_TRACING = "KATIB_TPU_TRACING"
 ENV_TRACEPARENT = "KATIB_TPU_TRACEPARENT"
+ENV_WIRE_TRACING = "KATIB_TPU_WIRE_TRACING"
 
 SPAN_DURATION_METRIC = "katib_span_duration_seconds"
 
@@ -55,6 +56,16 @@ _TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
 
 def tracing_enabled_from_env(default: bool = True) -> bool:
     raw = os.environ.get(ENV_TRACING)
+    if raw is None or raw == "":
+        return default
+    return raw.lower() not in ("0", "false", "off")
+
+
+def wire_tracing_from_env(default: bool = False) -> bool:
+    """Client-side resolution of the wire-tracing knob (ISSUE 19): trial
+    subprocesses and wire clients have no RuntimeConfig handle, so the env
+    override IS the knob for them. Default off = byte-identical wire."""
+    raw = os.environ.get(ENV_WIRE_TRACING)
     if raw is None or raw == "":
         return default
     return raw.lower() not in ("0", "false", "off")
@@ -204,6 +215,22 @@ class Tracer:
             collections.OrderedDict()
         )
         self._roots: Dict[str, Span] = {}  # trace_id -> root span
+        # distributed plane (ISSUE 19): a WireSpanSink appends every ended
+        # span durably under the SHARED root so cross-replica trees merge
+        # even after this process is SIGKILLed; per-experiment annotations
+        # (the failover fence token) stamp onto every later span
+        self.wire_sink: Optional["WireSpanSink"] = None
+        self._annotations: Dict[str, Dict[str, Any]] = {}
+
+    def attach_wire_sink(self, sink: Optional["WireSpanSink"]) -> None:
+        self.wire_sink = sink
+
+    def annotate(self, experiment: str, **attrs: Any) -> None:
+        """Merge default attrs into every span recorded for ``experiment``
+        from now on — the placement failover path stamps the bumped fence
+        token here so a taken-over experiment's resumed spans carry it."""
+        with self._lock:
+            self._annotations.setdefault(experiment, {}).update(attrs)
 
     # -- id + record plumbing ------------------------------------------------
 
@@ -217,10 +244,21 @@ class Tracer:
 
     def _record(self, experiment: str, span: Span) -> None:
         with self._lock:
+            defaults = self._annotations.get(experiment)
             ring = self._rings.get(experiment)
             if ring is None:
                 ring = self._rings[experiment] = collections.deque(maxlen=self.ring_size)
             ring.append(span)
+        if defaults:
+            for k, v in defaults.items():
+                span.attrs.setdefault(k, v)
+        sink = self.wire_sink
+        if sink is not None:
+            span._wire_experiment = experiment  # type: ignore[attr-defined]
+            if span.parent_id is None:
+                # root spans are written once at open too, so a SIGKILL
+                # mid-trial still leaves the victim's trace anchored
+                sink.record(span, experiment)
 
     # -- explicit span API (cross-thread lifecycle instrumentation) ----------
 
@@ -257,6 +295,9 @@ class Tracer:
                 self.metrics.observe(SPAN_DURATION_METRIC, span.duration, stage=span.name)
             except Exception:
                 pass  # a histogram bug must never unwind the traced path
+        sink = self.wire_sink
+        if sink is not None:
+            sink.record(span, getattr(span, "_wire_experiment", ""))
 
     def record_span(
         self,
@@ -308,6 +349,24 @@ class Tracer:
             root = self._roots.get(trace_id) if trace_id else None
         if root is not None and root.end is None:
             return root  # resubmit of an in-flight trace (resume path)
+        sink = self.wire_sink
+        if root is None and sink is not None:
+            adopted = sink.adopt_trial_root(experiment, trial)
+            if adopted is not None:
+                # failover resume (ISSUE 19): rejoin the dead replica's
+                # still-open trace so victim + takeover spans merge into ONE
+                # cross-replica tree; per-experiment annotations (the bumped
+                # fence token) stamp onto the adopted root via _record
+                adopted.attrs.update(attrs)
+                self._record(experiment, adopted)
+                with self._lock:
+                    self._trial_traces[(experiment, trial)] = adopted.trace_id
+                    self._trial_traces.move_to_end((experiment, trial))
+                    while len(self._trial_traces) > self.MAX_TRIAL_INDEX:
+                        _, old_trace = self._trial_traces.popitem(last=False)
+                        self._roots.pop(old_trace, None)
+                    self._roots[adopted.trace_id] = adopted
+                return adopted
         trace_id = self.new_trace_id()
         root = Span(
             trace_id=trace_id,
@@ -593,6 +652,302 @@ def install_log_context(*names: str) -> None:
                 continue
             _installed_loggers.add(name)
             logging.getLogger(name).addFilter(TraceContextFilter())
+
+
+# -- distributed tracing plane (ISSUE 19) ------------------------------------
+#
+# When runtime.wire_tracing is on, every ended span is appended as one JSON
+# line under the SHARED state root: <root>/traces/wire/<trace_id>/<replica>
+# .jsonl. Append-only jsonl is the crash-durability idiom here (a torn last
+# line is skipped by the reader; KTI305's tmp+os.replace applies to whole-
+# file rewrites, not logs), and the directory key IS the trace id, so a
+# cross-replica merge is one readdir — no matter which replica died when.
+
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+_SAFE_COMPONENT_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+WIRE_TRACEPARENT_HEADER = "X-Katib-Traceparent"
+# adversarial bound: headers/frame fields longer than this are ignored
+# loudly rather than parsed (a valid traceparent is exactly 55 bytes)
+MAX_TRACEPARENT_LEN = 128
+
+
+class WireSpanSink:
+    """Durable, replica-tagged span appender on the shared state root.
+
+    One jsonl file per (trace, replica); records carry experiment/trial/
+    replica alongside the span so offline merges need no other index. Write
+    failures are logged once and never unwind the traced path.
+    """
+
+    def __init__(self, root_dir: str, replica: str):
+        self.root_dir = root_dir
+        self.dir = os.path.join(root_dir, "traces", "wire")
+        self.replica = _SAFE_COMPONENT_RE.sub("_", replica or "replica") or "replica"
+        self._lock = threading.Lock()
+        self._error_logged = False
+
+    def record(self, span: Span, experiment: str = "") -> None:
+        if not _TRACE_ID_RE.match(span.trace_id or ""):
+            return
+        rec = span.to_dict()
+        rec["experiment"] = experiment
+        rec["trial"] = span.attrs.get("trial", "")
+        rec["replica"] = self.replica
+        line = json.dumps(rec) + "\n"
+        path = os.path.join(self.dir, span.trace_id, f"{self.replica}.jsonl")
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with self._lock, open(path, "a") as f:
+                f.write(line)
+                f.flush()
+        except OSError:
+            if not self._error_logged:
+                self._error_logged = True
+                logging.getLogger("katib_tpu.tracing").warning(
+                    "wire span sink write failed under %s (logged once)",
+                    self.dir, exc_info=True,
+                )
+            return
+        if (
+            span.parent_id is None
+            and span.end is None
+            and span.name == "trial"
+            and span.attrs.get("trial")
+        ):
+            # trial-root index: one append per begin_trial, sharded per
+            # experiment, so a takeover replica can find the victim's
+            # still-open trace and REJOIN it instead of forking a new one
+            try:
+                entry = json.dumps({
+                    "trial": span.attrs["trial"],
+                    "traceId": span.trace_id,
+                    "spanId": span.span_id,
+                })
+                ipath = self._trial_index_path(experiment)
+                os.makedirs(os.path.dirname(ipath), exist_ok=True)
+                with self._lock, open(ipath, "a") as f:
+                    f.write(entry + "\n")
+                    f.flush()
+            except OSError:
+                pass  # adoption degrades to a fresh trace; spans still merge
+
+    def _trial_index_path(self, experiment: str) -> str:
+        safe = _SAFE_COMPONENT_RE.sub("_", experiment or "_") or "_"
+        return os.path.join(self.dir, "_trials", safe + ".jsonl")
+
+    def adopt_trial_root(self, experiment: str, trial: str) -> Optional[Span]:
+        """The failover-resume rejoin point: the most recent STILL-OPEN root
+        span another replica recorded for (experiment, trial), or None when
+        the trial was never traced or ended cleanly (a re-run then starts
+        its own trace — adopting a finished tree would conflate two runs)."""
+        best: Optional[Dict[str, Any]] = None
+        try:
+            with open(self._trial_index_path(experiment)) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line from a SIGKILLed writer
+                    if rec.get("trial") == trial and rec.get("traceId"):
+                        best = rec  # last wins: the newest begin_trial
+        except OSError:
+            return None
+        if best is None:
+            return None
+        for rec in load_wire_records(self.root_dir, best["traceId"]):
+            if rec.get("spanId") == best.get("spanId"):
+                if rec.get("end") is not None:
+                    return None  # ended cleanly: nothing to resume
+                return Span.from_dict(rec)
+        return None
+
+
+def load_wire_records(root_dir: str, trace_id: str) -> List[Dict[str, Any]]:
+    """All replicas' records for one trace, deduped by spanId (an ended
+    record supersedes the open root record written at span start)."""
+    if not _TRACE_ID_RE.match((trace_id or "").lower()):
+        return []
+    tdir = os.path.join(root_dir, "traces", "wire", trace_id.lower())
+    by_span: Dict[str, Dict[str, Any]] = {}
+    try:
+        files = sorted(os.listdir(tdir))
+    except OSError:
+        return []
+    for fname in files:
+        if not fname.endswith(".jsonl"):
+            continue
+        try:
+            with open(os.path.join(tdir, fname)) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line from a SIGKILLed writer
+                    sid = rec.get("spanId")
+                    if not sid:
+                        continue
+                    prev = by_span.get(sid)
+                    if prev is None or (prev.get("end") is None and rec.get("end") is not None):
+                        by_span[sid] = rec
+        except OSError:
+            continue
+    return sorted(by_span.values(), key=lambda r: r.get("start", 0.0))
+
+
+def merge_trace(root_dir: Optional[str], trace: Optional[Dict[str, Any]],
+                trace_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """One coherent cross-replica tree: the per-trial persisted/ring trace
+    (may be None for a SIGKILLed victim) unioned with every replica's wire
+    records for the trace id, deduped by spanId."""
+    tid = (trace or {}).get("traceId") or trace_id
+    if not tid:
+        return trace
+    merged: Dict[str, Dict[str, Any]] = {}
+    for s in (trace or {}).get("spans", []):
+        if s.get("spanId"):
+            merged[s["spanId"]] = s
+    replicas = set()
+    if root_dir:
+        for rec in load_wire_records(root_dir, tid):
+            if rec.get("replica"):
+                replicas.add(rec["replica"])
+            prev = merged.get(rec.get("spanId"))
+            if prev is None or (prev.get("end") is None and rec.get("end") is not None):
+                merged[rec["spanId"]] = rec
+    if not merged:
+        return trace
+    out = dict(trace or {"traceId": tid})
+    out["traceId"] = tid
+    out["spans"] = sorted(merged.values(), key=lambda s: s.get("start", 0.0))
+    if replicas:
+        out["replicas"] = sorted(replicas)
+    return out
+
+
+def experiment_traces(root_dir: str, experiment: str) -> List[Dict[str, Any]]:
+    """All of one experiment's merged traces, worst-first by root-span
+    duration: per-trial persisted traces under ``<root>/traces/<exp>/``
+    unioned with wire records, plus wire-only traces (a victim replica's
+    trials that never reached end_trial persistence)."""
+    traces: List[Dict[str, Any]] = []
+    seen_tids: set = set()
+    exp_dir = os.path.join(root_dir, "traces", experiment)
+    try:
+        trial_files = sorted(os.listdir(exp_dir))
+    except OSError:
+        trial_files = []
+    for fname in trial_files:
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(exp_dir, fname)) as f:
+                trace = json.load(f)
+        except (OSError, ValueError):
+            continue
+        merged = merge_trace(root_dir, trace)
+        if merged:
+            traces.append(merged)
+            if merged.get("traceId"):
+                seen_tids.add(merged["traceId"])
+    # wire-only traces: scan the by-trace dirs and keep those whose records
+    # name this experiment (bounded by what the sweep actually wrote)
+    wdir = os.path.join(root_dir, "traces", "wire")
+    try:
+        tids = sorted(os.listdir(wdir))
+    except OSError:
+        tids = []
+    for tid in tids:
+        if tid in seen_tids or not _TRACE_ID_RE.match(tid):
+            continue
+        recs = load_wire_records(root_dir, tid)
+        mine = [r for r in recs if r.get("experiment") == experiment]
+        if not mine:
+            continue
+        trials = sorted({r["trial"] for r in mine if r.get("trial")})
+        replicas = sorted({r["replica"] for r in recs if r.get("replica")})
+        traces.append({
+            "traceId": tid,
+            "experiment": experiment,
+            "trial": trials[0] if len(trials) == 1 else ",".join(trials),
+            "spans": recs,
+            "replicas": replicas,
+        })
+
+    def _root_duration(trace: Dict[str, Any]) -> float:
+        spans = [Span.from_dict(s) for s in trace.get("spans", [])]
+        roots, _ = build_tree(spans)
+        return max((r.duration for r in roots), default=0.0)
+
+    for t in traces:
+        t["rootDurationSeconds"] = round(_root_duration(t), 6)
+    traces.sort(key=lambda t: t["rootDurationSeconds"], reverse=True)
+    return traces
+
+
+def parse_slo_objectives(spec: str) -> Dict[str, float]:
+    """``"default=0.5,CreateExperiment=2.0"`` -> per-method latency
+    objectives in seconds; malformed parts are dropped loudly (a typo'd
+    objective must not take down the server)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        method, _, raw = part.partition("=")
+        try:
+            value = float(raw)
+        except ValueError:
+            logging.getLogger("katib_tpu.tracing").warning(
+                "ignoring malformed SLO objective %r (want Method=seconds)", part
+            )
+            continue
+        if method.strip() and value > 0:
+            out[method.strip()] = value
+    return out
+
+
+class FlightRecorder:
+    """Bounded worst-N slow-RPC ring: each entry keeps the request's method,
+    tenant, latency and its span tree, dumpable via GET /api/fleet/slow and
+    on SIGUSR2. Admission is by latency — once full, a new request must beat
+    the fastest retained entry."""
+
+    def __init__(self, size: int = 32):
+        self.size = max(int(size), 0)
+        self._lock = threading.Lock()
+        self._entries: List[Dict[str, Any]] = []  # sorted slowest-first
+
+    def record(
+        self,
+        method: str,
+        duration: float,
+        tenant: str = "",
+        trace_id: str = "",
+        code: int = 200,
+        spans: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        if self.size <= 0:
+            return
+        entry = {
+            "method": method,
+            "tenant": tenant,
+            "durationSeconds": round(duration, 6),
+            "traceId": trace_id,
+            "code": code,
+            "time": time.time(),
+            "spans": spans or [],
+        }
+        with self._lock:
+            if len(self._entries) >= self.size and duration <= self._entries[-1]["durationSeconds"]:
+                return
+            self._entries.append(entry)
+            self._entries.sort(key=lambda e: e["durationSeconds"], reverse=True)
+            del self._entries[self.size:]
+
+    def dump(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._entries]
 
 
 # -- export: span tree + Perfetto --------------------------------------------

@@ -905,7 +905,7 @@ class _Handler(BaseHTTPRequestHandler):
         """Serve one trial's lifecycle trace: JSON spans by default, Chrome
         trace_event JSON (openable in ui.perfetto.dev) with
         ``?format=perfetto`` (katib_tpu.tracing)."""
-        from ..tracing import Span, to_perfetto
+        from ..tracing import Span, merge_trace, to_perfetto
 
         tracer = getattr(self.controller, "tracer", None)
         trace = tracer.trial_trace(exp_name, trial_name) if tracer else None
@@ -915,6 +915,10 @@ class _Handler(BaseHTTPRequestHandler):
                           "(tracing disabled, or trial unknown)"},
                 code=404,
             )
+        # distributed plane (ISSUE 19): union in spans other replicas wrote
+        # for this trace under the shared root, so the tree is the whole
+        # cross-replica story (rpc handling, ingest commits, failover)
+        trace = merge_trace(getattr(self.controller, "root_dir", None), trace)
         fmt = parse_qs(urlparse(self.path).query).get("format", ["json"])[0]
         if fmt == "perfetto":
             spans = [Span.from_dict(s) for s in trace.get("spans", [])]

@@ -68,6 +68,17 @@ echo "== tenancy control-plane smoke (KATIB_TPU_TENANCY=1 armed under the failov
 JAX_PLATFORMS=cpu KATIB_TPU_TENANCY=1 python bench.py control_plane_scaling --smoke
 
 echo
+echo "== distributed-trace smoke (3 tenancy replicas, wire traceparent on both planes, merged cross-replica traces, per-tenant SLO series) =="
+JAX_PLATFORMS=cpu BENCH_CP_REPLICAS=3 KATIB_TPU_REPLICAS=3 KATIB_TPU_TENANCY=1 \
+    KATIB_TPU_TRACING=1 KATIB_TPU_WIRE_TRACING=1 \
+    KATIB_TPU_SLO_OBJECTIVES="default=0.000001" \
+    python bench.py control_plane_scaling --smoke
+
+echo
+echo "== distributed tracing-overhead smoke (3 replica subprocesses, wire tracing off vs on) =="
+JAX_PLATFORMS=cpu python bench.py tracing_overhead --smoke --distributed
+
+echo
 echo "== multi-tenant scaling smoke (per-tenant tokens/quotas, adversarial probe, SIGKILL zero-loss) =="
 JAX_PLATFORMS=cpu python bench.py multi_tenant_scaling --smoke
 

@@ -1,0 +1,554 @@
+"""Distributed tracing & fleet observability (ISSUE 19): wire-propagated
+trace context on BOTH planes, cross-replica trace merge, per-tenant RPC
+SLOs, and the fleet status plane.
+
+Covers the tentpole and its satellites:
+
+- span parity: the framed wire records the SAME ``rpc.report_observation_log``
+  span set as the JSON wire (the PR 16 regression fix), plus one
+  ``ingest.group_commit`` span per contributing trace;
+- server-side rpc spans parent under the X-Katib-Traceparent header;
+- adversarial trace context on both planes: malformed/oversized/missing
+  headers and frame fields are ignored LOUDLY (TraceContextInvalid warning
+  event) but the request/frame is still served — never a 500, never a lost
+  row; only STRUCTURAL damage (an overrunning length prefix) rejects;
+- knob off (`runtime.wire_tracing`, the default): framed bytes are
+  byte-identical to the PR 16 F_DATA wire, the JSON wire sends the exact
+  PR 17 header set, and the server records no rpc spans — the seeded
+  on-vs-off precedent of PR 14/15/16;
+- failover merge: a takeover replica ADOPTS the victim's still-open trial
+  root (WireSpanSink trial index), so the merged trace is ONE tree covering
+  both replicas, stamped with the bumped fence token; a cleanly-ended trace
+  is never adopted;
+- per-tenant SLO series + violation counter, the slow-RPC flight recorder
+  (GET /api/fleet/slow), and GET /api/fleet;
+- ``katib-tpu trace`` experiment-level worst-first listing and the
+  ``--format perfetto`` dump; ``katib-tpu fleet``.
+"""
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from katib_tpu import tracing
+from katib_tpu.cli import main
+from katib_tpu.db.store import InMemoryObservationStore, MetricLog
+from katib_tpu.service.httpapi import (
+    HttpApiClient,
+    HttpRemoteObservationStore,
+    fleet_snapshot,
+    serve_api,
+)
+from katib_tpu.service.ingest import (
+    ERR_FRAME,
+    F_ACK,
+    F_DATA,
+    F_ERR,
+    F_TDATA,
+    MAGIC,
+    VERSION,
+    FrameError,
+    FramedIngestClient,
+    IngestServer,
+    _HEADER,
+    _TP_HEAD,
+    decode_data_payload,
+    decode_tdata_payload,
+    encode_data_frame,
+    frames_from_buffer,
+)
+from katib_tpu.service.rpc import ApiServicer
+from katib_tpu.tracing import (
+    MAX_TRACEPARENT_LEN,
+    WIRE_TRACEPARENT_HEADER,
+    FlightRecorder,
+    Span,
+    Tracer,
+    WireSpanSink,
+    experiment_traces,
+    format_traceparent,
+    load_wire_records,
+    merge_trace,
+    parse_slo_objectives,
+)
+
+TID = "ab" * 16
+SID = "cd" * 8
+TP = format_traceparent(TID, SID)
+
+
+class _Events:
+    """Capture stand-in for controller/events.py EventRecorder."""
+
+    def __init__(self):
+        self.rows = []
+
+    def event(self, experiment, kind, name, reason, message, warning=False):
+        self.rows.append(
+            {"experiment": experiment, "kind": kind, "name": name,
+             "reason": reason, "message": message, "warning": warning}
+        )
+
+    def reasons(self):
+        return [r["reason"] for r in self.rows]
+
+
+class _Ctrl:
+    """Minimal controller shape the api handler consults."""
+
+    def __init__(self, tracer=None, events=None, root_dir=None):
+        self.tracer = tracer
+        self.events = events
+        self.root_dir = root_dir
+
+
+def _fresh_default_tracer(monkeypatch):
+    t = Tracer(enabled=True)
+    monkeypatch.setattr(tracing, "_default_tracer", t)
+    return t
+
+
+def _shutdown(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def _rows(n=2):
+    return [MetricLog(1_700_000_000.0 + i, "score", repr(0.1 * i)) for i in range(n)]
+
+
+def _rpc_spans(tracer, trace_id, name):
+    return [s for s in tracer.trace_spans("_rpc", trace_id) if s.name == name]
+
+
+def _span_key(s):
+    return (s.name, s.trace_id, s.parent_id, s.attrs.get("trial"), s.attrs.get("rows"))
+
+
+def _send_frames_await_reply(address, blob, timeout=10.0):
+    """Raw-socket exchange: returns the first reply frame (ftype, payload)."""
+    host, _, port = address.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    try:
+        sock.sendall(blob)
+        buf = bytearray()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            sock.settimeout(max(0.01, deadline - time.monotonic()))
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+            for frame in frames_from_buffer(buf):
+                return frame
+        raise AssertionError("no reply frame within the deadline")
+    finally:
+        sock.close()
+
+
+class TestSpanParity:
+    def test_framed_wire_records_same_span_set_as_json_wire(self, monkeypatch):
+        """The PR 16 regression fix: a traced batch over the framed wire
+        must land the exact ``rpc.report_observation_log`` span set the JSON
+        wire records — same name, trace, parent, trial, row count."""
+        monkeypatch.setenv(tracing.ENV_TRACEPARENT, TP)
+        monkeypatch.setenv(tracing.ENV_WIRE_TRACING, "1")
+        entries = [("t-a", _rows(2)), ("t-b", _rows(3))]
+
+        json_tracer = _fresh_default_tracer(monkeypatch)
+        srv = serve_api(ApiServicer(store=InMemoryObservationStore()))
+        remote = HttpRemoteObservationStore(srv.base_url)
+        try:
+            remote.report_many(entries)
+        finally:
+            remote.close()
+            _shutdown(srv)
+        json_spans = _rpc_spans(json_tracer, TID, "rpc.report_observation_log")
+
+        framed_tracer = Tracer(enabled=True)
+        store = InMemoryObservationStore()
+        isrv = IngestServer(store, tracer=framed_tracer)
+        cli = FramedIngestClient(isrv.address, wire_tracing=True)
+        try:
+            cli.report_many(entries)  # blocks until the drain's ACK
+        finally:
+            cli.close()
+            isrv.close()
+        framed_spans = _rpc_spans(framed_tracer, TID, "rpc.report_observation_log")
+
+        assert sorted(map(_span_key, json_spans)) == sorted(
+            map(_span_key, framed_spans)
+        ), "framed and JSON wires must record the same span set"
+        assert all(s.parent_id == SID for s in framed_spans)
+        # the framed drain additionally links its group commit into the trace
+        commits = _rpc_spans(framed_tracer, TID, "ingest.group_commit")
+        assert len(commits) == 1
+        assert commits[0].attrs["commitId"]
+        assert commits[0].attrs["rows"] == 5
+        # rows landed despite all the tracing — observability never costs data
+        assert len(store.get_observation_log("t-a")) == 2
+        assert len(store.get_observation_log("t-b")) == 3
+
+    def test_http_server_span_parents_under_wire_header(self, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_TRACEPARENT, TP)
+        tracer = _fresh_default_tracer(monkeypatch)
+        srv = serve_api(
+            ApiServicer(store=InMemoryObservationStore()), wire_tracing=True
+        )
+        client = HttpApiClient(srv.base_url, wire_tracing=True)
+        try:
+            client.call("GetObservationLog", {"trialName": "t"})
+        finally:
+            _shutdown(srv)
+        (span,) = _rpc_spans(tracer, TID, "rpc.GetObservationLog")
+        assert span.parent_id == SID
+        assert span.ended
+        assert span.attrs["code"] == 200
+        assert span.attrs["tenant"] == "default"
+
+
+class TestAdversarialTraceContext:
+    BAD_HEADERS = [
+        "garbage",
+        "00-" + "a" * 200,                    # oversized
+        "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",  # bad version
+        "00-" + "G" * 32 + "-" + "b" * 16 + "-01",  # non-hex trace id
+    ]
+
+    def test_http_bad_traceparent_served_with_warning_event(self, monkeypatch):
+        """Malformed/oversized headers never 500 — the request is served and
+        a TraceContextInvalid warning event is emitted per bad header."""
+        tracer = _fresh_default_tracer(monkeypatch)
+        events = _Events()
+        srv = serve_api(
+            ApiServicer(store=InMemoryObservationStore()),
+            controller=_Ctrl(tracer=tracer, events=events),
+            wire_tracing=True,
+        )
+        try:
+            for bad in self.BAD_HEADERS:
+                req = urllib.request.Request(
+                    f"{srv.base_url}/rpc/GetObservationLog",
+                    data=json.dumps({"trialName": "t"}).encode(),
+                    headers={
+                        "Content-Type": "application/json",
+                        WIRE_TRACEPARENT_HEADER: bad,
+                    },
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 200
+            assert events.reasons() == ["TraceContextInvalid"] * len(self.BAD_HEADERS)
+            assert all(r["warning"] for r in events.rows)
+            # a missing header is simply absent context — no warning
+            req = urllib.request.Request(
+                f"{srv.base_url}/rpc/GetObservationLog",
+                data=json.dumps({"trialName": "t"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+            assert len(events.rows) == len(self.BAD_HEADERS)
+        finally:
+            _shutdown(srv)
+
+    def test_framed_bad_traceparent_rows_still_land(self):
+        """Content-invalid TDATA trace context (regex fail, oversized) is
+        warned about and dropped — the frame is still ACKed and its rows
+        land. Only structural damage rejects."""
+        events = _Events()
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, tracer=Tracer(enabled=True), events=events)
+        try:
+            for i, tp in enumerate(
+                ["not-a-traceparent", "00-" + "a" * MAX_TRACEPARENT_LEN], start=1
+            ):
+                frame = encode_data_frame(
+                    [(f"t{i}", [MetricLog(float(i), "m", str(i))])], i,
+                    traceparent=tp,
+                )
+                ftype, payload = _send_frames_await_reply(srv.address, frame)
+                assert ftype == F_ACK
+                assert struct.unpack("!Q", payload)[0] == i
+                assert len(store.get_observation_log(f"t{i}")) == 1
+            assert events.reasons() == ["TraceContextInvalid"] * 2
+            assert all(r["warning"] for r in events.rows)
+        finally:
+            srv.close()
+
+    def test_framed_structural_overrun_rejected_loudly(self):
+        """A TDATA length prefix that overruns the payload is a framing bug,
+        not trace context: ERR_FRAME, connection closed, no rows landed."""
+        store = InMemoryObservationStore()
+        srv = IngestServer(store, tracer=Tracer(enabled=True))
+        try:
+            body = _TP_HEAD.pack(1000) + b"xx"  # claims 1000, carries 2
+            frame = _HEADER.pack(MAGIC, VERSION, F_TDATA, len(body)) + body
+            ftype, payload = _send_frames_await_reply(srv.address, frame)
+            assert ftype == F_ERR
+            assert payload[0] == ERR_FRAME
+        finally:
+            srv.close()
+
+    def test_decode_tdata_overrun_raises(self):
+        with pytest.raises(FrameError):
+            decode_tdata_payload(_TP_HEAD.pack(50) + b"short")
+
+
+class TestKnobOffByteIdentity:
+    def test_encoder_without_traceparent_is_the_pr16_f_data_wire(self):
+        """Knob off => the framed client encodes the exact PR 16 F_DATA
+        frame: same type byte, same header layout, same payload bytes."""
+        entries = [("t", [MetricLog(1.5, "loss", "0.25"),
+                          MetricLog(2.5, "acc", "0.75")])]
+        frame = encode_data_frame(entries, 7)
+        assert frame == encode_data_frame(entries, 7, traceparent=None)
+        (ftype, payload), = list(frames_from_buffer(bytearray(frame)))
+        assert ftype == F_DATA
+        # recompose from the documented PR 16 layout: header + raw payload
+        assert frame == _HEADER.pack(MAGIC, VERSION, F_DATA, len(payload)) + payload
+        seq, got = decode_data_payload(payload)
+        assert seq == 7 and len(got) == 1
+
+    def test_http_client_knob_off_sends_no_traceparent_header(self, monkeypatch):
+        """Seeded on-vs-off: with a live traceparent in scope, the knob-off
+        client's header set is exactly the PR 17 wire; the knob-on client
+        adds X-Katib-Traceparent and nothing else."""
+        monkeypatch.setenv(tracing.ENV_TRACEPARENT, TP)
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        seen = []
+
+        class _Capture(BaseHTTPRequestHandler):
+            def do_POST(self):
+                seen.append(dict(self.headers))
+                self.rfile.read(int(self.headers.get("Content-Length", "0")))
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), _Capture)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            off = HttpApiClient(url, wire_tracing=False, retries=1)
+            off.call("GetObservationLog", {"trialName": "t"})
+            on = HttpApiClient(url, wire_tracing=True, retries=1)
+            on.call("GetObservationLog", {"trialName": "t"})
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        assert WIRE_TRACEPARENT_HEADER not in seen[0]
+        assert seen[1][WIRE_TRACEPARENT_HEADER] == TP
+        assert set(seen[1]) - set(seen[0]) == {WIRE_TRACEPARENT_HEADER}
+
+    def test_knob_off_server_records_no_rpc_spans(self, monkeypatch, tmp_path):
+        """wire_tracing off (the default) => the span set is PR 17's: no
+        server-side rpc spans, no wire-sink directory, no flight recorder."""
+        tracer = _fresh_default_tracer(monkeypatch)
+        srv = serve_api(
+            ApiServicer(store=InMemoryObservationStore()),
+            root_dir=str(tmp_path),
+        )
+        client = HttpApiClient(srv.base_url, wire_tracing=False)
+        try:
+            client.call("GetObservationLog", {"trialName": "t"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(srv.base_url + "/api/fleet/slow", timeout=10)
+            assert err.value.code == 404
+        finally:
+            _shutdown(srv)
+        assert not tracer._rings.get("_rpc")
+        assert not os.path.isdir(tmp_path / "traces" / "wire")
+
+
+class TestFailoverMerge:
+    def test_takeover_adopts_victims_open_trace(self, tmp_path):
+        """SIGKILL shape: the victim's root span is open-written to the
+        shared sink; the takeover replica's begin_trial REJOINS that trace
+        (same trace id, same root span id), the bumped fence token stamps
+        the resumed spans, and the merged tree covers both replicas."""
+        root = str(tmp_path)
+        victim = Tracer(enabled=True)
+        victim.attach_wire_sink(WireSpanSink(root, "replica-a"))
+        vroot = victim.begin_trial("exp", "t1")
+        victim.record_span(
+            "epoch", "exp", vroot.trace_id, vroot.span_id,
+            start=vroot.start, end=vroot.start + 1.0, epoch=0,
+        )
+        del victim  # SIGKILL: the root never ends
+
+        takeover = Tracer(enabled=True)
+        takeover.attach_wire_sink(WireSpanSink(root, "replica-b"))
+        takeover.annotate("exp", fence=2, failedOverTo="replica-b")
+        adopted = takeover.begin_trial("exp", "t1")
+        assert adopted.trace_id == vroot.trace_id
+        assert adopted.span_id == vroot.span_id
+        assert adopted.attrs["fence"] == 2
+        takeover.record_span(
+            "epoch", "exp", adopted.trace_id, adopted.span_id,
+            start=adopted.start + 2.0, end=adopted.start + 3.0, epoch=1,
+        )
+        takeover.end_trial("exp", "t1")
+
+        merged = merge_trace(root, None, trace_id=vroot.trace_id)
+        assert merged["replicas"] == ["replica-a", "replica-b"]
+        spans = merged["spans"]
+        roots = [s for s in spans if s.get("parentId") is None]
+        assert len(roots) == 1, "ONE root: the takeover rejoined, not forked"
+        assert roots[0]["end"] is not None, "ended record supersedes open"
+        assert roots[0]["attrs"]["fence"] == 2
+        assert sorted(
+            s["attrs"]["epoch"] for s in spans if s["name"] == "epoch"
+        ) == [0, 1]
+        # the experiment view agrees: one merged trace, not two fragments
+        traces = experiment_traces(root, "exp")
+        assert len(traces) == 1
+        assert traces[0]["replicas"] == ["replica-a", "replica-b"]
+
+    def test_cleanly_ended_trace_is_never_adopted(self, tmp_path):
+        """A re-run of a finished trial starts its OWN trace — adopting a
+        cleanly-ended tree would conflate two runs."""
+        root = str(tmp_path)
+        first = Tracer(enabled=True)
+        first.attach_wire_sink(WireSpanSink(root, "replica-a"))
+        froot = first.begin_trial("exp", "t1")
+        first.end_trial("exp", "t1")
+
+        rerun = Tracer(enabled=True)
+        rerun.attach_wire_sink(WireSpanSink(root, "replica-b"))
+        again = rerun.begin_trial("exp", "t1")
+        assert again.trace_id != froot.trace_id
+
+    def test_load_wire_records_tolerates_torn_tail(self, tmp_path):
+        """A SIGKILLed writer leaves a torn last line; the reader skips it
+        and keeps every whole record."""
+        tdir = tmp_path / "traces" / "wire" / TID
+        tdir.mkdir(parents=True)
+        good = Span(trace_id=TID, span_id=SID, parent_id=None, name="trial",
+                    start=1.0).to_dict()
+        good["replica"] = "replica-a"
+        (tdir / "replica-a.jsonl").write_text(
+            json.dumps(good) + "\n" + '{"traceId": "ab', encoding="utf-8"
+        )
+        recs = load_wire_records(str(tmp_path), TID)
+        assert [r["spanId"] for r in recs] == [SID]
+
+
+class TestSloAndFleet:
+    def test_slo_series_flight_recorder_and_fleet_endpoints(
+        self, monkeypatch, tmp_path
+    ):
+        from katib_tpu.controller.events import MetricsRegistry
+
+        _fresh_default_tracer(monkeypatch)
+        registry = MetricsRegistry()
+        srv = serve_api(
+            ApiServicer(store=InMemoryObservationStore()),
+            metrics=registry,
+            wire_tracing=True,
+            slo_objectives="default=0.000001",  # everything violates
+            slow_rpc_ring=4,
+            root_dir=str(tmp_path),
+        )
+        client = HttpApiClient(srv.base_url)
+        try:
+            client.call("GetObservationLog", {"trialName": "t"})
+            text = registry.render()
+            assert 'tenant="default"' in text
+            assert "katib_rpc_latency_seconds" in text
+            assert 'katib_slo_violations_total{method="GetObservationLog"' \
+                   ',tenant="default"}' in text.replace(" ", "")
+            with urllib.request.urlopen(
+                srv.base_url + "/api/fleet/slow", timeout=10
+            ) as resp:
+                slow = json.loads(resp.read())["slow"]
+            assert slow and slow[0]["method"] == "GetObservationLog"
+            assert slow[0]["tenant"] == "default"
+            assert slow[0]["spans"], "flight entries carry the span tree"
+            with urllib.request.urlopen(
+                srv.base_url + "/api/fleet", timeout=10
+            ) as resp:
+                fleet = json.loads(resp.read())
+            assert fleet["root"] == str(tmp_path)
+            assert fleet["replicas"] == [] and fleet["tenants"] == []
+        finally:
+            _shutdown(srv)
+
+    def test_parse_slo_objectives(self):
+        assert parse_slo_objectives("default=0.5,CreateExperiment=2.0") == {
+            "default": 0.5, "CreateExperiment": 2.0,
+        }
+        # malformed parts drop loudly, never take down the server
+        assert parse_slo_objectives("garbage,X=-1,Y=abc, Z=0.25 ,") == {"Z": 0.25}
+        assert parse_slo_objectives("") == {}
+
+    def test_flight_recorder_keeps_worst_n(self):
+        ring = FlightRecorder(2)
+        for dt in (0.1, 0.5, 0.3, 0.01):
+            ring.record("M", dt)
+        dump = ring.dump()
+        assert [e["durationSeconds"] for e in dump] == [0.5, 0.3]
+        ring_off = FlightRecorder(0)
+        ring_off.record("M", 1.0)
+        assert ring_off.dump() == []
+
+    def test_fleet_snapshot_empty_root(self, tmp_path):
+        snap = fleet_snapshot(str(tmp_path))
+        assert snap["replicas"] == [] and snap["tenants"] == []
+
+
+class TestCli:
+    def _seed_wire_traces(self, root):
+        """Two wire-only traces for one experiment with distinct root
+        durations (worst-first ordering is observable)."""
+        for i, (trial, dur) in enumerate([("t-fast", 1.0), ("t-slow", 5.0)]):
+            sink = WireSpanSink(root, f"replica-{i}")
+            sink.record(
+                Span(trace_id=Tracer.new_trace_id(),
+                     span_id=Tracer.new_span_id(),
+                     parent_id=None, name="trial", start=1000.0,
+                     end=1000.0 + dur,
+                     attrs={"experiment": "exp", "trial": trial}),
+                "exp",
+            )
+
+    def test_trace_experiment_level_worst_first(self, tmp_path, capsys):
+        root = str(tmp_path)
+        self._seed_wire_traces(root)
+        traces = experiment_traces(root, "exp")
+        assert [t["trial"] for t in traces] == ["t-slow", "t-fast"]
+        assert traces[0]["rootDurationSeconds"] >= 5.0
+        assert main(["--root", root, "trace", "exp"]) == 0
+        out = capsys.readouterr().out
+        assert out.index("t-slow") < out.index("t-fast"), "worst-first"
+
+    def test_trace_perfetto_dump(self, tmp_path, capsys, monkeypatch):
+        root = str(tmp_path)
+        self._seed_wire_traces(root)
+        monkeypatch.chdir(tmp_path)
+        out_path = tmp_path / "exp.perfetto.json"
+        assert main(
+            ["--root", root, "trace", "exp", "--format", "perfetto",
+             "--output", str(out_path)]
+        ) == 0
+        doc = json.loads(out_path.read_text())
+        assert doc["traceEvents"], "perfetto dump must carry events"
+
+    def test_fleet_command_on_empty_root(self, tmp_path, capsys):
+        assert main(["--root", str(tmp_path), "fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "REPLICA" in out
