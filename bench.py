@@ -902,6 +902,136 @@ def _bench_tracing_overhead_distributed(smoke: bool = False):
     }
 
 
+def _bench_step_stats_overhead(smoke: bool = False):
+    """Step-statistics plane cost (ISSUE 20): end-to-end packs/sec of a
+    pack_size=8 in-process sweep with ``runtime.step_stats`` on vs off.
+    Target <3% overhead when on (off IS the KATIB_TPU_STEP_STATS=0 path:
+    every consult is one ``is None`` check). Same interleaved-passes,
+    keep-each-side's-best shape as tracing_overhead. Also asserts the
+    knob-off run writes zero katib-tpu/perf/ rows and exports none of the
+    step metric families, and runs one injected-straggler pass
+    (KATIB_TPU_STEP_STATS_INJECT=straggle=...) that must fire exactly one
+    GangStraggler warning event."""
+    from katib_tpu.api.spec import (
+        AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+        ObjectiveType, ParameterSpec, ParameterType, TrialResources,
+        TrialTemplate,
+    )
+    from katib_tpu.config import KatibConfig
+    from katib_tpu.controller.experiment import ExperimentController
+    from katib_tpu.runtime.packed import population_of, report_population
+    from katib_tpu.runtime.stepstats import PERF_PREFIX
+
+    pack_size = 8
+    reports = 20 if smoke else 100     # report_population is the hot site
+    work = 200 if smoke else 20000     # busy-work per step (see
+    # tracing_overhead: an empty loop measures scheduler noise, not the
+    # plane; the <3% target is cost relative to a realistically-busy pack)
+    lrs = [str(round(0.1 + 0.1 * i, 1)) for i in range(pack_size)]
+
+    def pack_fn(assignments, ctx=None):
+        pop = population_of(assignments)
+        lr = pop["lr"]
+        for step in range(reports):
+            acc = 0
+            for j in range(work):
+                acc += j & 7
+            report_population(ctx, score=lr * (step + 1) + 1e-9 * acc,
+                              examples=pack_size)
+
+    pack_fn.supports_packing = True
+    counter = {"n": 0}
+
+    def run_once(stats_on: bool, inject: str = ""):
+        counter["n"] += 1
+        prev = os.environ.pop("KATIB_TPU_STEP_STATS_INJECT", None)
+        if inject:
+            os.environ["KATIB_TPU_STEP_STATS_INJECT"] = inject
+        cfg = KatibConfig()
+        cfg.runtime.step_stats = stats_on
+        cfg.runtime.obslog_buffered = False
+        ctrl = ExperimentController(
+            root_dir=None, devices=list(range(8)), persist=False, config=cfg
+        )
+        name = f"stepstats-bench-{counter['n']}"
+        spec = ExperimentSpec(
+            name=name,
+            parameters=[
+                ParameterSpec("lr", ParameterType.DISCRETE, FeasibleSpace(list=lrs))
+            ],
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            algorithm=AlgorithmSpec("grid"),
+            trial_template=TrialTemplate(
+                function=pack_fn, resources=TrialResources(pack_size=pack_size)
+            ),
+            max_trial_count=pack_size,
+            parallel_trial_count=pack_size,
+        )
+        try:
+            ctrl.create_experiment(spec)
+            t0 = time.perf_counter()
+            exp = ctrl.run(name, timeout=300)
+            dt = time.perf_counter() - t0
+            assert exp.status.trials_succeeded == pack_size, (
+                f"{exp.status.trials_succeeded}/{pack_size} succeeded"
+            )
+            perf_rows = sum(
+                1
+                for t in ctrl.state.list_trials(name)
+                for log in ctrl.obs_store.get_observation_log(t.name)
+                if log.metric_name.startswith(PERF_PREFIX)
+            )
+            rendered = ctrl.metrics.render()
+            stragglers = [
+                e for e in ctrl.events.list(name) if e.reason == "GangStraggler"
+            ]
+            if stats_on:
+                assert perf_rows > 0, "step stats on but no perf rows"
+                assert "katib_step_seconds" in rendered
+            else:
+                assert perf_rows == 0, (
+                    f"knob off but {perf_rows} perf rows written"
+                )
+                assert "katib_step_seconds" not in rendered
+                assert "katib_trial_throughput" not in rendered
+            return dt, stragglers
+        finally:
+            ctrl.close()
+            if inject:
+                del os.environ["KATIB_TPU_STEP_STATS_INJECT"]
+            if prev is not None:
+                os.environ["KATIB_TPU_STEP_STATS_INJECT"] = prev
+
+    run_once(False)  # warmup
+    passes = 2 if smoke else 3
+    on_s, off_s = [], []
+    for _ in range(passes):
+        off_s.append(run_once(False)[0])
+        on_s.append(run_once(True)[0])
+    on, off = min(on_s), min(off_s)
+    overhead_pct = (on - off) / off * 100.0
+    # injected straggler: member 3 runs 8x slow — exactly one gang member
+    # must cross the straggler_ratio*median line
+    _, stragglers = run_once(True, inject="straggle=3@8.0")
+    assert len(stragglers) == 1, (
+        f"expected exactly 1 GangStraggler event, got {len(stragglers)}"
+    )
+    return {
+        "pack_size": pack_size,
+        "reports_per_member": reports,
+        "passes": passes,
+        "off_s": round(off, 4),
+        "on_s": round(on, 4),
+        "overhead_pct": round(overhead_pct, 2),
+        "target_pct": 3.0,
+        "within_target": overhead_pct < 3.0,
+        "straggler_events": len(stragglers),
+        "smoke": smoke,
+    }
+
+
 def _bench_telemetry_overhead(smoke: bool = False):
     """Resource telemetry (katib_tpu/telemetry.py): end-to-end trials/sec of
     an in-process experiment with ``runtime.telemetry`` on vs off. The
@@ -4620,6 +4750,7 @@ OBSLOG_SCENARIOS = {
     "obslog_report_throughput": _bench_obslog_report_throughput,
     "obslog_fold_latency": _bench_obslog_fold_latency,
     "tracing_overhead": _bench_tracing_overhead,
+    "step_stats_overhead": _bench_step_stats_overhead,
     "telemetry_overhead": _bench_telemetry_overhead,
     "check_latency": _bench_check_latency,
     "analyze_latency": _bench_analyze_latency,

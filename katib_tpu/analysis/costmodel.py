@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 # elementwise primitives charged one op per output element
 _ELEMENTWISE = {
@@ -263,3 +263,79 @@ def estimate_cost(closed_jaxpr, param_bytes: int = 0) -> CostEstimate:
         eqns=eqns,
         notes=notes,
     )
+
+
+# -- MFU accounting (step-statistics plane, ISSUE 20) -------------------------
+#
+# Model-FLOPs-utilization = achieved FLOP/s divided by the hardware peak —
+# the primary fleet-health ratio of the pjit/TPUv4 paper (arXiv:2204.06514,
+# §5: published MFU 39.8%–46.6% for PaLM-class runs; BENCH_r02 hand-computed
+# 0.54 for the flash-attention microbench). The numerator comes from the
+# same static cost model the compile plane already runs (CostEstimate.flops
+# = FLOPs of ONE traced step program); the denominator is the per-chip
+# dense peak from the table below times the gang size.
+
+# Dense bf16 peak FLOP/s per chip. TPU numbers are the published per-chip
+# peaks (v4 275 TFLOP/s, v5e 197, v5p 459, v6e 918); GPU entries cover the
+# common single-host dev boxes; "cpu" is a nominal 100 GFLOP/s placeholder
+# so CPU smoke runs still produce a ratio (meaningful only relatively —
+# override with $KATIB_TPU_PEAK_FLOPS for calibrated numbers).
+PEAK_FLOPS: Dict[str, float] = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,
+    "tpu v6e": 918e12,
+    "h100": 989e12,
+    "a100": 312e12,
+    "cpu": 100e9,
+}
+
+ENV_PEAK_FLOPS = "KATIB_TPU_PEAK_FLOPS"
+
+
+def peak_flops_for(device_kind: Optional[str] = None) -> Optional[float]:
+    """Per-chip peak FLOP/s for a device kind (jax Device.device_kind, any
+    case), from $KATIB_TPU_PEAK_FLOPS when set (operator calibration wins),
+    else the table by longest matching key. None when the kind is unknown —
+    callers must then skip MFU rather than report a wrong ratio."""
+    import os
+
+    env = os.environ.get(ENV_PEAK_FLOPS)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if not device_kind:
+        return None
+    kind = device_kind.strip().lower()
+    best: Optional[float] = None
+    best_len = -1
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind and len(key) > best_len:
+            best, best_len = peak, len(key)
+    return best
+
+
+def mfu(
+    cost_estimate: Optional["CostEstimate"],
+    step_seconds: float,
+    n_devices: int,
+    peak: Optional[float] = None,
+    device_kind: Optional[str] = None,
+) -> Optional[float]:
+    """Model-FLOPs-utilization for one step: cost.flops / (step_seconds ×
+    n_devices × per-chip peak). None whenever any input is missing or
+    degenerate — an absent MFU is better than a fabricated one."""
+    if cost_estimate is None or step_seconds <= 0 or n_devices <= 0:
+        return None
+    flops = float(getattr(cost_estimate, "flops", 0.0) or 0.0)
+    if flops <= 0:
+        return None
+    if peak is None:
+        peak = peak_flops_for(device_kind)
+    if peak is None or peak <= 0:
+        return None
+    return flops / (step_seconds * n_devices * peak)

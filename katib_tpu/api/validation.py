@@ -168,6 +168,18 @@ def _validate_objective(spec: ExperimentSpec, errs: List[str]) -> None:
         errs.append("objective.objectiveMetricName must be specified")
     if obj.objective_metric_name in obj.additional_metric_names:
         errs.append("objective.additionalMetricNames should not contain objectiveMetricName")
+    # katib-tpu/perf/ is the step-statistics plane's reserved observation
+    # namespace (runtime/stepstats.py): the folder ignores it BY NAME, so an
+    # objective under it would fold nothing and every trial would finish
+    # MetricsUnavailable — reject at admission instead
+    from ..runtime.stepstats import PERF_PREFIX
+
+    for name in [obj.objective_metric_name, *obj.additional_metric_names]:
+        if name and name.startswith(PERF_PREFIX):
+            errs.append(
+                f"metric name {name!r} is under the reserved {PERF_PREFIX!r} "
+                "namespace (step-statistics rows; never folded as objectives)"
+            )
 
 
 def _validate_algorithm(spec: ExperimentSpec, known: Optional[set], errs: List[str]) -> None:

@@ -323,14 +323,25 @@ def cmd_trace(args) -> int:
         rows = []
         for t in traces:
             dur = t.get("rootDurationSeconds")
+            # the deep-profile linkage (ISSUE 20): the trial root span is
+            # stamped with the xplane dump dir when profiling dumps survived
+            profile = "-"
+            for s in t.get("spans", []):
+                if s.get("parentId") is None:
+                    profile = (s.get("attrs") or {}).get("profileDir") or "-"
+                    break
             rows.append((
                 t.get("trial") or "?",
                 (t.get("traceId") or "?")[:16],
                 f"{dur:.3f}" if dur is not None else "-",
                 len(t.get("spans", [])),
                 ",".join(t.get("replicas") or []) or "-",
+                profile,
             ))
-        _table(["TRIAL", "TRACE", "ROOT-SECONDS", "SPANS", "REPLICAS"], rows)
+        _table(
+            ["TRIAL", "TRACE", "ROOT-SECONDS", "SPANS", "REPLICAS", "PROFILE"],
+            rows,
+        )
         all_spans = [
             Span.from_dict(s) for t in traces for s in t.get("spans", [])
         ]
@@ -422,6 +433,32 @@ def cmd_fleet(args) -> int:
                 f"(no replicas registered under {args.root}/placement/"
                 "replicas — is this the shared state root?)"
             )
+        # step-performance rollups (ISSUE 20): one row per (replica,
+        # experiment) with perf gauges — present only when the step-stats
+        # knob was on somewhere in the fleet
+        perf_rows = []
+        for r in snap["replicas"]:
+            for exp, p in ((r.get("metrics") or {}).get("perf") or {}).items():
+                p95 = p.get("p95")
+                thr = p.get("throughput")
+                mfu_v = p.get("mfu")
+                perf_rows.append((
+                    r.get("replica") or "?",
+                    exp,
+                    f"{p95:.4f}" if p95 is not None else "-",
+                    f"{thr:.2f}" if thr is not None else "-",
+                    f"{mfu_v:.3f}" if mfu_v is not None else "-",
+                    int(p.get("retraces", 0)),
+                    f"{p['objectivePerDeviceSecond']:.6g}"
+                    if p.get("objectivePerDeviceSecond") is not None else "-",
+                ))
+        if perf_rows:
+            print()
+            _table(
+                ["REPLICA", "EXPERIMENT", "STEP-P95", "STEPS/S", "MFU",
+                 "RETRACES", "OBJ/DEV-S"],
+                perf_rows,
+            )
         tenants = snap.get("tenants") or []
         if tenants:
             print()
@@ -446,6 +483,65 @@ def cmd_fleet(args) -> int:
         except KeyboardInterrupt:
             return 0
         print()
+
+
+def cmd_perf(args) -> int:
+    """Step-performance table (ISSUE 20): per-trial step timing, throughput,
+    MFU and retrace counts folded offline from the persisted perf rows
+    (``katib-tpu/perf/`` observation namespace). Empty unless the sweep ran
+    with runtime.step_stats / KATIB_TPU_STEP_STATS on."""
+    from .runtime.stepstats import summarize_perf_rows
+
+    ctrl = _controller(args.root, readonly=True)
+    _load_all(ctrl, args.root)
+    exp = ctrl.state.get_experiment(args.experiment)
+    if exp is None:
+        print(f"experiment {args.experiment!r} not found", file=sys.stderr)
+        return 1
+    trials = ctrl.state.list_trials(args.experiment)
+    summaries = []
+    for t in trials:
+        s = summarize_perf_rows(ctrl.obs_store.get_observation_log(t.name))
+        if s is not None:
+            summaries.append((t, s))
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "experiment": args.experiment,
+                "trials": [
+                    dict(s, trial=t.name, status=t.condition.value)
+                    for t, s in summaries
+                ],
+            },
+            indent=2, sort_keys=True,
+        ))
+        return 0
+    if not summaries:
+        print(
+            f"no step-performance rows for experiment {args.experiment!r} "
+            "(run with KATIB_TPU_STEP_STATS=1 / runtime.step_stats)"
+        )
+        return 0
+
+    def fmt(v, spec="{:.4f}"):
+        return spec.format(v) if v is not None else "-"
+
+    rows = [
+        (
+            t.name, t.condition.value, s["stints"], s["windows"],
+            fmt(s["stepSecondsP50"]), fmt(s["stepSecondsP95"]),
+            fmt(s["stepsPerSecond"], "{:.2f}"),
+            fmt(s["examplesPerSecond"], "{:.2f}"),
+            fmt(s["mfu"], "{:.3f}"), s["retraces"],
+        )
+        for t, s in summaries
+    ]
+    _table(
+        ["TRIAL", "STATUS", "STINTS", "WINDOWS", "STEP-P50", "STEP-P95",
+         "STEPS/S", "EXAMPLES/S", "MFU", "RETRACES"],
+        rows,
+    )
+    return 0
 
 
 def cmd_top(args) -> int:
@@ -1216,6 +1312,18 @@ def main(argv=None) -> int:
     )
     fl.add_argument("--interval", type=float, default=5.0)
     fl.set_defaults(fn=cmd_fleet)
+
+    pf = sub.add_parser(
+        "perf",
+        help="per-trial step timing, throughput, MFU and retraces from the "
+        "persisted katib-tpu/perf/ rows (needs runtime.step_stats on)",
+    )
+    pf.add_argument("experiment")
+    pf.add_argument(
+        "--format", choices=("table", "json"), default="table",
+        help="table (default) or the full per-trial summaries as JSON",
+    )
+    pf.set_defaults(fn=cmd_perf)
 
     tp = sub.add_parser(
         "top",

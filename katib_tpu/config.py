@@ -231,6 +231,28 @@ class RuntimeConfig:
     # unset keeps the SQLite dialect. Requires a Postgres driver
     # (psycopg2/pg8000) in the environment.
     pg_dsn: Optional[str] = None
+    # -- step-statistics plane (runtime/stepstats.py + controller/
+    # stepstats.py, ISSUE 20): when True every trial context carries a step
+    # clock — per-step wall durations, steps/sec, optional examples/tokens
+    # throughput, retrace counters off JAX's compile events — flushed
+    # through the observation pipeline under the reserved katib-tpu/perf/
+    # namespace, rolled up per experiment on /metrics, and watched by the
+    # RetraceStorm / GangStraggler / StepTimeRegression detectors. False
+    # (default) is byte-identical wire, span set, /metrics, and observation
+    # rows (asserted by a seeded on-vs-off test).
+    step_stats: bool = False
+    # perf window size: the step clock flushes one summary row set every
+    # this many steps (mean/p95 step seconds, steps/sec, throughput)
+    step_stats_flush_steps: int = 32
+    # RetraceStorm: warning event when one stint re-compiles more than this
+    # many times after the first compile
+    retrace_storm_threshold: int = 8
+    # GangStraggler: warning event when a packed/fused member's p95 step
+    # time exceeds the gang median p95 by this ratio
+    straggler_ratio: float = 2.0
+    # StepTimeRegression: warning event when a resumed/promoted stint's p50
+    # step time exceeds the same trial's prior-stint baseline by this ratio
+    step_regression_ratio: float = 1.5
 
 
 # Every RuntimeConfig knob is overridable from the environment without
@@ -298,6 +320,11 @@ ENV_OVERRIDES: Dict[str, str] = {
     "slo_objectives": "KATIB_TPU_SLO_OBJECTIVES",
     "slow_rpc_ring": "KATIB_TPU_SLOW_RPC_RING",
     "pg_dsn": "KATIB_TPU_PG_DSN",
+    "step_stats": "KATIB_TPU_STEP_STATS",
+    "step_stats_flush_steps": "KATIB_TPU_STEP_STATS_FLUSH_STEPS",
+    "retrace_storm_threshold": "KATIB_TPU_RETRACE_STORM_THRESHOLD",
+    "straggler_ratio": "KATIB_TPU_STRAGGLER_RATIO",
+    "step_regression_ratio": "KATIB_TPU_STEP_REGRESSION_RATIO",
 }
 
 _FALSY = ("0", "false", "off")

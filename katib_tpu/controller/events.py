@@ -395,6 +395,14 @@ _HELP_CATALOG: Dict[str, str] = {
     "katib_tenant_requests_total": "Wire requests admitted under a resolved tenant identity, by tenant.",
     "katib_tenant_denied_total": "Cross-tenant or unauthorized wire requests rejected (403 / ERR frame), by tenant and plane.",
     "katib_tenant_quota_refusals_total": "Experiment admissions refused with a tenant-tagged 429 (admission rate or max-experiments quota).",
+    # step-statistics plane (ISSUE 20, runtime/stepstats.py + controller/
+    # stepstats.py) — the RetraceStorm / GangStraggler / StepTimeRegression
+    # warning events pair with these series
+    "katib_step_seconds": "Per-experiment step-time rollup over recent stints, by quantile (p50/p95).",
+    "katib_trial_throughput": "Aggregate steps per second per experiment (total steps / total step-seconds over recent stints).",
+    "katib_trial_mfu_ratio": "Latest model-FLOPs-utilization per experiment (cost-model FLOPs / achieved FLOP/s over hardware peak).",
+    "katib_trial_retraces_total": "Recompiles past the first compile observed by trial stints (JAX compile events), per experiment.",
+    "katib_objective_per_device_second": "Best objective divided by accumulated gang device-seconds, per experiment (ROADMAP 3c admission signal).",
 }
 
 
@@ -472,4 +480,8 @@ EVENT_CATALOG: Dict[str, str] = {
     "TenantQuotaRefused": "An experiment admission was refused with a tenant-tagged 429 (admission rate or max-experiments quota exceeded).",
     # distributed tracing plane (ISSUE 19, tracing.py + both wire planes)
     "TraceContextInvalid": "A wire request carried a malformed or oversized traceparent (header or frame field); the context was ignored and the request served without it.",
+    # step-statistics plane (ISSUE 20, controller/stepstats.py)
+    "RetraceStorm": "One stint recompiled more than runtime.retrace_storm_threshold times past the first compile — the train loop is likely shape-unstable and burning its step budget on XLA retraces.",
+    "GangStraggler": "A packed/fused member's p95 step time exceeded the gang median by runtime.straggler_ratio — the slowest member is pacing the shared program.",
+    "StepTimeRegression": "A resumed/promoted stint's p50 step time exceeded the same trial's prior-stint baseline (persisted perf rows) by runtime.step_regression_ratio.",
 }

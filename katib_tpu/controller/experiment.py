@@ -292,6 +292,26 @@ class ExperimentController:
                 ),
             )
             self.device_plane.start()
+        # Step-statistics plane (controller/stepstats.py + runtime/
+        # stepstats.py, ISSUE 20): per-step timing/throughput/MFU series
+        # under the reserved katib-tpu/perf/ namespace, per-experiment
+        # rollups on /metrics, and the RetraceStorm / GangStraggler /
+        # StepTimeRegression detectors. Disabled (default,
+        # runtime.step_stats=false / KATIB_TPU_STEP_STATS unset) nothing is
+        # constructed: wire, span set, /metrics, and observation rows are
+        # byte-identical.
+        self.step_stats = None
+        if rt.step_stats:
+            from .stepstats import StepStatsPlane
+
+            self.step_stats = StepStatsPlane(
+                metrics=self.metrics,
+                events=self.events,
+                flush_steps=rt.step_stats_flush_steps,
+                retrace_storm_threshold=rt.retrace_storm_threshold,
+                straggler_ratio=rt.straggler_ratio,
+                regression_ratio=rt.step_regression_ratio,
+            )
         workdir_root = os.path.join(root_dir, "trials") if root_dir else None
         self.scheduler = TrialScheduler(
             self.state,
@@ -326,6 +346,7 @@ class ExperimentController:
             multifidelity=self.multifidelity,
             device_plane=self.device_plane,
             journal=self.journal,
+            step_stats=self.step_stats,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -1097,6 +1118,8 @@ class ExperimentController:
         self.scheduler.forget_experiment(name)
         if self.multifidelity is not None:
             self.multifidelity.forget(name)
+        if self.step_stats is not None:
+            self.step_stats.forget_experiment(name)
         self.tracer.forget(name)
         self._completed_seen.discard(name)
         self.metrics.inc("katib_experiment_deleted_total", experiment=name)

@@ -506,6 +506,10 @@ class FusedPopulationExecutor:
                 "population_chunk", t0, _time.time(),
                 generations=length, startGeneration=done,
             )
+            # step-stats plane: the chunk is the gang's step loop — credit
+            # its wall time as `length` steps to every active member's
+            # clock (no-op when step stats are off)
+            ctx.note_step_seconds(length, elapsed)
             done += length
             # checkpoint BEFORE demux: a preempt mid-demux re-persists the
             # progress counter; resume replays only unreported generations.

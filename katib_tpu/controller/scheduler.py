@@ -34,7 +34,7 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ..api.spec import CollectorKind, UNAVAILABLE_METRIC_VALUE
+from ..api.spec import CollectorKind, ObjectiveType, UNAVAILABLE_METRIC_VALUE
 from ..api.status import Experiment, Trial, TrialCondition
 from ..db.state import ExperimentStateStore
 from ..db.store import ObservationStore
@@ -143,6 +143,7 @@ class TrialScheduler:
         multifidelity=None,
         device_plane=None,
         journal=None,
+        step_stats=None,
     ):
         from .fairshare import FairSharePolicy
         from ..tracing import install_log_context
@@ -236,6 +237,10 @@ class TrialScheduler:
         # None = disabled: dispatch and terminal transitions leave no intent
         # records and every consult below is one `is None` check
         self.journal = journal
+        # -- step-statistics plane (controller/stepstats.py, ISSUE 20) -------
+        # None = disabled: no clocks are bound to contexts, no perf rows are
+        # written, and every consult below is one `is None` check
+        self.step_stats = step_stats
         self._gate_since: Dict[Any, float] = {}  # group key -> hold start
         self._gate_held: Dict[str, float] = {}   # trial -> hold start (spans)
         self._gate_timer_live = False            # one wake timer per hold
@@ -347,9 +352,27 @@ class TrialScheduler:
         Called AFTER all child spans closed so parents outlive children."""
         tr = self._tr()
         if tr is not None and trial.is_terminal:
+            attrs = {}
+            if self.workdir_root:
+                import os
+                # deep-profile linkage (runtime/profiling.py): when the trial
+                # captured xplane dumps, stamp their location on the root
+                # span so `katib-tpu trace <exp>` shows which trials have a
+                # profiler trace behind their spans. _record_terminal's
+                # retainRun cleanup ran already, so the stamp only lands
+                # when the dumps actually survive on disk (retained,
+                # failed/killed, or rung-paused workdirs).
+                from ..runtime.profiling import list_profile_artifacts
+
+                workdir = os.path.join(self.workdir_root, exp_name, trial.name)
+                if list_profile_artifacts(workdir):
+                    from ..runtime.profiling import PROFILE_DIRNAME
+
+                    attrs["profileDir"] = os.path.join(workdir, PROFILE_DIRNAME)
             tr.end_trial(
                 exp_name, trial.name,
                 outcome=trial.condition.value, reason=trial.current_reason,
+                **attrs,
             )
 
     def submit(
@@ -1058,6 +1081,7 @@ class TrialScheduler:
         requeued = False
         started = time.time()
         timer = None
+        ctx: Optional[TrialContext] = None
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         tr = self._tr()
@@ -1183,6 +1207,20 @@ class TrialScheduler:
                 # the stint's resource summary lands on the trial root span
                 # BEFORE it is ended/persisted below
                 self._telemetry_finalize(tm, trial.name, root)
+            if (
+                self.step_stats is not None
+                and ctx is not None
+                and ctx.step_clock is not None
+            ):
+                # stint rows + RetraceStorm/StepTimeRegression + rollups.
+                # Requeued/restarted stints skip persistence: their rows
+                # would be truncated to the last checkpoint on resume (or
+                # the log dropped on restart) — the next stint re-measures.
+                self.step_stats.finalize_stint(
+                    exp, trial.name, ctx.step_clock, self.obs_store,
+                    n_devices=len(devices),
+                    write_rows=not (requeued or restarted),
+                )
             if run_span is not None:
                 tr.end_span(exec_span)  # no-op unless an exception skipped it
                 tr.end_span(run_span, requeued=requeued, restarted=restarted)
@@ -1260,6 +1298,7 @@ class TrialScheduler:
         timer = None
         started = time.time()
         requeued: set = set()
+        ctx = None
         abandoned: Optional[threading.Thread] = None
         timed_out = threading.Event()
         pack_id = f"{trials[0].name}x{len(trials)}"
@@ -1400,6 +1439,19 @@ class TrialScheduler:
                         tm, t.name,
                         tr.trial_root(exp.name, t.name) if tr is not None else None,
                     )
+            if (
+                self.step_stats is not None
+                and ctx is not None
+                and getattr(ctx, "_step_clocks", None) is not None
+            ):
+                # per-member stint rows + detectors, then the gang-level
+                # straggler check; requeued members skip persistence (their
+                # rows truncate to the last checkpoint on resume)
+                self.step_stats.finalize_pack(
+                    exp, [t.name for t in trials], ctx._step_clocks,
+                    self.obs_store, n_devices=len(devices),
+                    requeued=[t.name in requeued for t in trials],
+                )
             if gang is not None:
                 for t in trials:
                     tr.end_span(gang.members.get(t.name))
@@ -1547,7 +1599,7 @@ class TrialScheduler:
                 workdir = os.path.join(self.workdir_root, exp.name, t.name)
                 os.makedirs(workdir, exist_ok=True)
             workdirs.append(workdir)
-        return PackedTrialContext(
+        ctx = PackedTrialContext(
             trial_names=[t.name for t in trials],
             experiment_name=exp.name,
             assignments=stack_assignments(trials),
@@ -1565,6 +1617,16 @@ class TrialScheduler:
                 self._note_checkpoint(n) for n in _names
             ],
         )
+        if self.step_stats is not None:
+            # one clock per member: the demux marks each active member's
+            # clock per report; fused sweeps time chunks instead
+            # (note_step_seconds) and the member index keys the straggler
+            # injection seam
+            ctx._step_clocks = [
+                self.step_stats.clock_for(member_index=i)
+                for i in range(len(trials))
+            ]
+        return ctx
 
     KILL_GRACE_SECONDS = 30.0
 
@@ -1685,6 +1747,10 @@ class TrialScheduler:
                 value=round(len(devices) * elapsed, 6),
                 experiment=exp.name,
             )
+        if self.step_stats is not None:
+            # objective-per-device-second rollup (ISSUE 20 satellite): every
+            # gang release charges its device-seconds, multi-fidelity or not
+            self.step_stats.charge_device_seconds(exp.name, len(devices) * elapsed)
         self.allocator.release(devices)
 
     def _note_checkpoint(self, trial_name: str) -> None:
@@ -1984,6 +2050,10 @@ class TrialScheduler:
                 if tm is not None else None
             ),
             compiled_program=compiled,
+            step_clock=(
+                self.step_stats.clock_for()
+                if self.step_stats is not None else None
+            ),
         )
 
     CONDITION_STDOUT_TAIL = 65536  # bytes of stdout offered to conditions
@@ -2093,6 +2163,16 @@ class TrialScheduler:
         metrics_available = (
             obj_metric is not None and obj_metric.latest != UNAVAILABLE_METRIC_VALUE
         )
+        if self.step_stats is not None and metrics_available:
+            # best-objective tracking for the per-device-second rollup;
+            # non-numeric objectives (custom string collectors) are skipped
+            try:
+                self.step_stats.note_objective(
+                    exp.name, float(obj_metric.latest),
+                    spec.objective.type == ObjectiveType.MAXIMIZE,
+                )
+            except (TypeError, ValueError):
+                pass
 
         if result.outcome == TrialOutcome.EARLY_STOPPED:
             trial.set_condition(

@@ -53,6 +53,12 @@ class TrialContext:
     # back to its own jit (which the shared persistent XLA cache still
     # amortizes).
     compiled_program: Optional[Any] = None
+    # Step clock (runtime/stepstats.py) — bound by the scheduler when
+    # runtime.step_stats is on. Every report marks one step and freshly
+    # completed perf windows are written through the observation store
+    # under the reserved katib-tpu/perf/ namespace. None when the plane is
+    # off: the hot path then pays one attribute check per report.
+    step_clock: Optional[Any] = None
 
     def bind_trace(self, tracer, experiment: str, trace_id: str, parent_id: str) -> None:
         """Attach the trial's trace context (scheduler-side hook)."""
@@ -81,6 +87,10 @@ class TrialContext:
         the train step dominates it on JAX workloads)."""
         if self.tracer is not None:
             self._compile_span = self._trace_span("compile")
+        if self.step_clock is not None:
+            from . import stepstats
+
+            self._step_clock_token = stepstats.activate([self.step_clock])
 
     def _trace_mark_report(self) -> None:
         """First report = compile boundary: end `compile`, open `steps`."""
@@ -93,6 +103,12 @@ class TrialContext:
 
     def _trace_fn_end(self) -> None:
         """Executor hook: the trial function returned/unwound."""
+        token = getattr(self, "_step_clock_token", None)
+        if token is not None:
+            from . import stepstats
+
+            stepstats.deactivate(token)
+            self._step_clock_token = None
         if self.tracer is None:
             return
         cs = getattr(self, "_compile_span", None)
@@ -115,6 +131,19 @@ class TrialContext:
             self._trace_mark_report()
         if self.on_report is not None:
             self.on_report()  # watchdog heartbeat BEFORE a possible unwind
+        sc = self.step_clock
+        if sc is not None:
+            from . import stepstats
+
+            sc.mark(metrics)
+            rows = sc.drain()
+            if rows:
+                # perf rows land BEFORE the report so the kill/preempt
+                # flush barrier in MetricsReporter.report makes them
+                # durable ahead of any unwind
+                self.reporter.store.report_observation_log(
+                    self.trial_name, stepstats.perf_logs(rows)
+                )
         self.reporter.report(**metrics)
 
     def flush_metrics(self) -> None:

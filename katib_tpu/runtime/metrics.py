@@ -327,6 +327,14 @@ def report_metrics(metrics: Optional[Dict[str, float]] = None, **kw: float) -> N
             store = _env_bound_rpc_store(rpc_url)
         else:
             store = _env_bound_store(db)
+        # step-stats plane (runtime/stepstats.py): a subprocess trial
+        # inherits KATIB_TPU_STEP_STATS from the controller env; its perf
+        # windows ride the same store binding. Empty (no clock) when unset.
+        from .stepstats import env_perf_logs
+
+        perf = env_perf_logs(trial, merged)
+        if perf:
+            store.report_observation_log(trial, perf)
         MetricsReporter(store=store, trial_name=trial).report(**merged)
         # rejoin the controller trace: $KATIB_TPU_TRACEPARENT (issued by the
         # subprocess executor) parents this process's report span onto the

@@ -158,6 +158,10 @@ class PackedTrialContext:
         self._compile_span = None
         self._steps_span = None
         self._report_count = 0
+        # per-member step clocks (runtime/stepstats.py) — bound by the
+        # scheduler when runtime.step_stats is on; None otherwise
+        self._step_clocks: Optional[List[Any]] = None
+        self._step_clock_token = None
 
     # -- tracing hooks (one shared program -> spans in the gang trace) -------
 
@@ -173,6 +177,12 @@ class PackedTrialContext:
                 "compile", self._trace_experiment, self._trace_id,
                 self._trace_parent, attrs={"packSize": self.pack_size},
             )
+        if self._step_clocks is not None:
+            from . import stepstats
+
+            # one shared compiled program: a recompile retraces the gang,
+            # so compile events are charged to every member's clock
+            self._step_clock_token = stepstats.activate(self._step_clocks)
 
     def _trace_mark_report(self) -> None:
         self._report_count += 1
@@ -184,6 +194,11 @@ class PackedTrialContext:
             )
 
     def _trace_fn_end(self) -> None:
+        if self._step_clock_token is not None:
+            from . import stepstats
+
+            stepstats.deactivate(self._step_clock_token)
+            self._step_clock_token = None
         if self._tracer is None:
             return
         if self._compile_span is not None:
@@ -328,9 +343,17 @@ class PackedTrialContext:
         for i in range(k):
             if not self._active[i]:
                 continue
-            fvals, logs = self.reporters[i].build_logs(
-                {name: float(col[i]) for name, col in cols.items()}, timestamp=ts
-            )
+            member_vals = {name: float(col[i]) for name, col in cols.items()}
+            fvals, logs = self.reporters[i].build_logs(member_vals, timestamp=ts)
+            if self._step_clocks is not None:
+                from . import stepstats
+
+                clock = self._step_clocks[i]
+                clock.mark(member_vals)
+                # perf rows ride each member's batch entry: one report_many
+                # keeps the pack off the store lock, and the freeze
+                # barrier below makes them durable with the member's rows
+                logs.extend(stepstats.perf_logs(clock.drain(), timestamp=ts))
             batch.append((self.reporters[i].trial_name, logs))
             written.append((i, fvals))
         if batch and store is not None:
@@ -368,6 +391,18 @@ class PackedTrialContext:
             raise PackFrozen(
                 f"all {k} members of pack {self.trial_names} are frozen"
             )
+
+    def note_step_seconds(self, n: int, total_seconds: float) -> None:
+        """Fused-sweep chunk timing: ``n`` generations ran in one compiled
+        chunk taking ``total_seconds`` — credited to every still-active
+        member's step clock (the chunk IS the gang's step loop). Switches
+        the clocks to external mode so the demux-time reports that follow
+        do not double-count. No-op when step stats are off."""
+        if self._step_clocks is None:
+            return
+        for i, clock in enumerate(self._step_clocks):
+            if self._active[i]:
+                clock.note_steps(n, total_seconds)
 
     # -- terminal-state views consumed by the PackedTrialExecutor ------------
 

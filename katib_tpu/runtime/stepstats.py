@@ -1,0 +1,424 @@
+"""Step clock — the per-trial half of the step-statistics plane (ISSUE 20).
+
+The pjit/TPUv4 fleet paper (arXiv:2204.06514) treats step time and MFU as
+the primary health signals of a TPU training fleet; Podracer (arXiv:
+2104.06272) tunes packed schedulers off exactly this telemetry. This module
+measures it from the one vantage point the runtime already owns: every
+``ctx.report()`` is one step boundary. A :class:`StepClock` accumulates
+per-step wall durations in a bounded ring, counts (re)compiles off JAX's
+monitoring events, and flushes windowed summaries through the ordinary
+observation pipeline under the reserved ``katib-tpu/perf/`` namespace —
+rows the objective folder never folds (``folded`` only reads requested
+metric names), so perf series can never pollute folding, warm-start
+signatures, or BOHB rung models.
+
+Everything here is inert unless the scheduler binds a clock to the trial
+context (``runtime.step_stats`` / ``KATIB_TPU_STEP_STATS``): knob off means
+no clock object exists and ``ctx.report`` pays one ``is None`` check.
+
+Determinism seams (used by the durability tests — perf series for a trial
+SIGKILLed mid-stint and failed over must be bit-identical to a fault-free
+run):
+
+- ``KATIB_TPU_STEP_STATS_CLOCK=counter`` replaces wall time with a counter:
+  every report records exactly one 1.0 s step, so row VALUES are exact and
+  replayed reports reproduce identical rows.
+- ``KATIB_TPU_STEP_STATS_INJECT`` injects faults for detector tests:
+  ``straggle=<member>@<factor>`` scales that pack member's recorded
+  durations (GangStraggler), ``retrace=<n>`` records one synthetic
+  recompile per step until n have fired (RetraceStorm). Comma-separated.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..db.store import MetricLog
+
+# Reserved metric namespace. spec validation rejects objective/metric names
+# under it, and the perf CLI / detectors read it back by this prefix.
+PERF_PREFIX = "katib-tpu/perf/"
+
+ENV_STEP_STATS = "KATIB_TPU_STEP_STATS"
+ENV_CLOCK = "KATIB_TPU_STEP_STATS_CLOCK"
+ENV_INJECT = "KATIB_TPU_STEP_STATS_INJECT"
+ENV_FLUSH_STEPS = "KATIB_TPU_STEP_STATS_FLUSH_STEPS"
+
+# per-step durations kept for stint percentiles (windows flush long before
+# this; the ring only bounds stint-end p50/p95 memory on million-step runs)
+RING_STEPS = 4096
+
+# report kwargs the clock reads for throughput. They stay ordinary metric
+# rows (the clock observes, never consumes) — knob off leaves them untouched.
+VOLUME_KEYS = ("examples", "tokens")
+
+
+def _truthy(v: Optional[str]) -> bool:
+    return bool(v) and v.strip().lower() not in ("", "0", "false", "off")
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted sequence (deterministic,
+    no interpolation — replayed series must reproduce values exactly)."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    rank = max(1, min(n, int(-(-q * n // 1))))  # ceil(q*n), clamped
+    return float(sorted_vals[rank - 1])
+
+
+def _parse_inject() -> Tuple[Optional[Tuple[int, float]], int]:
+    spec = os.environ.get(ENV_INJECT, "") or ""
+    straggle: Optional[Tuple[int, float]] = None
+    retraces = 0
+    for part in spec.split(","):
+        part = part.strip()
+        try:
+            if part.startswith("straggle="):
+                body = part[len("straggle="):]
+                idx, _, factor = body.partition("@")
+                straggle = (int(idx), float(factor) if factor else 2.0)
+            elif part.startswith("retrace="):
+                retraces = int(part[len("retrace="):])
+        except ValueError:
+            continue  # malformed injection spec: ignore, never fail a trial
+    return straggle, retraces
+
+
+@dataclass
+class StintSummary:
+    """What one ended stint measured — consumed by the controller plane's
+    rollups and detectors (controller/stepstats.py)."""
+
+    steps: int
+    seconds: float
+    p50: float
+    p95: float
+    retraces: int
+    examples: float
+    member_index: Optional[int] = None
+
+    @property
+    def steps_per_second(self) -> float:
+        return self.steps / self.seconds if self.seconds > 0 else 0.0
+
+
+class StepClock:
+    """Per-trial (or per-pack-member) step timer.
+
+    ``mark()`` is called on every ``ctx.report`` — each call records the
+    wall duration since the previous one as one step. Fused population
+    sweeps time whole chunks instead (``note_steps``), which switches the
+    clock to external mode so demux-time reports stop double-counting.
+    Completed windows (every ``flush_steps`` steps) are retrieved with
+    ``drain()`` as ``(name, value)`` rows the caller writes under
+    :data:`PERF_PREFIX`; ``finalize()`` closes the last partial window and
+    appends the stint-level p50/p95 rows.
+    """
+
+    def __init__(
+        self, flush_steps: int = 32, member_index: Optional[int] = None
+    ) -> None:
+        self.flush_steps = max(1, int(flush_steps))
+        self.member_index = member_index
+        self._counter_mode = (os.environ.get(ENV_CLOCK) or "") == "counter"
+        straggle, inject_retraces = _parse_inject()
+        self._factor = 1.0
+        if (
+            straggle is not None
+            and member_index is not None
+            and straggle[0] == member_index
+        ):
+            self._factor = straggle[1]
+        self._inject_retraces_left = inject_retraces
+        self._external = False
+        self._last_mark: Optional[float] = None
+        self._pending: List[float] = []
+        self._ring: deque = deque(maxlen=RING_STEPS)
+        self._windows: List[List[Tuple[str, float]]] = []
+        self._compiles = 0
+        self._window_retraces = 0
+        self._window_volume = 0.0
+        self._total_steps = 0
+        self._total_seconds = 0.0
+        self._total_examples = 0.0
+        self._finalized = False
+
+    # -- recording -----------------------------------------------------------
+
+    def mark(self, metrics: Optional[Dict[str, Any]] = None) -> None:
+        """One report happened. Reads (never consumes) examples/tokens for
+        throughput; records one step duration unless an external timer
+        (``note_steps``) owns this clock."""
+        if metrics:
+            for key in VOLUME_KEYS:
+                v = metrics.get(key)
+                if v is not None:
+                    try:
+                        fv = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    self._window_volume += fv
+                    self._total_examples += fv
+        if self._external:
+            return
+        if self._counter_mode:
+            self._record(1.0)
+            return
+        now = time.time()
+        if self._last_mark is None:
+            # first report closes the compile stretch — not a step
+            self._last_mark = now
+            return
+        d = now - self._last_mark
+        self._last_mark = now
+        self._record(d)
+
+    def note_steps(self, n: int, total_seconds: float) -> None:
+        """External timing for fused sweeps: one compiled chunk of ``n``
+        generations took ``total_seconds``. Switches the clock to external
+        mode — demux-time ``mark()`` calls then only harvest volume."""
+        self._external = True
+        n = max(1, int(n))
+        per = 1.0 if self._counter_mode else total_seconds / n
+        for _ in range(n):
+            self._record(per)
+
+    def note_compile(self) -> None:
+        """One backend compile finished (JAX monitoring event). Retraces are
+        compiles past the first — the initial trace-and-compile is the
+        expected cost, every later one is a retrace."""
+        self._compiles += 1
+        if self._compiles > 1:
+            self._window_retraces += 1
+
+    def _record(self, d: float) -> None:
+        d *= self._factor
+        if self._inject_retraces_left > 0:
+            self._inject_retraces_left -= 1
+            if self._compiles == 0:
+                self.note_compile()  # baseline compile; retraces count past it
+            self.note_compile()
+        self._pending.append(d)
+        self._ring.append(d)
+        self._total_steps += 1
+        self._total_seconds += d
+        if len(self._pending) >= self.flush_steps:
+            self._flush_window()
+
+    def _flush_window(self) -> None:
+        w = self._pending
+        if not w:
+            return
+        self._pending = []
+        n = len(w)
+        total = sum(w)
+        srt = sorted(w)
+        rows: List[Tuple[str, float]] = [
+            ("step_seconds_mean", total / n),
+            ("step_seconds_p95", _percentile(srt, 0.95)),
+        ]
+        if total > 0:
+            rows.append(("steps_per_second", n / total))
+            if self._window_volume > 0:
+                rows.append(("examples_per_second", self._window_volume / total))
+        if self._window_retraces > 0:
+            rows.append(("retraces", float(self._window_retraces)))
+        self._window_volume = 0.0
+        self._window_retraces = 0
+        self._windows.append(rows)
+
+    # -- harvesting ----------------------------------------------------------
+
+    def drain(self) -> List[Tuple[str, float]]:
+        """Completed windows' rows, flattened in flush order (names WITHOUT
+        the katib-tpu/perf/ prefix — ``perf_logs`` adds it)."""
+        if not self._windows:
+            return []
+        out: List[Tuple[str, float]] = []
+        for w in self._windows:
+            out.extend(w)
+        self._windows = []
+        return out
+
+    @property
+    def retraces(self) -> int:
+        return max(0, self._compiles - 1)
+
+    def finalize(self) -> Tuple[List[Tuple[str, float]], StintSummary]:
+        """Stint ended: close the partial window, emit stint-level rows.
+
+        Stint rows carry only duration-derived stats (p50/p95) — never raw
+        counts — so a failed-over trial's replayed series stays bit-identical
+        to a fault-free run (counts would differ across the resume seam)."""
+        self._flush_window()
+        rows = self.drain()
+        durs = sorted(self._ring)
+        p50 = _percentile(durs, 0.50)
+        p95 = _percentile(durs, 0.95)
+        if durs:
+            rows.append(("stint_step_seconds_p50", p50))
+            rows.append(("stint_step_seconds_p95", p95))
+        self._finalized = True
+        return rows, StintSummary(
+            steps=self._total_steps,
+            seconds=self._total_seconds,
+            p50=p50,
+            p95=p95,
+            retraces=self.retraces,
+            examples=self._total_examples,
+            member_index=self.member_index,
+        )
+
+
+def perf_logs(
+    rows: Sequence[Tuple[str, float]], timestamp: Optional[float] = None
+) -> List[MetricLog]:
+    """Row tuples -> MetricLogs under the reserved namespace, formatted the
+    same way MetricsReporter stores values (str(float)) so perf rows ride
+    every store backend and the wire planes unchanged."""
+    if not rows:
+        return []
+    ts = timestamp if timestamp is not None else time.time()
+    return [
+        MetricLog(timestamp=ts, metric_name=PERF_PREFIX + name, value=str(float(v)))
+        for name, v in rows
+    ]
+
+
+# -- JAX compile-event attribution -------------------------------------------
+#
+# jax.monitoring fires '/jax/core/compile/backend_compile_duration' (name
+# varies by version; anything mentioning "compile" counts) on every backend
+# compile. The listener registry is process-global, so attribution rides a
+# contextvar set around the trial function: compiles happen synchronously in
+# the executing thread, which sees its own context. For a pack there is one
+# shared program — a recompile is charged to every active member's clock
+# (the gang retraces together).
+
+_active_clocks: contextvars.ContextVar[Optional[Tuple[StepClock, ...]]] = (
+    contextvars.ContextVar("katib_tpu_step_clocks", default=None)
+)
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **kwargs: Any) -> None:
+    if "compile" not in event:
+        return
+    clocks = _active_clocks.get()
+    if not clocks:
+        return
+    for c in clocks:
+        c.note_compile()
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_event_duration)
+        except Exception:
+            pass  # no jax / no monitoring API: step timing still works
+
+
+def activate(clocks: Sequence[StepClock]):
+    """Route this thread's compile events to ``clocks`` until the returned
+    token is passed to :func:`deactivate`. Called by the trial-fn start
+    hooks; the listener install is one-time and knob-gated by construction
+    (no clock objects exist when step_stats is off)."""
+    _install_listener()
+    return _active_clocks.set(tuple(clocks))
+
+
+def deactivate(token) -> None:
+    try:
+        _active_clocks.reset(token)
+    except ValueError:
+        _active_clocks.set(None)
+
+
+# -- subprocess env binding ---------------------------------------------------
+#
+# A subprocess trial reporting via report_metrics (env/RPC/ingest store
+# bindings) inherits KATIB_TPU_STEP_STATS from the controller environment;
+# its perf series is produced here, one clock per (pid, trial). Series only —
+# detectors and rollups live controller-side off the persisted rows.
+
+_env_clock_lock = threading.Lock()
+_env_clocks: Dict[Tuple[int, str], StepClock] = {}
+
+
+def env_step_stats_enabled() -> bool:
+    return _truthy(os.environ.get(ENV_STEP_STATS))
+
+
+def env_perf_logs(trial: str, metrics: Dict[str, Any]) -> List[MetricLog]:
+    """Mark the env-bound clock for ``trial`` and return any freshly
+    completed windows as rows. Empty (and clock-free) when the knob is off."""
+    if not env_step_stats_enabled():
+        return []
+    try:
+        flush = int(os.environ.get(ENV_FLUSH_STEPS) or 32)
+    except ValueError:
+        flush = 32
+    key = (os.getpid(), trial)
+    with _env_clock_lock:
+        clock = _env_clocks.get(key)
+        if clock is None:
+            clock = StepClock(flush_steps=flush)
+            _env_clocks[key] = clock
+    clock.mark(metrics)
+    return perf_logs(clock.drain())
+
+
+# -- offline summaries --------------------------------------------------------
+
+def summarize_perf_rows(logs: Sequence[MetricLog]) -> Optional[Dict[str, Any]]:
+    """Fold one trial's perf rows (any rows under PERF_PREFIX) into the
+    summary the ``katib-tpu perf`` CLI renders. None when the trial has no
+    perf series (knob was off)."""
+    windows = 0
+    stints = 0
+    retraces = 0.0
+    last: Dict[str, float] = {}
+    for log in logs:
+        if not log.metric_name.startswith(PERF_PREFIX):
+            continue
+        name = log.metric_name[len(PERF_PREFIX):]
+        try:
+            value = float(log.value)
+        except (TypeError, ValueError):
+            continue
+        if name == "step_seconds_mean":
+            windows += 1
+        elif name == "stint_step_seconds_p50":
+            stints += 1
+        elif name == "retraces":
+            retraces += value
+        last[name] = value
+    if not last:
+        return None
+    return {
+        "windows": windows,
+        "stints": stints,
+        "stepSecondsP50": last.get("stint_step_seconds_p50"),
+        "stepSecondsP95": last.get(
+            "stint_step_seconds_p95", last.get("step_seconds_p95")
+        ),
+        "stepsPerSecond": last.get("steps_per_second"),
+        "examplesPerSecond": last.get("examples_per_second"),
+        "mfu": last.get("stint_mfu"),
+        "retraces": int(retraces),
+    }

@@ -704,12 +704,35 @@ _FLEET_COUNTER_FAMILIES = (
 )
 
 
+# per-experiment step-statistics families folded into the fleet row's
+# ``perf`` map (ISSUE 20; absent entirely when runtime.step_stats is off)
+_FLEET_PERF_FAMILIES = {
+    "katib_step_seconds": "stepSeconds",
+    "katib_trial_throughput": "throughput",
+    "katib_trial_mfu_ratio": "mfu",
+    "katib_trial_retraces_total": "retraces",
+    "katib_objective_per_device_second": "objectivePerDeviceSecond",
+}
+
+
+def _parse_labels(head: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    if "{" in head:
+        for part in head[head.index("{") + 1:-1].split(","):
+            k, _, v = part.partition("=")
+            if k:
+                labels[k.strip()] = v.strip().strip('"')
+    return labels
+
+
 def _metrics_summary(text: str) -> Dict[str, Any]:
     """Fold one replica's Prometheus exposition into the fleet row: summed
-    rpc/ingest counters, the last coalesce depth, and per-tenant SLO
-    violation counts. Tolerant of any families it doesn't know."""
+    rpc/ingest counters, the last coalesce depth, per-tenant SLO violation
+    counts, and the per-experiment step-performance rollups. Tolerant of
+    any families it doesn't know."""
     sums: Dict[str, float] = {}
     slo: Dict[str, float] = {}
+    perf: Dict[str, Dict[str, Any]] = {}
     depth: Optional[float] = None
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -727,19 +750,29 @@ def _metrics_summary(text: str) -> Dict[str, Any]:
         elif name == "katib_ingest_coalesce_depth":
             depth = value
         elif name == "katib_slo_violations_total":
-            tenant = "default"
-            if "{" in head:
-                for part in head[head.index("{") + 1:-1].split(","):
-                    k, _, v = part.partition("=")
-                    if k == "tenant":
-                        tenant = v.strip('"')
+            tenant = _parse_labels(head).get("tenant", "default")
             slo[tenant] = slo.get(tenant, 0.0) + value
-    return {
+        elif name in _FLEET_PERF_FAMILIES:
+            labels = _parse_labels(head)
+            exp = labels.get("experiment")
+            if not exp:
+                continue
+            row = perf.setdefault(exp, {})
+            if name == "katib_step_seconds":
+                row[labels.get("quantile", "p50")] = value
+            else:
+                row[_FLEET_PERF_FAMILIES[name]] = value
+    out: Dict[str, Any] = {
         "rpcRequests": sums.get("katib_rpc_requests_total", 0.0),
         "ingestFrames": sums.get("katib_ingest_frames_total", 0.0),
         "ingestCoalesceDepth": depth,
         "sloViolations": slo,
     }
+    if perf:
+        # key absent entirely when step stats are off — the fleet JSON stays
+        # byte-identical to the pre-perf plane
+        out["perf"] = perf
+    return out
 
 
 def _fetch_metrics_text(base_url: str, timeout: float) -> Optional[str]:

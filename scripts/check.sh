@@ -79,6 +79,10 @@ echo "== distributed tracing-overhead smoke (3 replica subprocesses, wire tracin
 JAX_PLATFORMS=cpu python bench.py tracing_overhead --smoke --distributed
 
 echo
+echo "== step-stats smoke (per-step timing plane off vs on, injected gang straggler) =="
+JAX_PLATFORMS=cpu python bench.py step_stats_overhead --smoke
+
+echo
 echo "== multi-tenant scaling smoke (per-tenant tokens/quotas, adversarial probe, SIGKILL zero-loss) =="
 JAX_PLATFORMS=cpu python bench.py multi_tenant_scaling --smoke
 

@@ -556,6 +556,23 @@ def test_tracing_overhead_smoke_wiring(bench):
     # run with busy-work trials is the meaningful <3% measurement
 
 
+def test_step_stats_overhead_smoke_wiring(bench):
+    """--smoke mode of the step_stats_overhead scenario (ISSUE 20): full
+    pack_size=8 sweeps run end-to-end with the step-statistics plane off and
+    on (off must write zero katib-tpu/perf/ rows and export none of the step
+    metric families — asserted inside the scenario), and the final
+    injected-straggler pass must fire exactly one GangStraggler event. No
+    strict 3% assertion in smoke — the trimmed passes are scheduling noise;
+    the timed run's within_target is the acceptance number."""
+    out = bench._bench_step_stats_overhead(smoke=True)
+    assert out["smoke"] is True
+    assert out["pack_size"] == 8 and out["reports_per_member"] > 0
+    assert out["on_s"] > 0 and out["off_s"] > 0
+    assert out["target_pct"] == 3.0
+    assert isinstance(out["within_target"], bool)
+    assert out["straggler_events"] == 1
+
+
 def test_tracing_overhead_distributed_smoke_wiring(bench):
     """--distributed --smoke mode of tracing_overhead (ISSUE 19): the same
     experiment batch runs through 3 REAL replica subprocesses with wire
